@@ -1,0 +1,48 @@
+"""Test env: force JAX onto the host CPU with 8 fake devices BEFORE any jax
+import (SURVEY.md §4.3 — the standard way to test multi-device pjit/shard_map
+programs without a pod).  Must run before any test module imports jax."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture
+def taxi_like_df():
+    """NYC-taxi-shaped fixture (SURVEY §4.4): mixed numeric / categorical /
+    datetime / constant / unique / correlated columns with missing values."""
+    rng = np.random.default_rng(42)
+    n = 2000
+    fare = rng.gamma(2.0, 7.5, n)
+    tip = fare * 0.2 + rng.normal(0, 0.5, n)          # strongly correlated
+    distance = rng.exponential(2.5, n)
+    passengers = rng.integers(1, 7, n).astype(np.int64)
+    vendor = rng.choice(["CMT", "VTS", "DDS"], n, p=[0.5, 0.4, 0.1])
+    payment = rng.choice(["card", "cash", "disp", "no charge"], n)
+    pickup = pd.Timestamp("2019-01-01") + pd.to_timedelta(
+        rng.integers(0, 31 * 24 * 3600, n), unit="s")
+    flag = rng.random(n) < 0.3
+    df = pd.DataFrame({
+        "fare_amount": fare,
+        "tip_amount": tip,
+        "trip_distance": distance,
+        "passenger_count": passengers,
+        "vendor_id": vendor,
+        "payment_type": payment,
+        "pickup_datetime": pickup,
+        "store_and_fwd": flag,
+        "const_col": 1.0,
+        "record_id": [f"id_{i:06d}" for i in range(n)],
+    })
+    # missing values in a few columns
+    df.loc[rng.choice(n, 200, replace=False), "fare_amount"] = np.nan
+    df.loc[rng.choice(n, 100, replace=False), "vendor_id"] = None
+    return df

@@ -1,0 +1,131 @@
+"""Oracle-backend semantics tests (SURVEY §4.1): the CPU backend defines
+exact reference behavior; these pin type classification, moment values,
+rejection rules, and the stats-dict contract."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfilerConfig, describe, schema
+from tpuprof.backends.cpu import CPUStatsBackend
+
+
+def _collect(df, **kw):
+    cfg = ProfilerConfig(backend="cpu", **kw)
+    return CPUStatsBackend().collect(df, cfg)
+
+
+def test_contract_valid(taxi_like_df):
+    stats = _collect(taxi_like_df)
+    assert schema.validate_stats(stats) == []
+
+
+def test_type_classification(taxi_like_df):
+    stats = _collect(taxi_like_df)
+    v = stats["variables"]
+    assert v["fare_amount"]["type"] == schema.NUM
+    assert v["tip_amount"]["type"] == schema.CORR      # corr with fare > 0.9
+    assert v["vendor_id"]["type"] == schema.CAT
+    assert v["pickup_datetime"]["type"] == schema.DATE
+    assert v["store_and_fwd"]["type"] == schema.BOOL
+    assert v["const_col"]["type"] == schema.CONST
+    assert v["record_id"]["type"] == schema.UNIQUE
+
+
+def test_numeric_moments_exact():
+    x = np.array([1.0, 2.0, 3.0, 4.0, 100.0])
+    df = pd.DataFrame({"x": x, "y": [1.0, -1.0, 1.0, -1.0, 1.0]})
+    stats = _collect(df)
+    v = stats["variables"]["x"]
+    assert v["count"] == 5
+    assert v["mean"] == pytest.approx(x.mean())
+    assert v["std"] == pytest.approx(x.std(ddof=1))
+    assert v["variance"] == pytest.approx(x.var(ddof=1))
+    assert v["min"] == 1.0 and v["max"] == 100.0 and v["range"] == 99.0
+    assert v["sum"] == pytest.approx(x.sum())
+    d = x - x.mean()
+    m2, m3, m4 = (d**2).mean(), (d**3).mean(), (d**4).mean()
+    assert v["skewness"] == pytest.approx(m3 / m2**1.5)
+    assert v["kurtosis"] == pytest.approx(m4 / m2**2 - 3.0)
+    assert v["mad"] == pytest.approx(np.abs(d).mean())
+    assert v["p50"] == pytest.approx(np.quantile(x, 0.5))
+    assert v["iqr"] == pytest.approx(np.quantile(x, .75) - np.quantile(x, .25))
+
+
+def test_missing_zeros_inf():
+    df = pd.DataFrame({
+        "x": [0.0, 0.0, 1.0, np.nan, np.inf, -np.inf, 5.0],
+        "y": np.arange(7, dtype="float64"),
+    })
+    stats = _collect(df)
+    v = stats["variables"]["x"]
+    assert v["count"] == 6 and v["n_missing"] == 1
+    assert v["p_missing"] == pytest.approx(1 / 7)
+    assert v["n_zeros"] == 2 and v["n_infinite"] == 2
+    assert v["min"] == -np.inf and v["max"] == np.inf
+    # moments over finite values only
+    finite = np.array([0.0, 0.0, 1.0, 5.0])
+    assert v["mean"] == pytest.approx(finite.mean())
+    assert v["sum"] == pytest.approx(finite.sum())
+
+
+def test_histogram_bins():
+    df = pd.DataFrame({"x": np.linspace(0, 10, 100),
+                       "y": np.random.default_rng(0).normal(size=100)})
+    stats = _collect(df, bins=7)
+    counts, edges = stats["variables"]["x"]["histogram"]
+    assert len(counts) == 7 and len(edges) == 8
+    assert counts.sum() == 100
+
+
+def test_corr_rejection_order_and_api(taxi_like_df):
+    from tpuprof import ProfileReport
+    report = ProfileReport(taxi_like_df, backend="cpu")
+    rejected = report.get_rejected_variables()
+    assert rejected == ["tip_amount"]
+    assert report.get_rejected_variables(0.999) == []
+    v = report.description["variables"]["tip_amount"]
+    assert v["correlation_var"] == "fare_amount"
+    assert abs(v["correlation"]) > 0.9
+
+
+def test_corr_overrides(taxi_like_df):
+    stats = _collect(taxi_like_df, correlation_overrides=["tip_amount"])
+    assert stats["variables"]["tip_amount"]["type"] == schema.NUM
+
+
+def test_table_stats(taxi_like_df):
+    stats = _collect(taxi_like_df)
+    t = stats["table"]
+    assert t["n"] == 2000 and t["nvar"] == 10
+    assert t[schema.NUM] == 3 and t[schema.CORR] == 1 and t[schema.CAT] == 2
+    assert t[schema.DATE] == 1 and t[schema.BOOL] == 1
+    assert t[schema.CONST] == 1 and t[schema.UNIQUE] == 1
+    assert 0 < t["total_missing"] < 0.05
+
+
+def test_messages(taxi_like_df):
+    stats = _collect(taxi_like_df)
+    kinds = {(m.kind, m.column) for m in stats["messages"]}
+    assert (schema.MSG_CONST, "const_col") in kinds
+    assert (schema.MSG_UNIQUE, "record_id") in kinds
+    assert (schema.MSG_CORR, "tip_amount") in kinds
+
+
+def test_freq_and_sample(taxi_like_df):
+    stats = _collect(taxi_like_df)
+    vc = stats["freq"]["vendor_id"]
+    assert vc.index[0] == "CMT"
+    assert vc.sum() == 1900            # 100 missing
+    assert len(stats["sample"]) == 5
+
+
+def test_empty_and_edge_frames():
+    stats = _collect(pd.DataFrame({"x": pd.Series([], dtype="float64")}))
+    assert stats["table"]["n"] == 0
+    assert stats["variables"]["x"]["type"] == schema.CONST
+    stats = _collect(pd.DataFrame({"x": [np.nan, np.nan]}))
+    v = stats["variables"]["x"]
+    assert v["count"] == 0 and v["n_missing"] == 2
+    stats = _collect(pd.DataFrame({"x": [1.0, 1.0, 1.0]}))
+    assert stats["variables"]["x"]["type"] == schema.CONST

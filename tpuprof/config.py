@@ -1,0 +1,67 @@
+"""Profiler configuration.
+
+The reference exposes constructor kwargs only (``bins=10``,
+``corr_reject=0.9``, sample size — SURVEY.md §5 "Config / flag system").
+tpuprof keeps that facade and routes everything through one dataclass so
+the TPU runtime knobs (batch size, sketch sizes, mesh shape, backend
+selection) have a single home with sane defaults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class ProfilerConfig:
+    # ---- parity knobs (reference constructor kwargs) ----------------------
+    bins: int = 10                  # histogram bin count
+    corr_reject: float = 0.9        # |Pearson| above this vs an earlier
+                                    # column rejects the later column (CORR)
+    sample_rows: int = 5            # head rows shown in the report
+    top_freq: int = 10              # value-count rows shown per CAT column
+    correlation_overrides: Optional[Sequence[str]] = None  # never reject these
+
+    # ---- warning thresholds (reference: messages derivation, SURVEY §2.1) -
+    high_cardinality_threshold: int = 50     # CAT distinct count above => warn
+    missing_threshold: float = 0.19          # p_missing above => warn
+    zeros_threshold: float = 0.5             # p_zeros above => warn
+    skewness_threshold: float = 20.0         # |skew| above => warn
+
+    # ---- backend selection ------------------------------------------------
+    backend: str = "auto"           # "auto" | "cpu" | "tpu"
+
+    # ---- TPU runtime knobs ------------------------------------------------
+    batch_rows: int = 1 << 16       # rows per Arrow batch fed to the device
+    quantile_sketch_size: int = 4096  # K: mergeable uniform-sample size per
+                                      # numeric column; rank error ~ 1/sqrt(K)
+    hll_precision: int = 11         # p: 2^p registers per column; rel. error
+                                    # ~= 1.04 / sqrt(2^p) (~2.3% at p=11)
+    topk_capacity: int = 4096       # Misra-Gries candidate capacity per CAT
+                                    # column; count error <= n / capacity
+    exact_passes: bool = True       # second scan: exact histograms + exact
+                                    # recount of top-k candidates (parity with
+                                    # Spark's exact groupBy().count()).
+                                    # False => single-pass streaming mode with
+                                    # sample-derived histograms.
+    mesh_devices: Optional[int] = None  # None => all available devices
+    seed: int = 0                   # PRNG seed for the sample sketch
+
+    # ---- quantiles reported (reference: approxQuantile probes) ------------
+    quantile_probes: Sequence[float] = (0.05, 0.25, 0.5, 0.75, 0.95)
+
+    def __post_init__(self) -> None:
+        if self.bins < 1:
+            raise ValueError("bins must be >= 1")
+        if not 0.0 < self.corr_reject <= 1.0:
+            raise ValueError("corr_reject must be in (0, 1]")
+        if self.hll_precision < 4 or self.hll_precision > 16:
+            raise ValueError("hll_precision must be in [4, 16]")
+
+    @classmethod
+    def from_kwargs(cls, **kwargs) -> "ProfilerConfig":
+        """Build a config from ProfileReport(**kwargs), ignoring unknowns the
+        way the reference tolerates stray kwargs."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in kwargs.items() if k in fields})
