@@ -1,0 +1,100 @@
+"""Golden-report tests (SURVEY §4.4): fixture → ProfileReport → parse HTML,
+assert section presence and key values; renderer must stay a pure function
+of the stats dict."""
+
+import re
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfileReport, ProfilerConfig
+from tpuprof.report import formatters, svg
+
+
+@pytest.fixture
+def report(taxi_like_df):
+    return ProfileReport(taxi_like_df, backend="cpu")
+
+
+def test_html_sections(report):
+    html = report.html
+    for section in ("Overview", "Variables", "Correlations (Pearson)",
+                    "Sample", "Warnings"):
+        assert section in html, f"missing section {section!r}"
+    # every column appears
+    for col in report.description["variables"]:
+        assert f'id="var-{col}"' in html
+    # histograms render as SVG, not matplotlib PNGs
+    assert "<svg" in html and "base64" not in html
+
+
+def test_variable_type_badges(report):
+    html = report.html
+    for badge in ("Numeric", "Categorical", "Boolean", "Date",
+                  "Constant", "Unique", "Rejected"):
+        assert badge in html
+
+
+def test_key_values_present(report):
+    html = report.html
+    v = report.description["variables"]["trip_distance"]
+    assert formatters.fmt_value(v["mean"]) in html
+    assert formatters.fmt_value(v["max"]) in html
+    # top category value appears in the freq table
+    assert "CMT" in html
+
+
+def test_to_file_standalone(report, tmp_path):
+    out = tmp_path / "report.html"
+    report.to_file(str(out))
+    page = out.read_text()
+    assert page.startswith("<!DOCTYPE html>")
+    assert "<style>" in page            # self-contained CSS
+    assert "</html>" in page
+    assert "http://" not in page.replace("http://www.w3.org", "")  # no CDN
+
+
+def test_repr_html_is_cached(report):
+    html1 = report._repr_html_()
+    html2 = report._repr_html_()
+    assert html1 is html2               # eager stats, cached render
+
+
+def test_histogram_svg_shapes():
+    counts = np.array([1, 5, 2])
+    edges = np.array([0.0, 1.0, 2.0, 3.0])
+    full = svg.histogram_svg((counts, edges))
+    mini = svg.histogram_svg((counts, edges), mini=True)
+    assert full.count("<rect") == 3 and mini.count("<rect") == 3
+    assert "hist-label" in full and "hist-label" not in mini
+    assert svg.histogram_svg(None) == ""
+
+
+def test_freq_table_other_row():
+    n = 100
+    df = pd.DataFrame({
+        "c": ["v%d" % (i % 20) for i in range(n)],
+        "x": np.arange(n, dtype="float64"),
+    })
+    r = ProfileReport(df, config=ProfilerConfig(backend="cpu", top_freq=5))
+    html = r.html
+    assert "Other values" in html
+    assert len(r.description["freq"]["c"]) == 5
+
+
+def test_formatters():
+    assert formatters.fmt_percent(0.1234) == "12.3%"
+    assert formatters.fmt_bytesize(2048) == "2.0 KiB"
+    assert formatters.fmt_number(1234567) == "1,234,567"
+    assert formatters.fmt_number(float("inf")) == "∞"
+    assert formatters.fmt_number(np.nan) == "NaN"
+    assert formatters.fmt_number(0.000123456) == "0.00012346"
+    assert formatters.alert_class(0.5, 0.3) == "alert-value"
+    assert formatters.alert_class(0.1, 0.3) == ""
+
+
+def test_empty_frame_renders():
+    df = pd.DataFrame({"x": pd.Series([], dtype="float64")})
+    html = ProfileReport(df, backend="cpu").html
+    assert "Overview" in html
