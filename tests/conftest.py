@@ -10,6 +10,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# A site hook (e.g. a TPU-tunnel plugin) may have force-registered an
+# accelerator platform at interpreter start and overridden jax_platforms;
+# pin the config back to CPU before any backend initializes so the suite
+# never depends on (or hangs on) accelerator availability.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pandas as pd
 import pytest

@@ -1,0 +1,246 @@
+"""Kernel oracle tests (SURVEY §4.1): each fused kernel vs numpy on small
+exact datasets, including NaN/±inf/zeros/constant edge distributions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuprof.kernels import corr, histogram, hll, moments, quantiles, topk
+
+
+def _np_batches(x, nb):
+    """Split rows of x into nb uneven batches."""
+    cuts = np.linspace(0, x.shape[0], nb + 1).astype(int)
+    return [x[a:b] for a, b in zip(cuts[:-1], cuts[1:])]
+
+
+def _fold_moments(x, nb=3):
+    state = moments.init(x.shape[1])
+    upd = jax.jit(moments.update)
+    for xb in _np_batches(x, nb):
+        state = upd(state, jnp.asarray(xb, dtype=jnp.float32),
+                    jnp.ones(xb.shape[0], dtype=bool))
+    return moments.finalize(jax.device_get(state))
+
+
+class TestMoments:
+    def test_vs_numpy(self):
+        rng = np.random.default_rng(0)
+        x = np.stack([rng.normal(1000.0, 2.0, 1001),       # large-mean col:
+                      rng.gamma(2.0, 5.0, 1001),            # cancellation test
+                      np.linspace(-5, 5, 1001)], axis=1)
+        out = _fold_moments(x, nb=7)
+        for c in range(3):
+            col = x[:, c].astype(np.float32).astype(np.float64)
+            d = col - col.mean()
+            scale = max(col.std(ddof=1), 1.0)
+            assert out["mean"][c] == pytest.approx(col.mean(), rel=1e-5,
+                                                   abs=1e-5 * scale)
+            assert out["std"][c] == pytest.approx(col.std(ddof=1), rel=1e-4)
+            assert out["sum"][c] == pytest.approx(col.sum(), rel=1e-5,
+                                                  abs=1e-2 * scale)
+            m2, m3, m4 = (d**2).mean(), (d**3).mean(), (d**4).mean()
+            assert out["skewness"][c] == pytest.approx(m3 / m2**1.5, abs=2e-2)
+            assert out["kurtosis"][c] == pytest.approx(m4 / m2**2 - 3, rel=2e-2, abs=2e-2)
+            assert out["min"][c] == col.min() and out["max"][c] == col.max()
+
+    def test_nan_inf_zero_masks(self):
+        x = np.array([[0.0, 1.0], [np.nan, 2.0], [np.inf, 3.0],
+                      [-np.inf, 4.0], [0.0, np.nan], [7.0, 6.0]])
+        state = moments.init(2)
+        # padding: 2 extra invalid rows must not count anywhere
+        xp = np.vstack([x, np.full((2, 2), np.nan)])
+        rv = np.array([True] * 6 + [False] * 2)
+        state = jax.jit(moments.update)(
+            state, jnp.asarray(xp, dtype=jnp.float32), jnp.asarray(rv))
+        out = moments.finalize(jax.device_get(state))
+        assert out["n_missing"].tolist() == [1, 1]
+        assert out["n_inf"].tolist() == [2, 0]
+        assert out["n_zeros"].tolist() == [2, 0]
+        assert out["n"].tolist() == [3, 5]              # finite counts
+        assert out["min"][0] == -np.inf and out["max"][0] == np.inf
+        assert out["fmin"][0] == 0.0 and out["fmax"][0] == 7.0
+        assert out["mean"][0] == pytest.approx(7.0 / 3)
+
+    def test_empty_state_finalize(self):
+        out = moments.finalize(jax.device_get(moments.init(2)))
+        assert np.isnan(out["mean"]).all()
+        assert (out["n"] == 0).all()
+
+
+class TestCorr:
+    def test_vs_pandas_pairwise(self):
+        import pandas as pd
+        rng = np.random.default_rng(1)
+        n = 500
+        df = pd.DataFrame({
+            "a": rng.normal(1e4, 1.0, n),       # large mean: shift test
+            "b": rng.normal(0, 1, n),
+            "c": rng.normal(0, 1, n),
+        })
+        df["d"] = df["a"] * -0.5 + rng.normal(0, 1, n)
+        df.loc[rng.choice(n, 50, replace=False), "b"] = np.nan  # pairwise-
+        x = df.to_numpy(dtype=np.float64)                       # complete path
+        state = corr.init(4)
+        upd = jax.jit(corr.update)
+        for xb in _np_batches(x, 5):
+            state = upd(state, jnp.asarray(xb, dtype=jnp.float32),
+                        jnp.ones(xb.shape[0], dtype=bool))
+        rho = corr.finalize(jax.device_get(state))
+        expected = df.corr(method="pearson").to_numpy()
+        np.testing.assert_allclose(rho, expected, atol=2e-3)
+        assert np.allclose(np.diag(rho), 1.0, atol=1e-4)
+
+    def test_constant_column_nan(self):
+        x = np.stack([np.ones(100), np.arange(100.0)], axis=1)
+        state = jax.jit(corr.update)(
+            corr.init(2), jnp.asarray(x, dtype=jnp.float32),
+            jnp.ones(100, dtype=bool))
+        rho = corr.finalize(jax.device_get(state))
+        assert np.isnan(rho[0, 1]) and np.isnan(rho[0, 0])
+
+
+class TestQuantiles:
+    def test_exact_when_small(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(0, 10, (300, 2))
+        state = quantiles.init(2, k=512)           # n < K: sample == column
+        upd = jax.jit(quantiles.update)
+        for i, xb in enumerate(_np_batches(x, 4)):
+            state = upd(state, jnp.asarray(xb, dtype=jnp.float32),
+                        jnp.ones(xb.shape[0], dtype=bool),
+                        jax.random.key(i))
+        probes = (0.05, 0.25, 0.5, 0.75, 0.95)
+        q = quantiles.finalize(jax.device_get(state), probes)
+        for c in range(2):
+            np.testing.assert_allclose(
+                q[:, c], np.quantile(x[:, c], probes), rtol=1e-6)
+
+    def test_error_bound_large(self):
+        rng = np.random.default_rng(3)
+        n, k = 200_000, 4096
+        x = rng.gamma(2.0, 5.0, (n, 1))
+        state = quantiles.init(1, k=k)
+        upd = jax.jit(quantiles.update)
+        for i, xb in enumerate(_np_batches(x, 10)):
+            state = upd(state, jnp.asarray(xb, dtype=jnp.float32),
+                        jnp.ones(xb.shape[0], dtype=bool), jax.random.key(i))
+        q = quantiles.finalize(jax.device_get(state), (0.5,))
+        # rank error ~1/sqrt(K): the median estimate must sit within ±4
+        # sigma_rank of the true rank
+        sorted_x = np.sort(x[:, 0])
+        rank = np.searchsorted(sorted_x, q[0, 0]) / n
+        assert abs(rank - 0.5) < 4.0 / np.sqrt(k)
+
+    def test_nan_inf_excluded(self):
+        x = np.array([[1.0], [np.nan], [np.inf], [2.0], [3.0]])
+        state = jax.jit(quantiles.update)(
+            quantiles.init(1, 16), jnp.asarray(x, dtype=jnp.float32),
+            jnp.ones(5, dtype=bool), jax.random.key(0))
+        q = quantiles.finalize(jax.device_get(state), (0.0, 1.0))
+        assert q[0, 0] == 1.0 and q[1, 0] == 3.0
+
+
+class TestHLL:
+    def _hashes(self, values):
+        import pandas as pd
+        h64 = pd.util.hash_array(np.asarray(values))
+        return ((h64 >> 32).astype(np.uint32), h64.astype(np.uint32))
+
+    def test_small_exact_linear_counting(self):
+        ha, hb = self._hashes(np.arange(37) % 5)     # 5 distinct
+        regs = hll.init(1, precision=11)
+        regs = jax.jit(hll.update, static_argnames="precision")(
+            regs, jnp.asarray(ha)[:, None], jnp.asarray(hb)[:, None],
+            jnp.ones((37, 1), dtype=bool), precision=11)
+        est = hll.finalize(jax.device_get(regs))
+        assert round(est[0]) == 5
+
+    def test_error_bound_large(self):
+        n = 300_000
+        ha, hb = self._hashes(np.arange(n))          # all distinct
+        regs = hll.init(1, precision=11)
+        upd = jax.jit(hll.update, static_argnames="precision")
+        for s in range(0, n, 50_000):
+            regs = upd(regs, jnp.asarray(ha[s:s+50_000])[:, None],
+                       jnp.asarray(hb[s:s+50_000])[:, None],
+                       jnp.ones((50_000, 1), dtype=bool), precision=11)
+        est = hll.finalize(jax.device_get(regs))
+        assert abs(est[0] - n) / n < 5 * 1.04 / np.sqrt(2048)
+
+    def test_nulls_ignored(self):
+        ha, hb = self._hashes(np.arange(10))
+        valid = np.zeros((10, 1), dtype=bool)
+        regs = jax.jit(hll.update, static_argnames="precision")(
+            hll.init(1, 11), jnp.asarray(ha)[:, None],
+            jnp.asarray(hb)[:, None], jnp.asarray(valid), precision=11)
+        assert hll.finalize(jax.device_get(regs))[0] == 0.0
+
+
+class TestHistogram:
+    def test_vs_numpy(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(0, 3, (5000, 2)).astype(np.float32)  # ranges must come
+        lo, hi = x.min(axis=0), x.max(axis=0)   # from the same f32 values the
+        x = x.astype(np.float64)                # device sees (as pass A does)
+        state = histogram.init(2, bins=10)
+        upd = jax.jit(histogram.update)
+        mean = x.mean(axis=0)
+        for xb in _np_batches(x, 6):
+            state = upd(state, jnp.asarray(xb, dtype=jnp.float32),
+                        jnp.ones(xb.shape[0], dtype=bool),
+                        jnp.asarray(lo, dtype=jnp.float32),
+                        jnp.asarray(hi, dtype=jnp.float32),
+                        jnp.asarray(mean, dtype=jnp.float32))
+        hists, mad = histogram.finalize(
+            jax.device_get(state), lo, hi, np.array([5000, 5000]), 10)
+        for c in range(2):
+            counts, edges = hists[c]
+            expected, eedges = np.histogram(
+                x[:, c].astype(np.float32), bins=10, range=(lo[c], hi[c]))
+            # f32 values near bin edges may land one bin over vs f64 numpy;
+            # compare against the f32-cast numpy histogram (exact match)
+            np.testing.assert_array_equal(counts, expected)
+            np.testing.assert_allclose(edges, eedges, rtol=1e-12)
+            assert mad[c] == pytest.approx(
+                np.abs(x[:, c] - mean[c]).mean(), rel=1e-4)
+
+
+class TestMisraGries:
+    def test_exact_under_capacity(self):
+        mg = topk.MisraGries(10)
+        vals = np.array(["a"] * 50 + ["b"] * 30 + ["c"] * 20)
+        u, c = np.unique(vals, return_counts=True)
+        mg.update_batch(u, c)
+        assert mg.exact and mg.distinct_count() == 3
+        assert mg.top(2) == [("a", 50), ("b", 30)]
+
+    def test_heavy_hitter_guarantee(self):
+        rng = np.random.default_rng(5)
+        # zipf-ish: value i has frequency ~ 1/i
+        vals = np.concatenate([np.full(3000 // (i + 1), i) for i in range(200)])
+        rng.shuffle(vals)
+        mg = topk.MisraGries(64)
+        for chunk in np.array_split(vals, 7):
+            u, c = np.unique(chunk, return_counts=True)
+            mg.update_batch(u, c)
+        n = len(vals)
+        true_counts = {i: (3000 // (i + 1)) for i in range(200)}
+        # every value with true count > n/capacity survives, counts are
+        # underestimates within n/capacity
+        for v, est in mg.counts.items():
+            assert est <= true_counts[v]
+            assert true_counts[v] - est <= mg.offset <= n / 64 + 1
+        for v, tc in true_counts.items():
+            if tc > n / 64:
+                assert v in mg.counts
+
+    def test_merge(self):
+        a, b = topk.MisraGries(8), topk.MisraGries(8)
+        ua, ca = np.unique(["x"] * 9 + ["y"] * 5, return_counts=True)
+        ub, cb = np.unique(["x"] * 4 + ["z"] * 7, return_counts=True)
+        a.update_batch(ua, ca)
+        b.update_batch(ub, cb)
+        a.merge(b)
+        assert a.counts["x"] == 13 and a.exact
