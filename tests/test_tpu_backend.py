@@ -1,0 +1,192 @@
+"""TPU-backend integration tests (SURVEY §4.1, §4.3): the fused sharded
+engine must reproduce the CPU oracle's stats dict — exact where the scan
+is exact, within documented bounds where a sketch is involved — and must
+be invariant to the device count (runs on the 8 fake CPU devices from
+conftest)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfilerConfig, schema
+from tpuprof.backends.cpu import CPUStatsBackend
+from tpuprof.backends.tpu import TPUStatsBackend
+
+
+def _cfg(**kw):
+    kw.setdefault("batch_rows", 512)
+    kw.setdefault("quantile_sketch_size", 4096)
+    return ProfilerConfig(backend="tpu", **kw)
+
+
+@pytest.fixture(scope="module")
+def fixture_df():
+    rng = np.random.default_rng(42)
+    n = 2000
+    fare = rng.gamma(2.0, 7.5, n)
+    df = pd.DataFrame({
+        "fare_amount": fare,
+        "tip_amount": fare * 0.2 + rng.normal(0, 0.5, n),
+        "trip_distance": rng.exponential(2.5, n),
+        "passenger_count": rng.integers(1, 7, n).astype(np.int64),
+        "vendor_id": rng.choice(["CMT", "VTS", "DDS"], n, p=[0.5, 0.4, 0.1]),
+        "pickup_datetime": pd.Timestamp("2019-01-01") + pd.to_timedelta(
+            rng.integers(0, 31 * 24 * 3600, n), unit="s"),
+        "store_and_fwd": rng.random(n) < 0.3,
+        "const_col": 1.0,
+        "record_id": [f"id_{i:06d}" for i in range(n)],
+    })
+    df.loc[rng.choice(n, 200, replace=False), "fare_amount"] = np.nan
+    df.loc[rng.choice(n, 100, replace=False), "vendor_id"] = None
+    return df
+
+
+@pytest.fixture(scope="module")
+def both(fixture_df):
+    cfg = _cfg()
+    tpu = TPUStatsBackend().collect(fixture_df, cfg)
+    cpu = CPUStatsBackend().collect(fixture_df, cfg)
+    return tpu, cpu
+
+
+def test_contract_and_types(both):
+    tpu, cpu = both
+    assert schema.validate_stats(tpu) == []
+    for name, v in cpu["variables"].items():
+        assert tpu["variables"][name]["type"] == v["type"], name
+
+
+def test_exact_stats_match(both):
+    tpu, cpu = both
+    for name, cv in cpu["variables"].items():
+        tv = tpu["variables"][name]
+        assert tv["count"] == cv["count"], name
+        assert tv["n_missing"] == cv["n_missing"], name
+        if cv["type"] == schema.NUM:
+            assert tv["n_zeros"] == cv["n_zeros"], name
+            assert tv["n_infinite"] == cv["n_infinite"], name
+            assert tv["min"] == pytest.approx(cv["min"], rel=1e-6), name
+            assert tv["max"] == pytest.approx(cv["max"], rel=1e-6), name
+
+
+def test_moment_stats_f32_tolerance(both):
+    tpu, cpu = both
+    for name, cv in cpu["variables"].items():
+        if cv["type"] != schema.NUM:
+            continue
+        tv = tpu["variables"][name]
+        for fld, tol in [("mean", 1e-4), ("std", 1e-3), ("variance", 2e-3),
+                         ("sum", 1e-4), ("mad", 1e-3),
+                         ("skewness", 2e-2), ("kurtosis", 5e-2)]:
+            assert tv[fld] == pytest.approx(cv[fld], rel=tol, abs=tol), \
+                f"{name}.{fld}: {tv[fld]} vs {cv[fld]}"
+
+
+def test_quantiles_exact_when_sample_holds_all(both):
+    # n=2000 <= K=4096: the sample sketch holds every value -> exact
+    tpu, cpu = both
+    for name, cv in cpu["variables"].items():
+        if cv["type"] != schema.NUM:
+            continue
+        tv = tpu["variables"][name]
+        for fld in ("p5", "p25", "p50", "p75", "p95", "iqr"):
+            assert tv[fld] == pytest.approx(cv[fld], rel=1e-4, abs=1e-4), \
+                f"{name}.{fld}"
+
+
+def test_histograms_exact(both):
+    tpu, cpu = both
+    for name, cv in cpu["variables"].items():
+        if cv["type"] != schema.NUM:
+            continue
+        t_counts, t_edges = tpu["variables"][name]["histogram"]
+        c_counts, c_edges = cv["histogram"]
+        assert t_counts.sum() == c_counts.sum(), name
+        # f32 binning can move edge-adjacent values one bin; bound the drift
+        assert np.abs(t_counts - c_counts).max() <= max(
+            2, int(0.01 * c_counts.sum())), name
+        np.testing.assert_allclose(t_edges, c_edges, rtol=1e-5)
+
+
+def test_topk_exact_recount(both):
+    tpu, cpu = both
+    t_vc, c_vc = tpu["freq"]["vendor_id"], cpu["freq"]["vendor_id"]
+    assert list(t_vc.index[:3]) == list(c_vc.index[:3])
+    assert list(t_vc.values[:3]) == list(c_vc.values[:3])   # exact counts
+    tv = tpu["variables"]["vendor_id"]
+    assert tv["mode"] == "CMT" and tv["freq"] == int(c_vc.iloc[0])
+    assert tv["distinct_count"] == 3                        # MG exact
+
+
+def test_bool_stats(both):
+    tpu, cpu = both
+    tv, cv = tpu["variables"]["store_and_fwd"], cpu["variables"]["store_and_fwd"]
+    assert tv["mean"] == pytest.approx(cv["mean"], abs=1e-5)
+    assert tpu["freq"]["store_and_fwd"][False] == cpu["freq"]["store_and_fwd"][False]
+
+
+def test_date_minmax_exact(both):
+    tpu, cpu = both
+    tv, cv = tpu["variables"]["pickup_datetime"], cpu["variables"]["pickup_datetime"]
+    assert tv["min"] == cv["min"] and tv["max"] == cv["max"]
+
+
+def test_correlation_and_rejection(both):
+    tpu, cpu = both
+    tv = tpu["variables"]["tip_amount"]
+    assert tv["type"] == schema.CORR
+    assert tv["correlation_var"] == "fare_amount"
+    assert tv["correlation"] == pytest.approx(
+        cpu["variables"]["tip_amount"]["correlation"], abs=1e-3)
+    t_m = tpu["correlations"]["pearson"]
+    c_m = cpu["correlations"]["pearson"]
+    np.testing.assert_allclose(
+        t_m.loc[c_m.index, c_m.columns].to_numpy(), c_m.to_numpy(), atol=2e-3)
+
+
+def test_messages_parity(both):
+    tpu, cpu = both
+    t_kinds = {(m.kind, m.column) for m in tpu["messages"]}
+    c_kinds = {(m.kind, m.column) for m in cpu["messages"]}
+    assert t_kinds == c_kinds
+
+
+def test_device_count_invariance(fixture_df):
+    """SURVEY §4.3: 1-device result == 8-device result (same seed)."""
+    import jax
+    cfg = _cfg()
+    full = TPUStatsBackend().collect(fixture_df, cfg)
+    one = TPUStatsBackend(devices=jax.devices()[:1]).collect(fixture_df, cfg)
+    for name, v8 in full["variables"].items():
+        v1 = one["variables"][name]
+        assert v1["type"] == v8["type"], name
+        for fld in ("count", "n_missing", "distinct_count"):
+            assert v1[fld] == v8[fld], (name, fld)
+        if v8["type"] == schema.NUM:
+            for fld in ("mean", "std", "min", "max", "sum"):
+                assert v1[fld] == pytest.approx(v8[fld], rel=1e-5,
+                                                abs=1e-6), (name, fld)
+            np.testing.assert_array_equal(v1["histogram"][0],
+                                          v8["histogram"][0])
+
+
+def test_parquet_path_source(fixture_df, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    path = str(tmp_path / "fixture.parquet")
+    pq.write_table(pa.Table.from_pandas(fixture_df, preserve_index=False),
+                   path, row_group_size=300)
+    stats = TPUStatsBackend().collect(path, _cfg())
+    assert stats["table"]["n"] == len(fixture_df)
+    assert stats["variables"]["vendor_id"]["type"] == schema.CAT
+    assert len(stats["sample"]) == 5
+
+
+def test_streaming_single_pass_mode(fixture_df):
+    """exact_passes=False: one scan; histograms/topk from sketches."""
+    stats = TPUStatsBackend().collect(fixture_df, _cfg(exact_passes=False))
+    v = stats["variables"]["trip_distance"]
+    assert v["type"] == schema.NUM
+    counts, edges = v["histogram"]
+    assert counts.sum() > 0 and len(edges) == 11
+    assert stats["variables"]["vendor_id"]["freq"] > 0

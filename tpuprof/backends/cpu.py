@@ -163,24 +163,7 @@ def pearson_rejection(df: pd.DataFrame, numeric_cols: List[str],
     if len(numeric_cols) < 2:
         return pd.DataFrame(), {}
     corr = df[numeric_cols].corr(method="pearson")
-    overrides = set(config.correlation_overrides or ())
-    kept: List[str] = []
-    rejected: Dict[str, tuple] = {}
-    for col in numeric_cols:
-        if col in overrides:
-            kept.append(col)
-            continue
-        hit = None
-        for earlier in kept:
-            rho = corr.loc[col, earlier]
-            if np.isfinite(rho) and abs(rho) > config.corr_reject:
-                hit = (earlier, float(rho))
-                break
-        if hit:
-            rejected[col] = hit
-        else:
-            kept.append(col)
-    return corr, rejected
+    return corr, schema.reject_by_correlation(corr, numeric_cols, config)
 
 
 class CPUStatsBackend:

@@ -1,0 +1,221 @@
+"""Arrow → device-batch preparation (the host hot loop, SURVEY.md §3.5).
+
+Per record batch this module produces fixed-shape numpy arrays the fused
+device step consumes directly:
+
+* ``x``       (G, n_num)  float32 — numeric/boolean lanes, NaN = missing
+* ``row_valid`` (G,)      bool    — masks the padding rows
+* ``hash_a/b`` (G, n_hash) uint32 — two lanes of a 64-bit value hash for
+                                     EVERY column (HLL distinct counts)
+* ``hvalid``  (G, n_hash) bool    — per-value null mask for the hashes
+
+plus the host-only side-channel work: Misra-Gries frequency updates for
+categorical columns (on dictionary codes, vectorized), date min/max on
+int64 nanoseconds (float would quantize to 256 ns — exactness matters),
+null tallies, and the report's sample rows.
+
+Hashing: ``pandas.util.hash_array`` (vectorized SipHash-like, C speed).
+String columns are dictionary-encoded once per batch, only the
+dictionary is hashed, and codes gather the hashes — O(distinct) hashing
+instead of O(rows) (SURVEY §7.2's vectorize-before-C++ guidance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.dataset as pads
+
+from tpuprof import schema
+
+
+@dataclasses.dataclass
+class ColumnSpec:
+    name: str
+    role: str                 # "num" | "date" | "cat"
+    base_kind: str            # schema.{NUM,BOOL,DATE,CAT} before refinement
+    num_lane: int = -1        # lane in the x matrix ("num" role only)
+    hash_lane: int = -1       # lane in the hash matrices (every column)
+    arrow_type: Optional[pa.DataType] = None
+
+
+@dataclasses.dataclass
+class ColumnPlan:
+    specs: List[ColumnSpec]
+
+    @property
+    def n_num(self) -> int:
+        return sum(1 for s in self.specs if s.role == "num")
+
+    @property
+    def n_hash(self) -> int:
+        return len(self.specs)
+
+    def by_role(self, role: str) -> List[ColumnSpec]:
+        return [s for s in self.specs if s.role == role]
+
+    @classmethod
+    def from_schema(cls, arrow_schema: pa.Schema) -> "ColumnPlan":
+        specs: List[ColumnSpec] = []
+        num_lane = 0
+        for i, field in enumerate(arrow_schema):
+            t = field.type
+            if isinstance(t, pa.DictionaryType):
+                t_inner = t.value_type
+            else:
+                t_inner = t
+            if pa.types.is_boolean(t_inner):
+                spec = ColumnSpec(field.name, "num", schema.BOOL,
+                                  num_lane=num_lane, arrow_type=t)
+                num_lane += 1
+            elif (pa.types.is_integer(t_inner) or pa.types.is_floating(t_inner)
+                  or pa.types.is_decimal(t_inner)):
+                spec = ColumnSpec(field.name, "num", schema.NUM,
+                                  num_lane=num_lane, arrow_type=t)
+                num_lane += 1
+            elif (pa.types.is_timestamp(t_inner) or pa.types.is_date(t_inner)
+                  or pa.types.is_time(t_inner)):
+                spec = ColumnSpec(field.name, "date", schema.DATE,
+                                  arrow_type=t)
+            else:
+                spec = ColumnSpec(field.name, "cat", schema.CAT, arrow_type=t)
+            spec.hash_lane = i
+            specs.append(spec)
+        return cls(specs)
+
+
+@dataclasses.dataclass
+class HostBatch:
+    """One device-ready batch plus host-side raw views."""
+
+    nrows: int
+    x: np.ndarray             # (G, n_num) float32, NaN missing/padding
+    row_valid: np.ndarray     # (G,) bool
+    hash_a: np.ndarray        # (G, n_hash) uint32
+    hash_b: np.ndarray        # (G, n_hash) uint32
+    hvalid: np.ndarray        # (G, n_hash) bool
+    # host-side views for MG / recount / dates: name -> payload
+    cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]]   # (codes, dict_vals)
+    date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]]   # (int64 ns, valid)
+
+
+def _hash64(values: np.ndarray) -> np.ndarray:
+    return pd.util.hash_array(values).astype(np.uint64)
+
+
+def _split_hash(h64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    return ((h64 >> np.uint64(32)).astype(np.uint32), h64.astype(np.uint32))
+
+
+def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
+                  pad_rows: int) -> HostBatch:
+    """Decode one Arrow record batch into a fixed-shape HostBatch."""
+    n = batch.num_rows
+    g = pad_rows
+    n_num, n_hash = plan.n_num, plan.n_hash
+    x = np.full((g, n_num), np.nan, dtype=np.float32)
+    hash_a = np.zeros((g, n_hash), dtype=np.uint32)
+    hash_b = np.zeros((g, n_hash), dtype=np.uint32)
+    hvalid = np.zeros((g, n_hash), dtype=bool)
+    row_valid = np.zeros((g,), dtype=bool)
+    row_valid[:n] = True
+    cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+
+    for i, spec in enumerate(plan.specs):
+        arr = batch.column(i)
+        if spec.role == "num":
+            f64 = arr.cast(pa.float64(), safe=False).to_numpy(
+                zero_copy_only=False)
+            x[:n, spec.num_lane] = f64.astype(np.float32)
+            valid = ~np.isnan(f64)
+            h64 = _hash64(f64)
+            ha, hb = _split_hash(h64)
+            hash_a[:n, spec.hash_lane] = ha
+            hash_b[:n, spec.hash_lane] = hb
+            hvalid[:n, spec.hash_lane] = valid
+        elif spec.role == "date":
+            valid = arr.is_valid().to_numpy(zero_copy_only=False)
+            ints = arr.cast(pa.timestamp("ns"), safe=False) \
+                      .cast(pa.int64(), safe=False) \
+                      .fill_null(0).to_numpy(zero_copy_only=False)
+            h64 = _hash64(ints)
+            ha, hb = _split_hash(h64)
+            hash_a[:n, spec.hash_lane] = ha
+            hash_b[:n, spec.hash_lane] = hb
+            hvalid[:n, spec.hash_lane] = valid
+            date_ints[spec.name] = (ints, valid)
+        else:  # cat
+            if not isinstance(arr.type, pa.DictionaryType):
+                arr = pc.dictionary_encode(arr)
+            combined = arr.combine_chunks() if isinstance(
+                arr, pa.ChunkedArray) else arr
+            valid = combined.is_valid().to_numpy(zero_copy_only=False)
+            codes = combined.indices.fill_null(0).to_numpy(
+                zero_copy_only=False).astype(np.int64)
+            dvals = np.asarray(combined.dictionary.to_pandas(), dtype=object)
+            if dvals.size:
+                dh = _hash64(dvals)
+                h64 = dh[codes]
+            else:
+                h64 = np.zeros(n, dtype=np.uint64)
+            ha, hb = _split_hash(h64)
+            hash_a[:n, spec.hash_lane] = ha
+            hash_b[:n, spec.hash_lane] = hb
+            hvalid[:n, spec.hash_lane] = valid
+            cat_codes[spec.name] = (np.where(valid, codes, -1), dvals)
+
+    return HostBatch(nrows=n, x=x, row_valid=row_valid, hash_a=hash_a,
+                     hash_b=hash_b, hvalid=hvalid, cat_codes=cat_codes,
+                     date_ints=date_ints)
+
+
+class ArrowIngest:
+    """Normalize a source into repeatable streams of HostBatches.
+
+    Accepted sources: pandas DataFrame, pyarrow Table, pyarrow Dataset,
+    or a path to a Parquet file/directory (streamed fragment-by-fragment,
+    never materialized — SURVEY §7.2 '1B×200 memory')."""
+
+    def __init__(self, source: Any, batch_rows: int):
+        self.batch_rows = int(batch_rows)
+        self._table: Optional[pa.Table] = None
+        self._dataset: Optional[pads.Dataset] = None
+        if isinstance(source, pd.DataFrame):
+            self._table = pa.Table.from_pandas(source, preserve_index=False)
+        elif isinstance(source, pa.Table):
+            self._table = source
+        elif isinstance(source, pa.RecordBatch):
+            self._table = pa.Table.from_batches([source])
+        elif isinstance(source, pads.Dataset):
+            self._dataset = source
+        elif isinstance(source, str):
+            self._dataset = pads.dataset(source)
+        else:
+            raise TypeError(
+                f"cannot ingest {type(source)!r}; expected DataFrame, "
+                f"pyarrow Table/RecordBatch/Dataset, or a Parquet path")
+        arrow_schema = (self._table.schema if self._table is not None
+                        else self._dataset.schema)
+        self.plan = ColumnPlan.from_schema(arrow_schema)
+        self.rescannable = True
+
+    def raw_batches(self) -> Iterator[pa.RecordBatch]:
+        if self._table is not None:
+            yield from self._table.to_batches(max_chunksize=self.batch_rows)
+        else:
+            yield from self._dataset.to_batches(batch_size=self.batch_rows)
+
+    def batches(self) -> Iterator[HostBatch]:
+        for rb in self.raw_batches():
+            yield prepare_batch(rb, self.plan, self.batch_rows)
+
+    def sample(self, n_rows: int) -> pd.DataFrame:
+        if self._table is not None:
+            return self._table.slice(0, n_rows).to_pandas()
+        return self._dataset.head(n_rows).to_pandas()

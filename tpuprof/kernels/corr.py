@@ -92,6 +92,11 @@ def _rebase(s: CorrState, target: Array) -> CorrState:
     return out
 
 
+def rebase(s: CorrState, target: Array) -> CorrState:
+    """Public rebase for the mesh runtime's collective merge."""
+    return _rebase(s, target)
+
+
 def merge(a: CorrState, b: CorrState) -> CorrState:
     target = jnp.where(a["set"] > 0, a["shift"], b["shift"])
     ar = _rebase(a, target)

@@ -107,6 +107,12 @@ def _rebase(s: MomentState, target_shift: Array) -> MomentState:
     return out
 
 
+def rebase(s: MomentState, target_shift: Array) -> MomentState:
+    """Public rebase — the mesh runtime's collective merge rebases every
+    device's sums onto a collectively agreed shift before its psum."""
+    return _rebase(s, target_shift)
+
+
 def merge(a: MomentState, b: MomentState) -> MomentState:
     """Commutative-monoid combine — the per-leaf op of the cross-device
     tree-reduce (SURVEY §2.3).  The merged state adopts the shift of

@@ -1,0 +1,211 @@
+"""Row-sharded SPMD execution over a 1-D device mesh.
+
+The reference's parallelism is Spark data parallelism: rows partitioned
+across executors, partial aggregates shuffle-merged (SURVEY.md §2.3).
+The TPU-native equivalent here:
+
+* a 1-D ``Mesh(devices, ("data",))``;
+* each host batch (G rows, padded) is row-sharded ``P("data")`` so every
+  device folds G/D rows into its OWN sketch state (state leaves carry a
+  leading device axis, also sharded ``P("data")`` — purely local update,
+  zero per-step communication);
+* at finalize, ONE collective program merges the per-device states:
+  ``psum`` for additive leaves (after an exact rebase to a collectively
+  agreed shift), ``pmin``/``pmax`` for bounds and HLL registers, and an
+  ``all_gather`` + top-k for the sample sketch — the "single psum
+  tree-reduce" of the north star (BASELINE.json), riding ICI within a
+  slice.
+
+Multi-host note: under ``jax.distributed`` the same program spans hosts —
+each host feeds its own Arrow fragments (DCN only carries ingestion and
+the final host-0 gather, SURVEY §5); the collective merge is unchanged
+because every sketch state is a commutative monoid (tests/test_merge_laws).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuprof.kernels import corr, histogram, hll, moments, quantiles
+
+Pytree = Any
+
+
+def _unstack(tree: Pytree) -> Pytree:
+    """Inside shard_map each state leaf arrives as a (1, ...) block of the
+    device-stacked axis; strip it for the kernel code."""
+    return jax.tree.map(lambda a: a[0], tree)
+
+
+def _restack(tree: Pytree) -> Pytree:
+    return jax.tree.map(lambda a: a[None], tree)
+
+
+class MeshRunner:
+    """Owns the mesh, the compiled sharded step/merge programs, and the
+    per-device state layout."""
+
+    def __init__(self, config, n_num: int, n_hash: int,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        devs = list(devices if devices is not None else jax.devices())
+        if config.mesh_devices:
+            devs = devs[: config.mesh_devices]
+        self.n_dev = len(devs)
+        self.mesh = Mesh(np.asarray(devs), ("data",))
+        # host batches are padded to a device-divisible row count
+        self.rows = -(-config.batch_rows // self.n_dev) * self.n_dev
+        self.n_num = n_num
+        self.n_hash = n_hash
+        self.k = config.quantile_sketch_size
+        self.precision = config.hll_precision
+        self.bins = config.bins
+        self.seed = config.seed
+        self._build_programs()
+
+    # -- state ------------------------------------------------------------
+
+    def init_pass_a(self) -> Pytree:
+        def one_device(_):
+            return {
+                "mom": moments.init(self.n_num),
+                "corr": corr.init(self.n_num),
+                "qs": quantiles.init(self.n_num, self.k),
+                "hll": hll.init(self.n_hash, self.precision),
+            }
+        return jax.vmap(one_device)(jnp.arange(self.n_dev))
+
+    def init_pass_b(self) -> Pytree:
+        return jax.vmap(lambda _: histogram.init(self.n_num, self.bins))(
+            jnp.arange(self.n_dev))
+
+    # -- compiled programs -------------------------------------------------
+
+    def _build_programs(self) -> None:
+        mesh, seed = self.mesh, self.seed
+        precision = self.precision
+
+        def local_step_a(state, x, row_valid, ha, hb, hv, step_idx):
+            s = _unstack(state)
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(seed), step_idx),
+                jax.lax.axis_index("data"))
+            out = {
+                "mom": moments.update(s["mom"], x, row_valid),
+                "corr": corr.update(s["corr"], x, row_valid),
+                "qs": quantiles.update(s["qs"], x, row_valid, key),
+                "hll": hll.update(s["hll"], ha, hb, hv, precision),
+            }
+            return _restack(out)
+
+        def local_step_b(state, x, row_valid, lo, hi, mean):
+            s = _unstack(state)
+            return _restack(histogram.update(s, x, row_valid, lo, hi, mean))
+
+        def local_merge_a(state):
+            """The collective tree-reduce: merge all devices' pass-A states
+            into one replicated state."""
+            s = _unstack(state)
+            # ---- moments + corr: psum additive leaves after rebasing to a
+            # collectively agreed shift (weighted mean of device shifts)
+            def common_shift(shift, weight):
+                wsum = jax.lax.psum(weight, "data")
+                return jax.lax.psum(shift * weight, "data") / jnp.maximum(
+                    wsum, 1.0)
+
+            mom = s["mom"]
+            w = (mom["n"] > 0).astype(jnp.float32)
+            mom = moments.rebase(mom, common_shift(mom["shift"], w))
+            merged_mom = {
+                "shift": mom["shift"],
+                "minv": jax.lax.pmin(mom["minv"], "data"),
+                "maxv": jax.lax.pmax(mom["maxv"], "data"),
+                "fmin": jax.lax.pmin(mom["fmin"], "data"),
+                "fmax": jax.lax.pmax(mom["fmax"], "data"),
+            }
+            for leaf in ("n", "s1", "s2", "s3", "s4",
+                         "n_zeros", "n_inf", "n_missing"):
+                merged_mom[leaf] = jax.lax.psum(mom[leaf], "data")
+
+            co = s["corr"]
+            wc = jnp.broadcast_to((co["set"] > 0).astype(jnp.float32),
+                                  co["shift"].shape)
+            co = corr.rebase(co, common_shift(co["shift"], wc))
+            merged_corr = {
+                "shift": co["shift"],
+                "set": jax.lax.pmax(co["set"], "data"),
+                "N": jax.lax.psum(co["N"], "data"),
+                "S1": jax.lax.psum(co["S1"], "data"),
+                "S2": jax.lax.psum(co["S2"], "data"),
+                "P": jax.lax.psum(co["P"], "data"),
+            }
+
+            # ---- sample sketch: gather every device's K candidates, keep
+            # the global top-K priorities (exactly the pairwise merge law)
+            vals = jax.lax.all_gather(s["qs"]["values"], "data", axis=0)
+            prio = jax.lax.all_gather(s["qs"]["prio"], "data", axis=0)
+            d, c, k = vals.shape
+            vals = jnp.moveaxis(vals, 0, 1).reshape(c, d * k)
+            prio = jnp.moveaxis(prio, 0, 1).reshape(c, d * k)
+            top_p, idx = jax.lax.top_k(prio, k)
+            merged_qs = {"values": jnp.take_along_axis(vals, idx, axis=1),
+                         "prio": top_p}
+
+            # ---- HLL: registers are max-mergeable
+            merged_hll = jax.lax.pmax(s["hll"], "data")
+
+            return _restack({"mom": merged_mom, "corr": merged_corr,
+                             "qs": merged_qs, "hll": merged_hll})
+
+        def local_merge_b(state):
+            return _restack(jax.tree.map(
+                lambda a: jax.lax.psum(a, "data"), _unstack(state)))
+
+        state_spec = P("data")
+        rows_spec = P("data")
+        rep = P()
+
+        self._step_a = jax.jit(shard_map(
+            local_step_a, mesh=mesh,
+            in_specs=(state_spec, rows_spec, rows_spec, rows_spec, rows_spec,
+                      rows_spec, rep),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
+        self._step_b = jax.jit(shard_map(
+            local_step_b, mesh=mesh,
+            in_specs=(state_spec, rows_spec, rows_spec, rep, rep, rep),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
+        self._merge_a = jax.jit(shard_map(
+            local_merge_a, mesh=mesh, in_specs=(state_spec,),
+            out_specs=state_spec, check_vma=False))
+        self._merge_b = jax.jit(shard_map(
+            local_merge_b, mesh=mesh, in_specs=(state_spec,),
+            out_specs=state_spec, check_vma=False))
+
+    # -- driver API --------------------------------------------------------
+
+    def step_a(self, state: Pytree, hb, step_idx: int) -> Pytree:
+        return self._step_a(state, hb.x, hb.row_valid, hb.hash_a, hb.hash_b,
+                            hb.hvalid, jnp.int32(step_idx))
+
+    def step_b(self, state: Pytree, hb, lo, hi, mean) -> Pytree:
+        return self._step_b(state, hb.x, hb.row_valid,
+                            jnp.asarray(lo, dtype=jnp.float32),
+                            jnp.asarray(hi, dtype=jnp.float32),
+                            jnp.asarray(mean, dtype=jnp.float32))
+
+    def finalize_a(self, state: Pytree) -> Dict[str, Any]:
+        """Collective merge on-device, then pull ONE replica to host."""
+        merged = jax.device_get(
+            jax.tree.map(lambda a: a[0], self._merge_a(state)))
+        return merged
+
+    def finalize_b(self, state: Pytree) -> Dict[str, Any]:
+        return jax.device_get(
+            jax.tree.map(lambda a: a[0], self._merge_b(state)))

@@ -225,6 +225,31 @@ def validate_stats(stats: Dict[str, Any]) -> List[str]:
     return problems
 
 
+def reject_by_correlation(corr, ordered_cols, config) -> Dict[str, tuple]:
+    """The reference's rejection rule (SURVEY §2.1), backend-agnostic:
+    scanning numeric columns in order, reject a column whose |ρ| vs an
+    *earlier kept* column exceeds ``corr_reject``; returns
+    {rejected_col: (earlier_col, rho)}.  ``corr`` is a pandas DataFrame."""
+    overrides = set(config.correlation_overrides or ())
+    kept = []
+    rejected: Dict[str, tuple] = {}
+    for col in ordered_cols:
+        if col in overrides:
+            kept.append(col)
+            continue
+        hit = None
+        for earlier in kept:
+            rho = corr.loc[col, earlier] if len(corr) else np.nan
+            if np.isfinite(rho) and abs(rho) > config.corr_reject:
+                hit = (earlier, float(rho))
+                break
+        if hit:
+            rejected[col] = hit
+        else:
+            kept.append(col)
+    return rejected
+
+
 def rejected_variables(stats: Dict[str, Any],
                        threshold: Optional[float] = None) -> List[str]:
     """Reference: ProfileReport.get_rejected_variables(corr_threshold) scans
