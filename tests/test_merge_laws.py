@@ -1,0 +1,144 @@
+"""Merge-law property tests (SURVEY §4.2): every sketch state must be a
+commutative monoid — ``merge(s(A), s(B)) == s(A ∪ B)`` within bounds —
+because that is exactly what makes the cross-device tree-reduce correct.
+Randomized over adversarial distributions (uniform/zipf/constant/all-null/
+±inf/NaN mixtures)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuprof.kernels import corr, hll, moments, quantiles
+
+DISTS = ["normal", "lognormal", "constant", "allnan", "infmix", "bigmean"]
+
+
+def _draw(rng, dist, n, c):
+    if dist == "normal":
+        return rng.normal(0, 1, (n, c))
+    if dist == "lognormal":
+        return rng.lognormal(1, 1.5, (n, c))
+    if dist == "constant":
+        return np.full((n, c), 3.25)
+    if dist == "allnan":
+        return np.full((n, c), np.nan)
+    if dist == "infmix":
+        x = rng.normal(0, 1, (n, c))
+        x[rng.random((n, c)) < 0.1] = np.inf
+        x[rng.random((n, c)) < 0.1] = -np.inf
+        x[rng.random((n, c)) < 0.1] = np.nan
+        return x
+    if dist == "bigmean":
+        return rng.normal(1e5, 1.0, (n, c))
+    raise AssertionError(dist)
+
+
+def _mom_state(x):
+    s = moments.init(x.shape[1])
+    return jax.jit(moments.update)(
+        s, jnp.asarray(x, dtype=jnp.float32),
+        jnp.ones(x.shape[0], dtype=bool))
+
+
+def _corr_state(x):
+    s = corr.init(x.shape[1])
+    return jax.jit(corr.update)(
+        s, jnp.asarray(x, dtype=jnp.float32),
+        jnp.ones(x.shape[0], dtype=bool))
+
+
+@pytest.mark.parametrize("dist", DISTS)
+def test_moments_merge_law(dist):
+    rng = np.random.default_rng(hash(dist) % 2**31)
+    a = _draw(rng, dist, 400, 3)
+    b = _draw(rng, dist, 700, 3)
+    merged = moments.finalize(jax.device_get(
+        jax.jit(moments.merge)(_mom_state(a), _mom_state(b))))
+    direct = moments.finalize(jax.device_get(_mom_state(np.vstack([a, b]))))
+    for fld in ("n", "n_zeros", "n_inf", "n_missing"):
+        np.testing.assert_array_equal(merged[fld], direct[fld], err_msg=fld)
+    for fld in ("min", "max", "fmin", "fmax"):
+        np.testing.assert_array_equal(merged[fld], direct[fld], err_msg=fld)
+    for fld in ("mean", "variance", "skewness", "kurtosis", "sum", "cv"):
+        np.testing.assert_allclose(merged[fld], direct[fld], rtol=1e-3,
+                                   atol=1e-3, equal_nan=True, err_msg=fld)
+
+
+@pytest.mark.parametrize("dist", ["normal", "bigmean", "infmix"])
+def test_moments_merge_commutes(dist):
+    rng = np.random.default_rng(7)
+    a, b = _draw(rng, dist, 300, 2), _draw(rng, dist, 500, 2)
+    ab = moments.finalize(jax.device_get(
+        jax.jit(moments.merge)(_mom_state(a), _mom_state(b))))
+    ba = moments.finalize(jax.device_get(
+        jax.jit(moments.merge)(_mom_state(b), _mom_state(a))))
+    for fld in ("mean", "variance", "sum"):
+        np.testing.assert_allclose(ab[fld], ba[fld], rtol=1e-4, atol=1e-4,
+                                   equal_nan=True, err_msg=fld)
+
+
+def test_moments_identity():
+    rng = np.random.default_rng(8)
+    a = _draw(rng, "normal", 256, 2)
+    s = _mom_state(a)
+    with_id = jax.jit(moments.merge)(s, moments.init(2))
+    np.testing.assert_allclose(
+        moments.finalize(jax.device_get(with_id))["mean"],
+        moments.finalize(jax.device_get(s))["mean"], rtol=1e-6)
+
+
+@pytest.mark.parametrize("dist", ["normal", "bigmean", "infmix"])
+def test_corr_merge_law(dist):
+    rng = np.random.default_rng(hash(dist) % 2**31)
+    a = _draw(rng, dist, 400, 3)
+    b = _draw(rng, dist, 600, 3)
+    merged = corr.finalize(jax.device_get(
+        jax.jit(corr.merge)(_corr_state(a), _corr_state(b))))
+    direct = corr.finalize(jax.device_get(_corr_state(np.vstack([a, b]))))
+    np.testing.assert_allclose(merged, direct, atol=5e-3, equal_nan=True)
+
+
+def test_quantile_sketch_merge_is_topk_sample():
+    """The merged sketch must equal the sketch of the union stream: keep
+    the global top-K priorities."""
+    rng = np.random.default_rng(9)
+    xa, xb = rng.normal(0, 1, (500, 2)), rng.normal(5, 1, (300, 2))
+    k = 64
+
+    def sk(x, key):
+        return jax.jit(quantiles.update)(
+            quantiles.init(2, k), jnp.asarray(x, dtype=jnp.float32),
+            jnp.ones(x.shape[0], dtype=bool), jax.random.key(key))
+
+    sa, sb = sk(xa, 1), sk(xb, 2)
+    merged = jax.device_get(jax.jit(quantiles.merge)(sa, sb))
+    cat_p = np.concatenate([np.asarray(sa["prio"]), np.asarray(sb["prio"])],
+                           axis=1)
+    cat_v = np.concatenate([np.asarray(sa["values"]), np.asarray(sb["values"])],
+                           axis=1)
+    for c in range(2):
+        order = np.argsort(-cat_p[c], kind="stable")[:k]
+        np.testing.assert_allclose(np.sort(merged["values"][c]),
+                                   np.sort(cat_v[c][order]))
+
+
+def test_hll_merge_law_exact():
+    """HLL registers: merge == max, so the merged estimate must equal the
+    union-stream estimate EXACTLY (not just within bounds)."""
+    import pandas as pd
+    rng = np.random.default_rng(10)
+    va = rng.integers(0, 5000, 4000)
+    vb = rng.integers(2500, 8000, 4000)
+
+    def regs(vals):
+        h = pd.util.hash_array(vals).astype(np.uint64)
+        ha = (h >> np.uint64(32)).astype(np.uint32)[:, None]
+        hb_ = h.astype(np.uint32)[:, None]
+        return jax.jit(hll.update, static_argnames="precision")(
+            hll.init(1, 10), jnp.asarray(ha), jnp.asarray(hb_),
+            jnp.ones((len(vals), 1), dtype=bool), precision=10)
+
+    merged = jax.jit(hll.merge)(regs(va), regs(vb))
+    direct = regs(np.concatenate([va, vb]))
+    np.testing.assert_array_equal(np.asarray(merged), np.asarray(direct))

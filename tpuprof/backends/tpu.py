@@ -46,6 +46,7 @@ from tpuprof.kernels import quantiles as kquantiles
 from tpuprof.kernels import histogram as khistogram
 from tpuprof.kernels.topk import MisraGries
 from tpuprof.runtime.mesh import MeshRunner
+from tpuprof.utils.trace import log_event, phase_timer
 
 
 class HostAgg:
@@ -136,11 +137,15 @@ class TPUStatsBackend:
 
         hostagg = HostAgg(plan, config)
         state = runner.init_pass_a()
-        for step_idx, rb in enumerate(ingest.raw_batches()):
-            hb = prepare_batch(rb, plan, pad)
-            state = runner.step_a(state, hb, step_idx)
-            hostagg.update(hb)
-        res_a = runner.finalize_a(state)
+        with phase_timer("scan_a"):
+            for step_idx, rb in enumerate(ingest.raw_batches()):
+                hb = prepare_batch(rb, plan, pad)
+                state = runner.step_a(state, hb, step_idx)
+                hostagg.update(hb)
+        with phase_timer("merge"):
+            res_a = runner.finalize_a(state)
+        log_event("pass_a", rows=hostagg.n_rows, devices=runner.n_dev,
+                  n_num=plan.n_num, n_hash=plan.n_hash)
 
         momf = kmoments.finalize(res_a["mom"])
         rho_all = kcorr.finalize(res_a["corr"])
@@ -162,11 +167,12 @@ class TPUStatsBackend:
             lo = np.where(np.isfinite(lo), lo, 0.0)
             hi = np.where(np.isfinite(hi), hi, 0.0)
             mean_c = np.where(np.isfinite(mean), mean, 0.0)
-            for rb in ingest.raw_batches():
-                hb = prepare_batch(rb, plan, pad)
-                state_b = runner.step_b(state_b, hb, lo, hi, mean_c)
-                recounter.update(hb)
-            res_b = runner.finalize_b(state_b)
+            with phase_timer("scan_b"):
+                for rb in ingest.raw_batches():
+                    hb = prepare_batch(rb, plan, pad)
+                    state_b = runner.step_b(state_b, hb, lo, hi, mean_c)
+                    recounter.update(hb)
+                res_b = runner.finalize_b(state_b)
             hists, mad = khistogram.finalize(
                 res_b, momf["fmin"], momf["fmax"], momf["n"], config.bins)
         elif config.exact_passes and ingest.rescannable and hostagg.n_rows > 0:
