@@ -1,0 +1,99 @@
+"""Ingestion-layer tests: batching/padding shapes, hash stability across
+batches, and the per-fragment retry path (SURVEY §5 failure detection)."""
+
+import types
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from tpuprof.ingest.arrow import ArrowIngest, ColumnPlan, prepare_batch
+
+
+def _table(n=100):
+    rng = np.random.default_rng(0)
+    return pa.Table.from_pandas(pd.DataFrame({
+        "x": rng.normal(size=n),
+        "s": rng.choice(["u", "v", "w"], n),
+        "t": pd.Timestamp("2020-01-01")
+             + pd.to_timedelta(rng.integers(0, 1000, n), unit="s"),
+    }), preserve_index=False)
+
+
+def test_plan_roles():
+    plan = ColumnPlan.from_schema(_table().schema)
+    roles = {s.name: s.role for s in plan.specs}
+    assert roles == {"x": "num", "s": "cat", "t": "date"}
+    assert plan.n_num == 1 and plan.n_hash == 3
+
+
+def test_batch_shapes_and_padding():
+    ingest = ArrowIngest(_table(100), batch_rows=64)
+    batches = list(ingest.batches())
+    assert [b.nrows for b in batches] == [64, 36]
+    hb = batches[1]
+    assert hb.x.shape == (64, 1) and hb.hash_a.shape == (64, 3)
+    assert hb.row_valid.sum() == 36
+    assert not hb.hvalid[36:].any()          # padding rows invalid
+    assert np.isnan(hb.x[36:, 0]).all()
+
+
+def test_hash_stability_across_batching():
+    """The same value must hash identically regardless of which batch (or
+    dictionary) it arrives in — HLL correctness depends on it."""
+    t = _table(100)
+    one = list(ArrowIngest(t, batch_rows=100).batches())[0]
+    many = list(ArrowIngest(t, batch_rows=17).batches())
+    lane = 1  # "s"
+    got = np.concatenate([b.hash_a[: b.nrows, lane] for b in many])
+    np.testing.assert_array_equal(one.hash_a[:100, lane], got)
+
+
+def test_fragment_retry_resumes_without_duplicates():
+    table = _table(90)
+
+    class FlakyFragment:
+        def __init__(self):
+            self.calls = 0
+
+        def to_batches(self, batch_size):
+            self.calls += 1
+            batches = table.to_batches(max_chunksize=30)
+            if self.calls == 1:
+                yield batches[0]
+                raise OSError("transient read failure")
+            yield from batches
+
+    def scanner_batches(batch_size):
+        # scanner delivers one batch then dies -> fallback path takes over
+        yield table.to_batches(max_chunksize=30)[0]
+        raise OSError("scanner failure")
+
+    ingest = ArrowIngest(table, batch_rows=30)
+    frag = FlakyFragment()
+    ingest._table = None
+    ingest._dataset = types.SimpleNamespace(
+        to_batches=scanner_batches,
+        get_fragments=lambda: [frag], schema=table.schema)
+    rows = sum(rb.num_rows for rb in ingest.raw_batches())
+    assert rows == 90 and frag.calls == 2    # no duplicates, one retry
+
+
+def test_fragment_retry_exhaustion_raises():
+    class DeadFragment:
+        def to_batches(self, batch_size):
+            raise OSError("gone")
+            yield  # pragma: no cover
+
+    def dead_scanner(batch_size):
+        raise OSError("gone")
+        yield  # pragma: no cover
+
+    ingest = ArrowIngest(_table(10), batch_rows=10, max_retries=1)
+    ingest._table = None
+    ingest._dataset = types.SimpleNamespace(
+        to_batches=dead_scanner,
+        get_fragments=lambda: [DeadFragment()], schema=_table(1).schema)
+    with pytest.raises(OSError):
+        list(ingest.raw_batches())

@@ -1,0 +1,114 @@
+"""Streaming + checkpoint/resume tests (SURVEY §5, §7.1 stage 6):
+running-merge correctness vs the batch oracle, snapshot-while-streaming,
+and restore-equals-uninterrupted."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfilerConfig, schema
+from tpuprof.backends.cpu import CPUStatsBackend
+from tpuprof.runtime.stream import StreamingProfiler
+
+
+def _cfg(**kw):
+    kw.setdefault("batch_rows", 256)
+    return ProfilerConfig(**kw)
+
+
+def _micro_batches(n_batches=8, rows=250, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n_batches):
+        out.append(pd.DataFrame({
+            "x": rng.normal(100.0, 5.0, rows),
+            "y": rng.exponential(2.0, rows),
+            "cat": rng.choice(["a", "b", "c", "d"], rows),
+        }))
+    return out
+
+
+def test_running_profile_matches_batch_oracle():
+    batches = _micro_batches()
+    prof = StreamingProfiler.for_example(batches[0], config=_cfg())
+    for b in batches:
+        prof.update(b)
+    stats = prof.stats()
+    assert schema.validate_stats(stats) == []
+
+    full = pd.concat(batches, ignore_index=True)
+    oracle = CPUStatsBackend().collect(full, _cfg(backend="cpu"))
+    assert stats["table"]["n"] == len(full)
+    for col in ("x", "y"):
+        sv, ov = stats["variables"][col], oracle["variables"][col]
+        assert sv["type"] == schema.NUM
+        assert sv["count"] == ov["count"]
+        assert sv["mean"] == pytest.approx(ov["mean"], rel=1e-4)
+        assert sv["std"] == pytest.approx(ov["std"], rel=1e-3)
+        assert sv["min"] == pytest.approx(ov["min"], rel=1e-6)
+        # n (2000) <= K (4096): sample quantiles exact
+        assert sv["p50"] == pytest.approx(ov["p50"], rel=1e-4)
+    sc = stats["variables"]["cat"]
+    oc = oracle["variables"]["cat"]
+    assert sc["distinct_count"] == 4
+    assert sc["freq"] == oc["freq"]          # MG exact under capacity
+    assert sc["mode"] == oc["mode"]
+
+
+def test_snapshot_mid_stream_then_continue():
+    batches = _micro_batches()
+    prof = StreamingProfiler.for_example(batches[0], config=_cfg())
+    for b in batches[:3]:
+        prof.update(b)
+    mid = prof.stats()
+    assert mid["table"]["n"] == 750
+    for b in batches[3:]:
+        prof.update(b)
+    final = prof.stats()
+    assert final["table"]["n"] == 2000
+    html = prof.report_html()
+    assert "Overview" in html
+
+
+def test_checkpoint_restore_equals_uninterrupted(tmp_path):
+    batches = _micro_batches(seed=3)
+    path = str(tmp_path / "profile.ckpt")
+
+    # interrupted run: 4 batches, checkpoint, "crash", restore, 4 more
+    prof = StreamingProfiler.for_example(batches[0], config=_cfg())
+    for b in batches[:4]:
+        prof.update(b)
+    prof.checkpoint(path)
+    del prof
+    restored = StreamingProfiler.restore(path, config=_cfg())
+    assert restored.cursor == 4
+    for b in batches[4:]:
+        restored.update(b)
+    s_resumed = restored.stats()
+
+    # uninterrupted control run
+    control = StreamingProfiler.for_example(batches[0], config=_cfg())
+    for b in batches:
+        control.update(b)
+    s_control = control.stats()
+
+    assert s_resumed["table"]["n"] == s_control["table"]["n"] == 2000
+    for col in ("x", "y"):
+        rv, cv = s_resumed["variables"][col], s_control["variables"][col]
+        for fld in ("count", "n_missing"):
+            assert rv[fld] == cv[fld]
+        for fld in ("mean", "std", "min", "max", "p50"):
+            assert rv[fld] == pytest.approx(cv[fld], rel=1e-6), (col, fld)
+    assert (s_resumed["variables"]["cat"]["freq"]
+            == s_control["variables"]["cat"]["freq"])
+
+
+def test_checkpoint_shape_mismatch_rejected(tmp_path):
+    batches = _micro_batches()
+    prof = StreamingProfiler.for_example(batches[0], config=_cfg())
+    prof.update(batches[0])
+    path = str(tmp_path / "p.ckpt")
+    prof.checkpoint(path)
+    with pytest.raises(ValueError, match="shape|mismatch"):
+        StreamingProfiler.restore(
+            path, config=_cfg(quantile_sketch_size=128))

@@ -181,9 +181,9 @@ class TPUStatsBackend:
             for hb in ingest.batches():
                 recounter.update(hb)
 
-        return _assemble(plan, config, ingest, hostagg, momf, rho_all,
-                         quants, sample_vals, sample_kept, hll_est,
-                         hists, mad, recounter, probes)
+        return _assemble(plan, config, ingest.sample(config.sample_rows),
+                         hostagg, momf, rho_all, quants, sample_vals,
+                         sample_kept, hll_est, hists, mad, recounter, probes)
 
 
 # ---------------------------------------------------------------------------
@@ -200,7 +200,7 @@ def _sample_mode(values: np.ndarray, kept: np.ndarray) -> float:
     return float(uniq[np.argmax(cnt)])
 
 
-def _assemble(plan, config, ingest, hostagg, momf, rho_all, quants,
+def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
               sample_vals, sample_kept, hll_est, hists, mad, recounter,
               probes) -> Dict[str, Any]:
     n = hostagg.n_rows
@@ -314,7 +314,7 @@ def _assemble(plan, config, ingest, hostagg, momf, rho_all, quants,
         "freq": freq,
         "correlations": {"pearson": corr_df},
         "messages": messages,
-        "sample": ingest.sample(config.sample_rows),
+        "sample": sample_df,
     }
 
 

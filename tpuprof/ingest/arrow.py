@@ -182,8 +182,9 @@ class ArrowIngest:
     or a path to a Parquet file/directory (streamed fragment-by-fragment,
     never materialized — SURVEY §7.2 '1B×200 memory')."""
 
-    def __init__(self, source: Any, batch_rows: int):
+    def __init__(self, source: Any, batch_rows: int, max_retries: int = 2):
         self.batch_rows = int(batch_rows)
+        self.max_retries = int(max_retries)
         self._table: Optional[pa.Table] = None
         self._dataset: Optional[pads.Dataset] = None
         if isinstance(source, pd.DataFrame):
@@ -208,8 +209,37 @@ class ArrowIngest:
     def raw_batches(self) -> Iterator[pa.RecordBatch]:
         if self._table is not None:
             yield from self._table.to_batches(max_chunksize=self.batch_rows)
-        else:
-            yield from self._dataset.to_batches(batch_size=self.batch_rows)
+            return
+        # Happy path: the dataset Scanner (multithreaded cross-fragment
+        # readahead).  Only after the first IO error do we drop to
+        # fragment-granular iteration with retry, skipping batches already
+        # delivered (SURVEY §5 'failure detection' — the Spark-task-retry
+        # analogue; batch boundaries are deterministic for a fixed
+        # batch_size so the skip is duplicate-free).
+        delivered = 0
+        try:
+            for rb in self._dataset.to_batches(batch_size=self.batch_rows):
+                yield rb
+                delivered += 1
+            return
+        except OSError:
+            pass  # fall through to the resilient path
+        seen = 0
+        for fragment in self._dataset.get_fragments():
+            frag_start = seen
+            for attempt in range(self.max_retries + 1):
+                try:
+                    seen = frag_start
+                    for rb in fragment.to_batches(batch_size=self.batch_rows):
+                        seen += 1
+                        if seen <= delivered:
+                            continue        # already yielded pre-failure
+                        yield rb
+                        delivered = seen
+                    break
+                except OSError:
+                    if attempt == self.max_retries:
+                        raise
 
     def batches(self) -> Iterator[HostBatch]:
         for rb in self.raw_batches():

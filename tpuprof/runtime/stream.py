@@ -1,0 +1,171 @@
+"""Streaming micro-batch profiling (BASELINE.json config 5: Kafka→Arrow
+micro-batches with a running sketch merge).
+
+The reference cannot do this at all — ``ProfileReport`` is one-shot over
+a static DataFrame.  Because every tpuprof statistic lives in a
+fixed-shape mergeable state, a profile can instead be *maintained*: feed
+micro-batches as they arrive, snapshot the stats dict (or the full HTML
+report) at any moment, checkpoint/restore across process restarts
+(SURVEY.md §5 'Checkpoint / resume').
+
+Single-pass accuracy: exact moments/min-max/zeros/inf/bool/date stats,
+sketch-bounded quantiles/distincts, Misra-Gries top-k (error ≤ n/capacity),
+sample-derived histograms — the documented exact_passes=False tier.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from tpuprof.config import ProfilerConfig
+from tpuprof.ingest.arrow import ColumnPlan, prepare_batch
+from tpuprof.kernels import corr as kcorr
+from tpuprof.kernels import hll as khll
+from tpuprof.kernels import moments as kmoments
+from tpuprof.kernels import quantiles as kquantiles
+from tpuprof.runtime import checkpoint as ckpt
+from tpuprof.runtime.mesh import MeshRunner
+from tpuprof.utils.trace import log_event
+
+
+def _to_record_batches(batch: Any, schema: Optional[pa.Schema]):
+    if isinstance(batch, pd.DataFrame):
+        got = list(batch.columns)
+        expected = schema.names if schema is not None else got
+        if got != list(expected):
+            raise ValueError(
+                f"micro-batch columns {got} do not match the stream schema "
+                f"{list(expected)} — column sets must be stable over a "
+                f"stream (sketch lanes are fixed shapes)")
+        table = pa.Table.from_pandas(batch, preserve_index=False, schema=schema)
+        return table.to_batches()
+    if isinstance(batch, (pa.Table, pa.RecordBatch)):
+        if schema is not None and (batch.schema.names != schema.names
+                                   or batch.schema.types != schema.types):
+            # validate names AND types up front: a cast failure halfway
+            # through folding would leave the running state partially
+            # updated with no rollback
+            raise ValueError(
+                f"micro-batch schema {batch.schema} does not match the "
+                f"stream schema {schema}")
+        return batch.to_batches() if isinstance(batch, pa.Table) else [batch]
+    raise TypeError(f"cannot stream {type(batch)!r}")
+
+
+class StreamingProfiler:
+    """A live, mergeable profile over an unbounded stream.
+
+    >>> prof = StreamingProfiler(arrow_schema, config)
+    >>> for micro_batch in kafka_arrow_stream():
+    ...     prof.update(micro_batch)
+    >>> html = prof.report_html()
+    """
+
+    def __init__(self, arrow_schema: pa.Schema,
+                 config: Optional[ProfilerConfig] = None,
+                 devices: Optional[Sequence] = None):
+        import dataclasses
+        self.config = dataclasses.replace(    # streaming is single-pass
+            config or ProfilerConfig(), exact_passes=False)
+        self.arrow_schema = arrow_schema
+        self.plan = ColumnPlan.from_schema(arrow_schema)
+        self.runner = MeshRunner(self.config, self.plan.n_num,
+                                 self.plan.n_hash, devices=devices)
+        from tpuprof.backends.tpu import HostAgg
+        self.hostagg = HostAgg(self.plan, self.config)
+        self.state = self.runner.init_pass_a()
+        self.cursor = 0                      # micro-batches folded in
+        self._sample: Optional[pd.DataFrame] = None
+
+    @classmethod
+    def for_example(cls, example: Any, **kwargs) -> "StreamingProfiler":
+        """Infer the Arrow schema from an example batch/frame."""
+        if isinstance(example, pd.DataFrame):
+            # infer from the FULL example: head(1) would type an
+            # all-null-leading column as Arrow null and poison the stream
+            schema = pa.Table.from_pandas(
+                example, preserve_index=False).schema
+        elif isinstance(example, (pa.Table, pa.RecordBatch)):
+            schema = example.schema
+        else:
+            raise TypeError(f"cannot infer schema from {type(example)!r}")
+        return cls(schema, **kwargs)
+
+    # -- ingestion ---------------------------------------------------------
+
+    def update(self, batch: Any) -> None:
+        """Fold one micro-batch (pandas DataFrame / Arrow Table or
+        RecordBatch) into the running profile."""
+        for rb in _to_record_batches(batch, self.arrow_schema):
+            if self._sample is None or len(self._sample) < \
+                    self.config.sample_rows:
+                head = pa.Table.from_batches([rb]).to_pandas().head(
+                    self.config.sample_rows)
+                self._sample = head if self._sample is None else pd.concat(
+                    [self._sample, head], ignore_index=True).head(
+                        self.config.sample_rows)
+            # micro-batches larger than the device batch are chunked
+            for start in range(0, rb.num_rows, self.runner.rows):
+                chunk = rb.slice(start, self.runner.rows)
+                hb = prepare_batch(chunk, self.plan, self.runner.rows)
+                self.state = self.runner.step_a(self.state, hb, self.cursor)
+                self.hostagg.update(hb)
+                self.cursor += 1
+        log_event("stream_update", cursor=self.cursor,
+                  rows=self.hostagg.n_rows)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Snapshot the stats dict (non-destructive; streaming continues)."""
+        from tpuprof.backends.tpu import _assemble, _empty_stats
+        if not self.plan.specs:
+            return _empty_stats(self.config)
+        res = self.runner.finalize_a(self.state)
+        momf = kmoments.finalize(res["mom"])
+        probes = list(self.config.quantile_probes)
+        return _assemble(
+            self.plan, self.config,
+            self._sample if self._sample is not None else pd.DataFrame(),
+            self.hostagg, momf, kcorr.finalize(res["corr"]),
+            kquantiles.finalize(res["qs"], probes),
+            np.asarray(res["qs"]["values"], dtype=np.float64),
+            np.asarray(res["qs"]["prio"]) > -np.inf,
+            khll.finalize(res["hll"]), None, None, None, probes)
+
+    def report_html(self) -> str:
+        from tpuprof.report.render import to_standalone_html
+        return to_standalone_html(self.stats(), self.config)
+
+    # -- durability --------------------------------------------------------
+
+    def checkpoint(self, path: str) -> None:
+        """Persist (device state, host aggregators, cursor) atomically."""
+        host_blob = {
+            "hostagg": self.hostagg,
+            "sample": self._sample,
+            "schema": self.arrow_schema.serialize().to_pybytes(),
+        }
+        ckpt.save(path, self.state, host_blob, self.cursor,
+                  meta={"n_num": self.plan.n_num, "n_hash": self.plan.n_hash,
+                        "batch_rows": self.config.batch_rows})
+
+    @classmethod
+    def restore(cls, path: str, config: Optional[ProfilerConfig] = None,
+                devices: Optional[Sequence] = None) -> "StreamingProfiler":
+        """Rebuild a profiler from a checkpoint and continue streaming."""
+        payload = ckpt.load_payload(path)
+        host_blob = payload["host_blob"]
+        arrow_schema = pa.ipc.read_schema(pa.py_buffer(host_blob["schema"]))
+        prof = cls(arrow_schema, config=config, devices=devices)
+        # leave leaves as host numpy (uncommitted): the first sharded step
+        # places them onto the mesh exactly like freshly-init'd state
+        prof.state = ckpt.materialize(payload, prof.state)
+        prof.hostagg = host_blob["hostagg"]
+        prof._sample = host_blob["sample"]
+        prof.cursor = payload["cursor"]
+        return prof
