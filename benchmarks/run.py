@@ -1,0 +1,165 @@
+"""Benchmark harness: ``python benchmarks/run.py <scenario> [--scale S]``.
+
+Scenarios map 1:1 to BASELINE.json's configs (see scenarios.py).  Each
+prints a JSON line with rows/sec and wall-clock; ``--scale`` shrinks the
+nominal row counts (default 0.01 — a smoke-sized run; use 1.0 for the
+full-size soak on real hardware).
+
+taxi/tpch/criteo write a Parquet fixture once (cached in --workdir) and
+profile it end-to-end through ProfileReport (both scans + render).
+wide1b streams in-memory batches through the fused pass-A step (the
+scan-throughput number bench.py also reports).  streaming feeds 10k-row
+micro-batches through StreamingProfiler with periodic snapshots.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fixture_path(workdir: str, name: str, rows: int) -> str:
+    os.makedirs(workdir, exist_ok=True)
+    return os.path.join(workdir, f"{name}_{rows}.parquet")
+
+
+def _ensure_fixture(name: str, rows: int, workdir: str) -> str:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from benchmarks import scenarios
+
+    path = _fixture_path(workdir, name, rows)
+    if os.path.exists(path):
+        return path
+    gen, _ = scenarios.GENERATORS[name]
+    rng = np.random.default_rng(0)
+    writer = None
+    chunk = 1 << 18
+    written = 0
+    while written < rows:
+        df = gen(rng, min(chunk, rows - written))
+        table = pa.Table.from_pandas(df, preserve_index=False)
+        if writer is None:
+            writer = pq.ParquetWriter(path, table.schema)
+        writer.write_table(table)
+        written += len(df)
+    writer.close()
+    return path
+
+
+def run_table_scenario(name: str, scale: float, workdir: str,
+                       backend: str) -> dict:
+    from tpuprof import ProfileReport, ProfilerConfig
+
+    from benchmarks import scenarios
+
+    _, nominal = scenarios.GENERATORS[name]
+    rows = max(int(nominal * scale), 10_000)
+    path = _ensure_fixture(name, rows, workdir)
+    t0 = time.perf_counter()
+    report = ProfileReport(path, config=ProfilerConfig(backend=backend))
+    out = os.path.join(workdir, f"{name}_report.html")
+    report.to_file(out)
+    elapsed = time.perf_counter() - t0
+    n = report.description["table"]["n"]
+    return {"scenario": name, "rows": n,
+            "cols": report.description["table"]["nvar"],
+            "seconds": round(elapsed, 3),
+            "rows_per_sec": round(n / elapsed, 1)}
+
+
+def run_wide1b(scale: float, workdir: str, backend: str) -> dict:
+    import jax
+
+    from benchmarks import scenarios
+    from tpuprof.config import ProfilerConfig
+    from tpuprof.ingest.arrow import HostBatch
+    from tpuprof.runtime.mesh import MeshRunner
+
+    total_rows = max(int(1e9 * scale), 1 << 18)
+    config = ProfilerConfig(batch_rows=1 << 16)
+    runner = MeshRunner(config, n_num=200, n_hash=0)
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(4):
+        hb = HostBatch(
+            nrows=runner.rows,
+            x=scenarios.wide_batch(rng, runner.rows),
+            row_valid=np.ones(runner.rows, dtype=bool),
+            hash_a=np.zeros((runner.rows, 0), dtype=np.uint32),
+            hash_b=np.zeros((runner.rows, 0), dtype=np.uint32),
+            hvalid=np.zeros((runner.rows, 0), dtype=bool),
+            cat_codes={}, date_ints={})
+        batches.append(hb)
+    state = runner.init_pass_a()
+    state = runner.step_a(state, batches[0], 0)       # compile
+    jax.block_until_ready(state)
+    steps = max(total_rows // runner.rows, 4)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state = runner.step_a(state, batches[i % 4], i + 1)
+    runner.finalize_a(state)
+    elapsed = time.perf_counter() - t0
+    rows = steps * runner.rows
+    return {"scenario": "wide1b", "rows": rows, "cols": 200,
+            "seconds": round(elapsed, 3),
+            "rows_per_sec": round(rows / elapsed, 1),
+            "devices": runner.n_dev}
+
+
+def run_streaming(scale: float, workdir: str, backend: str) -> dict:
+    from benchmarks import scenarios
+    from tpuprof.config import ProfilerConfig
+    from tpuprof.runtime.stream import StreamingProfiler
+
+    micro = 10_000                                   # BASELINE config 5
+    n_batches = max(int(1000 * scale), 10)
+    rng = np.random.default_rng(0)
+    example = scenarios.taxi_batch(rng, 64)
+    prof = StreamingProfiler.for_example(
+        example, config=ProfilerConfig(batch_rows=micro))
+    t0 = time.perf_counter()
+    for i in range(n_batches):
+        prof.update(scenarios.taxi_batch(rng, micro))
+        if (i + 1) % 100 == 0:
+            prof.stats()                              # periodic snapshot
+    stats = prof.stats()
+    elapsed = time.perf_counter() - t0
+    rows = stats["table"]["n"]
+    return {"scenario": "streaming", "rows": rows,
+            "micro_batch": micro, "seconds": round(elapsed, 3),
+            "rows_per_sec": round(rows / elapsed, 1)}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("scenario", choices=["taxi", "tpch", "criteo",
+                                             "wide1b", "streaming", "all"])
+    parser.add_argument("--scale", type=float, default=0.01)
+    parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
+    parser.add_argument("--backend", default="tpu")
+    args = parser.parse_args()
+
+    names = (["taxi", "tpch", "criteo", "wide1b", "streaming"]
+             if args.scenario == "all" else [args.scenario])
+    for name in names:
+        if name in ("taxi", "tpch", "criteo"):
+            result = run_table_scenario(name, args.scale, args.workdir,
+                                        args.backend)
+        elif name == "wide1b":
+            result = run_wide1b(args.scale, args.workdir, args.backend)
+        else:
+            result = run_streaming(args.scale, args.workdir, args.backend)
+        print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

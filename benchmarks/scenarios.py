@@ -1,0 +1,97 @@
+"""The five BASELINE.json benchmark scenarios (SURVEY.md §6).
+
+The real datasets (NYC Yellow Taxi 2019-01, TPC-H SF100 lineitem, Criteo
+day-0) are not downloadable in a zero-egress environment, so each
+scenario generates a synthetic stand-in with the same shape, dtype mix,
+and distribution character, clearly labeled as such.  Scale factors let
+the same script run as a seconds-long smoke or a full-size soak.
+
+Scenario -> BASELINE.json config mapping:
+  taxi      -> "NYC Yellow Taxi 2019-01 (~7M rows, 18 cols), CPU ref"
+  tpch      -> "TPC-H SF100 lineitem (600M rows) numeric moments+quantiles"
+  criteo    -> "Criteo day-0 (45M rows, 39 cols) mixed int/cat with HLL"
+  wide1b    -> "Synthetic 1Bx200 float32 — fused moments+KLL+Pearson"
+  streaming -> "Kafka→Arrow 10k-row micro-batches, running KLL/HLL merge"
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+def taxi_batch(rng: np.random.Generator, rows: int) -> pd.DataFrame:
+    """18 mixed columns shaped like the yellow-taxi trip records."""
+    pickup = pd.Timestamp("2019-01-01") + pd.to_timedelta(
+        rng.integers(0, 31 * 86400, rows), unit="s")
+    trip_secs = rng.gamma(2.0, 420.0, rows)
+    distance = rng.exponential(2.9, rows)
+    fare = 2.5 + distance * 2.5 + rng.normal(0, 1.5, rows)
+    tip = np.where(rng.random(rows) < 0.6, fare * 0.2, 0.0)
+    return pd.DataFrame({
+        "vendor_id": rng.choice(["CMT", "VTS"], rows),
+        "pickup_datetime": pickup,
+        "dropoff_datetime": pickup + pd.to_timedelta(trip_secs, unit="s"),
+        "passenger_count": rng.integers(1, 7, rows).astype(np.int8),
+        "trip_distance": distance.astype(np.float32),
+        "rate_code": rng.choice([1, 2, 3, 4, 5, 99], rows,
+                                p=[.9, .04, .02, .02, .01, .01]).astype(np.int8),
+        "store_and_fwd_flag": rng.random(rows) < 0.01,
+        "pu_location": rng.integers(1, 266, rows).astype(np.int16),
+        "do_location": rng.integers(1, 266, rows).astype(np.int16),
+        "payment_type": rng.choice(["card", "cash", "no charge", "dispute"],
+                                   rows, p=[.7, .28, .01, .01]),
+        "fare_amount": fare.astype(np.float32),
+        "extra": rng.choice([0.0, 0.5, 1.0], rows).astype(np.float32),
+        "mta_tax": np.full(rows, 0.5, dtype=np.float32),
+        "tip_amount": tip.astype(np.float32),
+        "tolls_amount": np.where(rng.random(rows) < 0.05, 5.76, 0.0
+                                 ).astype(np.float32),
+        "improvement_surcharge": np.full(rows, 0.3, dtype=np.float32),
+        "total_amount": (fare + tip + 0.8).astype(np.float32),
+        "congestion_surcharge": np.where(pickup.month == 1, 2.5, 0.0
+                                         ).astype(np.float32),
+    })
+
+
+def tpch_lineitem_batch(rng: np.random.Generator, rows: int) -> pd.DataFrame:
+    """Numeric-only slice of lineitem: moments+quantiles workload."""
+    qty = rng.integers(1, 51, rows).astype(np.float32)
+    price = (qty * rng.uniform(900, 105000 / 50, rows)).astype(np.float32)
+    return pd.DataFrame({
+        "l_quantity": qty,
+        "l_extendedprice": price,
+        "l_discount": rng.integers(0, 11, rows).astype(np.float32) / 100,
+        "l_tax": rng.integers(0, 9, rows).astype(np.float32) / 100,
+        "l_orderkey": rng.integers(1, 6_000_000, rows),
+        "l_partkey": rng.integers(1, 200_000, rows),
+        "l_suppkey": rng.integers(1, 10_000, rows),
+    })
+
+
+def criteo_batch(rng: np.random.Generator, rows: int) -> pd.DataFrame:
+    """39 columns: 1 label + 13 ints (heavy-tailed, nullable) + 25 hashed
+    categoricals (string, high cardinality — the HLL workload)."""
+    data = {"label": (rng.random(rows) < 0.03).astype(np.int8)}
+    for i in range(13):
+        v = rng.zipf(1.7, rows).astype(np.float32)
+        v[rng.random(rows) < 0.3] = np.nan           # Criteo-style missing
+        data[f"i{i:02d}"] = v
+    for i in range(25):
+        card = [100, 1000, 10_000, 100_000][i % 4]
+        codes = rng.zipf(1.3, rows) % card
+        data[f"c{i:02d}"] = np.char.add("v", codes.astype(str))
+    return pd.DataFrame(data)
+
+
+def wide_batch(rng: np.random.Generator, rows: int,
+               cols: int = 200) -> np.ndarray:
+    """1B×200 float32 scan workload (in-memory batches; never a file)."""
+    return rng.normal(50.0, 10.0, (rows, cols)).astype(np.float32)
+
+
+GENERATORS = {
+    "taxi": (taxi_batch, 7_000_000),
+    "tpch": (tpch_lineitem_batch, 600_000_000),
+    "criteo": (criteo_batch, 45_000_000),
+}
