@@ -1,0 +1,84 @@
+"""CLI tests (SURVEY §7.1 stage 7) + the dict-contract snapshot test
+(SURVEY §4.4) that freezes the renderer seam."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof import ProfilerConfig, describe, schema
+from tpuprof.cli import main
+
+
+@pytest.fixture
+def parquet_path(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 3000
+    df = pd.DataFrame({
+        "a": rng.normal(10, 2, n),
+        "b": rng.exponential(1.0, n),
+        "c": rng.choice(["x", "y", "z"], n),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+    return path
+
+
+def test_cli_profile_end_to_end(parquet_path, tmp_path, capsys):
+    out = str(tmp_path / "r.html")
+    stats_json = str(tmp_path / "s.json")
+    rc = main(["profile", parquet_path, "-o", out, "--backend", "tpu",
+               "--batch-rows", "1024", "--stats-json", stats_json])
+    assert rc == 0
+    page = open(out).read()
+    assert page.startswith("<!DOCTYPE html>") and 'id="var-a"' in page
+    payload = json.load(open(stats_json))
+    assert payload["table"]["n"] == "3,000"
+    assert payload["variables"]["c"]["type"] == "CAT"
+    assert "rows/s" in capsys.readouterr().err
+
+
+def test_cli_single_pass(parquet_path, tmp_path):
+    out = str(tmp_path / "r.html")
+    rc = main(["profile", parquet_path, "-o", out, "--single-pass",
+               "--backend", "tpu", "--batch-rows", "1024"])
+    assert rc == 0 and "Overview" in open(out).read()
+
+
+def test_cli_rejects_unknown_backend(parquet_path):
+    with pytest.raises(SystemExit):
+        main(["profile", parquet_path, "--backend", "cuda"])
+
+
+SNAPSHOT_NUM_FIELDS = sorted(schema.NUM_FIELDS)
+
+
+def test_dict_contract_snapshot():
+    """Freeze the L2→L3 seam: the exact field sets per kind.  If this test
+    needs editing, the renderer and BOTH backends must change together
+    (SURVEY §1: 'the single most important compatibility requirement')."""
+    assert sorted(schema.COMMON_FIELDS) == [
+        "count", "distinct_count", "is_unique", "memorysize", "n_missing",
+        "p_missing", "p_unique", "type"]
+    assert sorted(schema.NUM_FIELDS) == sorted(schema.COMMON_FIELDS + [
+        "mean", "std", "variance", "min", "max", "range", "sum",
+        "p5", "p25", "p50", "p75", "p95", "iqr", "cv", "mad",
+        "skewness", "kurtosis", "n_zeros", "p_zeros", "n_infinite",
+        "p_infinite", "mode", "histogram", "mini_histogram"])
+    assert sorted(schema.CAT_FIELDS) == sorted(
+        schema.COMMON_FIELDS + ["mode", "top", "freq"])
+    assert sorted(schema.DATE_FIELDS) == sorted(
+        schema.COMMON_FIELDS + ["min", "max", "range"])
+    assert sorted(schema.CORR_FIELDS) == sorted(
+        schema.COMMON_FIELDS + ["correlation_var", "correlation"])
+
+
+def test_describe_function_contract():
+    df = pd.DataFrame({"x": [1.0, 2.0, 3.0], "y": ["a", "b", "a"]})
+    stats = describe(df, ProfilerConfig(backend="cpu"))
+    assert schema.validate_stats(stats) == []
+    with pytest.raises(ValueError, match="not both"):
+        describe(df, ProfilerConfig(backend="cpu"), bins=5)

@@ -66,8 +66,21 @@ class ProfileReport:
     def html(self) -> str:
         if self._html is None:
             from tpuprof.report.render import to_html
-            self._html = to_html(self.description, self.config)
+            self._html = to_html(self.description, self.config,
+                                 perf=self._perf_line())
         return self._html
+
+    def _perf_line(self) -> str:
+        """Report-footer observability (SURVEY §5): per-phase wall-clock +
+        throughput for the scan that produced this report."""
+        from tpuprof.utils.trace import get_phase_report
+        phases = get_phase_report()
+        scan = sum(v for k, v in phases.items() if k.startswith("scan"))
+        if not scan:
+            return ""
+        n = self.description["table"]["n"]
+        parts = [f"{k} {v:.2f}s" for k, v in sorted(phases.items())]
+        return f"{n / scan:,.0f} rows/s · " + " · ".join(parts)
 
     def to_file(self, outputfile: str) -> None:
         """Reference: ProfileReport.to_file — wraps the fragment with the

@@ -105,7 +105,31 @@ class HostBatch:
 
 
 def _hash64(values: np.ndarray) -> np.ndarray:
+    """64-bit value hashes.  Native C++ path when available (see
+    tpuprof/native), pandas ``hash_array`` otherwise; the choice is
+    process-stable so hashes agree across batches/fragments."""
+    from tpuprof import native
+    if values.dtype in (np.float64, np.int64, np.uint64):
+        bits = values
+        if values.dtype == np.float64:
+            bits = np.where(values == 0.0, 0.0, values).view(np.uint64)
+        else:
+            bits = values.view(np.uint64) if values.dtype != np.uint64 \
+                else values
+        h = native.hash_u64_array(bits)
+        if h is not None:
+            return h
     return pd.util.hash_array(values).astype(np.uint64)
+
+
+def _hash64_dictionary(dictionary, dvals: np.ndarray) -> np.ndarray:
+    """Hash a batch's string dictionary: native buffer path when possible,
+    else pandas over the materialized object values."""
+    from tpuprof import native
+    h = native.hash_string_dictionary(dictionary)
+    if h is not None:
+        return h
+    return pd.util.hash_array(dvals).astype(np.uint64)
 
 
 def _split_hash(h64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -160,7 +184,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                 zero_copy_only=False).astype(np.int64)
             dvals = np.asarray(combined.dictionary.to_pandas(), dtype=object)
             if dvals.size:
-                dh = _hash64(dvals)
+                dh = _hash64_dictionary(combined.dictionary, dvals)
                 h64 = dh[codes]
             else:
                 h64 = np.zeros(n, dtype=np.uint64)

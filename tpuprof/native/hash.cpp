@@ -1,0 +1,132 @@
+// Native host-path hashing for tpuprof ingestion.
+//
+// The reference's equivalent work happens inside the Spark JVM (Tungsten
+// codegen, external to its repo — SURVEY.md §2.3); tpuprof's host hot
+// loop is hashing every cell for HLL distinct counts (SURVEY §7.2
+// "Strings on TPU": hashing throughput is the likely CPU bottleneck at
+// 1B rows).  Two entry points, loaded via ctypes (no pybind11 in the
+// image):
+//
+//   tpuprof_hash_u64   — splitmix64 finalizer over raw 64-bit patterns
+//                        (float64 bitcasts, int64 timestamps/ints)
+//   tpuprof_hash_bytes — xxHash64 over variable-length UTF-8 values
+//                        given Arrow large_string offsets, hashing the
+//                        dictionary buffer directly (zero Python objects)
+//
+// Both are deterministic and seed-stable: hashes must agree across
+// batches, fragments, and hosts for HLL registers to merge correctly.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t P1 = 0x9E3779B185EBCA87ULL;
+constexpr uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+constexpr uint64_t P3 = 0x165667B19E3779F9ULL;
+constexpr uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+constexpr uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+inline uint64_t rotl(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint64_t round1(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = rotl(acc, 31);
+  return acc * P1;
+}
+
+inline uint64_t merge_round(uint64_t acc, uint64_t val) {
+  acc ^= round1(0, val);
+  return acc * P1 + P4;
+}
+
+inline uint64_t avalanche(uint64_t h) {
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+// Full xxHash64 of one byte run.
+uint64_t xxh64(const uint8_t* p, size_t len, uint64_t seed) {
+  const uint8_t* end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = round1(v1, read64(p));
+      v2 = round1(v2, read64(p + 8));
+      v3 = round1(v3, read64(p + 16));
+      v4 = round1(v4, read64(p + 24));
+      p += 32;
+    } while (p <= limit);
+    h = rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18);
+    h = merge_round(h, v1);
+    h = merge_round(h, v2);
+    h = merge_round(h, v3);
+    h = merge_round(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += static_cast<uint64_t>(len);
+  while (p + 8 <= end) {
+    h ^= round1(0, read64(p));
+    h = rotl(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= static_cast<uint64_t>(read32(p)) * P1;
+    h = rotl(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p++) * P5;
+    h = rotl(h, 11) * P1;
+  }
+  return avalanche(h);
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[i] = splitmix64-style avalanche of in[i] (raw 64-bit patterns).
+void tpuprof_hash_u64(const uint64_t* in, uint64_t* out, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    uint64_t z = in[i] + 0x9E3779B97F4A7C15ULL;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    out[i] = z ^ (z >> 31);
+  }
+}
+
+// out[i] = xxh64(data[offsets[i] .. offsets[i+1]]) for n values sharing
+// one contiguous buffer (Arrow large_string layout: int64 offsets).
+void tpuprof_hash_bytes(const uint8_t* data, const int64_t* offsets,
+                        uint64_t* out, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    const int64_t beg = offsets[i];
+    const int64_t len = offsets[i + 1] - beg;
+    out[i] = xxh64(data + beg, static_cast<size_t>(len), 0);
+  }
+}
+
+}  // extern "C"

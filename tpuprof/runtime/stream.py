@@ -150,9 +150,12 @@ class StreamingProfiler:
             "sample": self._sample,
             "schema": self.arrow_schema.serialize().to_pybytes(),
         }
+        from tpuprof import native
         ckpt.save(path, self.state, host_blob, self.cursor,
                   meta={"n_num": self.plan.n_num, "n_hash": self.plan.n_hash,
-                        "batch_rows": self.config.batch_rows})
+                        "batch_rows": self.config.batch_rows,
+                        # HLL registers only merge with same-impl hashes
+                        "native_hash": native.available()})
 
     @classmethod
     def restore(cls, path: str, config: Optional[ProfilerConfig] = None,
@@ -160,6 +163,14 @@ class StreamingProfiler:
         """Rebuild a profiler from a checkpoint and continue streaming."""
         payload = ckpt.load_payload(path)
         host_blob = payload["host_blob"]
+        from tpuprof import native
+        saved_native = payload["meta"].get("native_hash")
+        if saved_native is not None and saved_native != native.available():
+            raise ValueError(
+                "checkpoint was written with "
+                f"{'native' if saved_native else 'pandas'} hashing but this "
+                "process has the other implementation — HLL registers would "
+                "not merge consistently")
         arrow_schema = pa.ipc.read_schema(pa.py_buffer(host_blob["schema"]))
         prof = cls(arrow_schema, config=config, devices=devices)
         # leave leaves as host numpy (uncommitted): the first sharded step
