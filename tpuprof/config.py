@@ -47,6 +47,9 @@ class ProfilerConfig:
                                     # sample-derived histograms.
     mesh_devices: Optional[int] = None  # None => all available devices
     seed: int = 0                   # PRNG seed for the sample sketch
+    use_pallas: Optional[bool] = None   # None = auto (on for real TPU):
+                                        # dense pallas histogram kernel vs
+                                        # XLA scatter-add
 
     # ---- quantiles reported (reference: approxQuantile probes) ------------
     quantile_probes: Sequence[float] = (0.05, 0.25, 0.5, 0.75, 0.95)

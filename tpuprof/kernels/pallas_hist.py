@@ -1,0 +1,102 @@
+"""Pallas TPU kernel: all-column fixed-bin histograms.
+
+Why a custom kernel: XLA lowers the scatter-add in kernels/histogram.py
+to a serialized per-element scatter on TPU — the one op in the profile
+scan that doesn't vectorize.  Binning is really a *dense* computation:
+for bins ≤ ~64, comparing every element against every bin id is only
+``bins`` VPU passes over the tile, with all accumulation in registers/
+VMEM — no scatter at all.
+
+Layout (per /opt/skills/guides/pallas_guide.md tiling rules):
+* grid = (col_tiles, row_tiles); row tiles iterate fastest so each
+  output block stays resident in VMEM while its rows stream through;
+* x block (R_TILE=512, C_TILE=128) f32; per-column lo/scale ride along
+  as (1, C_TILE) blocks; output block (C_TILE, BINS_PAD=128) int32 is
+  zero-initialized at the first row tile and accumulated in place.
+
+The kernel is exact (same clip semantics as the XLA path) and is tested
+in interpreter mode on CPU against both numpy and the scatter version
+(tests/test_pallas.py); the mesh runtime enables it on real TPU only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+R_TILE = 512
+C_TILE = 128
+BINS_PAD = 128          # lane width; bins <= BINS_PAD
+
+
+def _hist_kernel(x_ref, lo_ref, scale_ref, out_ref, *, nbins: int):
+    i = pl.program_id(1)                      # row tile (fastest)
+    x = x_ref[...]                            # (R_TILE, C_TILE)
+    lo = lo_ref[...]                          # (1, C_TILE)
+    scale = scale_ref[...]                    # (1, C_TILE)
+    finite = jnp.isfinite(x)
+    idx = jnp.floor((x - lo) * scale)
+    idx = jnp.clip(idx, 0, nbins - 1).astype(jnp.int32)
+    idx = jnp.where(finite, idx, -1)          # -1 never matches a bin id
+
+    # dense bin counting: one vectorized compare+reduce per bin
+    cols = [jnp.sum((idx == b).astype(jnp.int32), axis=0)
+            for b in range(nbins)]            # each (C_TILE,)
+    counts = jnp.stack(cols, axis=1)          # (C_TILE, nbins)
+    counts = jnp.pad(counts, ((0, 0), (0, BINS_PAD - nbins)))
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += counts
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nbins", "interpret"))
+def histogram_tiles(x: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
+                    nbins: int, interpret: bool = False) -> jnp.ndarray:
+    """(rows, cols) f32 (NaN = skip) → (cols, nbins) int32 counts.
+
+    ``lo``/``hi`` are per-column finite ranges (pass-A min/max); values
+    land in ``clip(floor((x-lo)/(hi-lo)*nbins), 0, nbins-1)`` — identical
+    semantics to kernels/histogram.py and np.histogram's inclusive last
+    edge."""
+    if nbins > BINS_PAD:
+        raise ValueError(f"pallas histogram supports bins <= {BINS_PAD}")
+    rows, cols = x.shape
+    rpad = -rows % R_TILE
+    cpad = -cols % C_TILE
+    x = jnp.pad(x, ((0, rpad), (0, cpad)), constant_values=jnp.nan)
+    lo_p = jnp.pad(lo.astype(jnp.float32), (0, cpad))[None, :]
+    width = jnp.maximum(hi - lo, 1e-30).astype(jnp.float32)
+    scale_p = jnp.pad(nbins / width, (0, cpad))[None, :]
+
+    n_ct = (cols + cpad) // C_TILE
+    n_rt = (rows + rpad) // R_TILE
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, nbins=nbins),
+        grid=(n_ct, n_rt),
+        in_specs=[
+            pl.BlockSpec((R_TILE, C_TILE), lambda j, i: (i, j)),
+            pl.BlockSpec((1, C_TILE), lambda j, i: (0, j)),
+            pl.BlockSpec((1, C_TILE), lambda j, i: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((C_TILE, BINS_PAD), lambda j, i: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((cols + cpad, BINS_PAD), jnp.int32),
+        interpret=interpret,
+    )(x, lo_p, scale_p)
+    return out[:cols, :nbins]
+
+
+def histogram_batch(x, row_valid, lo, hi, nbins: int,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Batch entry point matching kernels/histogram.update semantics:
+    padding rows masked via ``row_valid``."""
+    x = jnp.where(row_valid[:, None], x, jnp.nan)
+    return histogram_tiles(x, lo, hi, nbins, interpret=interpret)

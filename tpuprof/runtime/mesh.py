@@ -66,6 +66,13 @@ class MeshRunner:
         self.precision = config.hll_precision
         self.bins = config.bins
         self.seed = config.seed
+        # dense pallas binning beats XLA's serialized scatter on real TPU;
+        # the scatter path stays for CPU meshes and as an opt-out
+        if config.use_pallas is None:
+            self.use_pallas = (devs[0].platform == "tpu"
+                               and self.bins <= 128)
+        else:
+            self.use_pallas = config.use_pallas and self.bins <= 128
         self._build_programs()
 
     # -- state ------------------------------------------------------------
@@ -103,9 +110,22 @@ class MeshRunner:
             }
             return _restack(out)
 
+        use_pallas = self.use_pallas
+
         def local_step_b(state, x, row_valid, lo, hi, mean):
             s = _unstack(state)
-            return _restack(histogram.update(s, x, row_valid, lo, hi, mean))
+            if use_pallas:
+                from tpuprof.kernels import pallas_hist
+                counts = pallas_hist.histogram_batch(
+                    x, row_valid, lo, hi, s["counts"].shape[1])
+                finite = row_valid[:, None] & jnp.isfinite(x)
+                abs_dev = jnp.where(
+                    finite, jnp.abs(x - mean[None, :]), 0.0).sum(axis=0)
+                out = {"counts": s["counts"] + counts,
+                       "abs_dev": s["abs_dev"] + abs_dev}
+            else:
+                out = histogram.update(s, x, row_valid, lo, hi, mean)
+            return _restack(out)
 
         def local_merge_a(state):
             """The collective tree-reduce: merge all devices' pass-A states
