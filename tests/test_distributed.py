@@ -1,0 +1,95 @@
+"""Multi-host layer tests (single-process: the striping and host-agg
+merge logic is exercised directly — the collective transport itself is
+jax.distributed's, already no-op'd at process_count()==1)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfilerConfig
+from tpuprof.backends.tpu import HostAgg
+from tpuprof.ingest.arrow import ArrowIngest, prepare_batch
+from tpuprof.runtime import distributed
+
+
+def test_fragment_striping_partitions_completely():
+    frags = list(range(10))
+    assigned = [list(distributed.assign_fragments(frags, i, 3))
+                for i in range(3)]
+    assert sorted(sum(assigned, [])) == frags            # complete
+    assert not set(assigned[0]) & set(assigned[1])       # disjoint
+    assert assigned[0] == [0, 3, 6, 9]
+
+
+def _hostagg_from(df, config):
+    ingest = ArrowIngest(df, batch_rows=512)
+    agg = HostAgg(ingest.plan, config)
+    for rb in ingest.raw_batches():
+        agg.update(prepare_batch(rb, ingest.plan, 512))
+    return agg
+
+
+def test_hostagg_merge_equals_union():
+    rng = np.random.default_rng(0)
+    mk = lambda n, seed: pd.DataFrame({
+        "c": np.random.default_rng(seed).choice(["a", "b", "c"], n),
+        "d": pd.Timestamp("2021-01-01")
+             + pd.to_timedelta(np.random.default_rng(seed + 1).integers(
+                 0, 10_000, n), unit="s"),
+    })
+    cfg = ProfilerConfig()
+    a, b = mk(400, 1), mk(300, 7)
+    merged = distributed._merge_pair(_hostagg_from(a, cfg),
+                                     _hostagg_from(b, cfg))
+    union = _hostagg_from(pd.concat([a, b], ignore_index=True), cfg)
+    assert merged.n_rows == union.n_rows == 700
+    assert merged.mg["c"].counts == union.mg["c"].counts
+    assert merged.date_min["d"] == union.date_min["d"]
+    assert merged.date_max["d"] == union.date_max["d"]
+
+
+def test_allgather_objects_single_process_identity():
+    obj = {"x": np.arange(3)}
+    out = distributed.allgather_objects(obj)
+    assert len(out) == 1 and out[0] is obj
+
+
+def test_multihost_requires_dataset_source():
+    df = pd.DataFrame({"x": [1.0, 2.0]})
+    ingest = ArrowIngest(df, batch_rows=8, process_shard=(0, 2))
+    with pytest.raises(ValueError, match="file-backed"):
+        list(ingest.raw_batches())
+
+
+def test_two_process_simulation_on_dataset(tmp_path):
+    """Simulate two hosts against one Parquet dataset: each reads its
+    stripe; merged host aggs equal the single-host run."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(5)
+    cfg = ProfilerConfig()
+    for i in range(4):                       # 4 fragments
+        df = pd.DataFrame({
+            "v": rng.normal(size=500),
+            "c": rng.choice(["p", "q", "r"], 500)})
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                       str(tmp_path / f"part{i}.parquet"))
+
+    aggs = []
+    total_rows = 0
+    for pidx in range(2):
+        ingest = ArrowIngest(str(tmp_path), batch_rows=512,
+                             process_shard=(pidx, 2))
+        agg = HostAgg(ingest.plan, cfg)
+        for rb in ingest.raw_batches():
+            agg.update(prepare_batch(rb, ingest.plan, 512))
+        total_rows += agg.n_rows
+        aggs.append(agg)
+    merged = distributed._merge_pair(aggs[0], aggs[1])
+    assert merged.n_rows == total_rows == 2000
+
+    single = ArrowIngest(str(tmp_path), batch_rows=512)
+    sagg = HostAgg(single.plan, cfg)
+    for rb in single.raw_batches():
+        sagg.update(prepare_batch(rb, single.plan, 512))
+    assert merged.mg["c"].counts == sagg.mg["c"].counts

@@ -125,7 +125,12 @@ class TPUStatsBackend:
         self._devices = devices
 
     def collect(self, source: Any, config: ProfilerConfig) -> Dict[str, Any]:
-        ingest = ArrowIngest(source, config.batch_rows)
+        import jax
+
+        from tpuprof.runtime.distributed import (merge_host_aggs,
+                                                 merge_recount_arrays)
+        pshard = (jax.process_index(), jax.process_count())
+        ingest = ArrowIngest(source, config.batch_rows, process_shard=pshard)
         plan = ingest.plan
         if not plan.specs:
             return _empty_stats(config)
@@ -144,6 +149,9 @@ class TPUStatsBackend:
                 hostagg.update(hb)
         with phase_timer("merge"):
             res_a = runner.finalize_a(state)
+            # cross-host: device sketches already merged by the mesh
+            # collectives; host-side aggregates ride one DCN gather
+            hostagg = merge_host_aggs(hostagg)
         log_event("pass_a", rows=hostagg.n_rows, devices=runner.n_dev,
                   n_num=plan.n_num, n_hash=plan.n_hash)
 
@@ -173,6 +181,7 @@ class TPUStatsBackend:
                     state_b = runner.step_b(state_b, hb, lo, hi, mean_c)
                     recounter.update(hb)
                 res_b = runner.finalize_b(state_b)
+                recounter.counts = merge_recount_arrays(recounter.counts)
             hists, mad = khistogram.finalize(
                 res_b, momf["fmin"], momf["fmax"], momf["n"], config.bins)
         elif config.exact_passes and ingest.rescannable and hostagg.n_rows > 0:

@@ -206,9 +206,13 @@ class ArrowIngest:
     or a path to a Parquet file/directory (streamed fragment-by-fragment,
     never materialized — SURVEY §7.2 '1B×200 memory')."""
 
-    def __init__(self, source: Any, batch_rows: int, max_retries: int = 2):
+    def __init__(self, source: Any, batch_rows: int, max_retries: int = 2,
+                 process_shard: Tuple[int, int] = (0, 1)):
         self.batch_rows = int(batch_rows)
         self.max_retries = int(max_retries)
+        # (process_index, process_count): multi-host runs stripe dataset
+        # fragments across hosts (runtime/distributed.py); (0, 1) reads all
+        self.process_shard = process_shard
         self._table: Optional[pa.Table] = None
         self._dataset: Optional[pads.Dataset] = None
         if isinstance(source, pd.DataFrame):
@@ -231,7 +235,13 @@ class ArrowIngest:
         self.rescannable = True
 
     def raw_batches(self) -> Iterator[pa.RecordBatch]:
+        pidx, pcount = self.process_shard
         if self._table is not None:
+            if pcount != 1:
+                raise ValueError(
+                    "multi-host profiling requires a file-backed dataset "
+                    "(each host streams its own fragments); got an "
+                    "in-memory table")
             yield from self._table.to_batches(max_chunksize=self.batch_rows)
             return
         # Happy path: the dataset Scanner (multithreaded cross-fragment
@@ -239,17 +249,20 @@ class ArrowIngest:
         # fragment-granular iteration with retry, skipping batches already
         # delivered (SURVEY §5 'failure detection' — the Spark-task-retry
         # analogue; batch boundaries are deterministic for a fixed
-        # batch_size so the skip is duplicate-free).
+        # batch_size so the skip is duplicate-free).  Multi-host runs skip
+        # the whole-dataset scanner and stream this host's fragment stripe.
         delivered = 0
-        try:
-            for rb in self._dataset.to_batches(batch_size=self.batch_rows):
-                yield rb
-                delivered += 1
-            return
-        except OSError:
-            pass  # fall through to the resilient path
+        if pcount == 1:
+            try:
+                for rb in self._dataset.to_batches(
+                        batch_size=self.batch_rows):
+                    yield rb
+                    delivered += 1
+                return
+            except OSError:
+                pass  # fall through to the resilient path
         seen = 0
-        for fragment in self._dataset.get_fragments():
+        for fragment in self._my_fragments():
             frag_start = seen
             for attempt in range(self.max_retries + 1):
                 try:
@@ -264,6 +277,11 @@ class ArrowIngest:
                 except OSError:
                     if attempt == self.max_retries:
                         raise
+
+    def _my_fragments(self):
+        from tpuprof.runtime.distributed import assign_fragments
+        pidx, pcount = self.process_shard
+        return assign_fragments(self._dataset.get_fragments(), pidx, pcount)
 
     def batches(self) -> Iterator[HostBatch]:
         for rb in self.raw_batches():
