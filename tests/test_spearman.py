@@ -1,0 +1,57 @@
+"""Spearman rank-correlation tests: oracle parity and the TPU rank-CDF
+path (exact when the pass-A sample holds every value; SURVEY §7.2)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfileReport, ProfilerConfig
+from tpuprof.backends.cpu import CPUStatsBackend
+from tpuprof.backends.tpu import TPUStatsBackend
+
+
+@pytest.fixture(scope="module")
+def df():
+    rng = np.random.default_rng(11)
+    n = 1500
+    x = rng.gamma(2.0, 5.0, n)
+    return pd.DataFrame({
+        "x": x,
+        "y_mono": np.exp(x / 10) + rng.normal(0, 0.1, n),  # rank-linear,
+        "z": rng.normal(0, 1, n),                          # not linear
+        "c": rng.choice(["a", "b"], n),
+    })
+
+
+def test_cpu_oracle_spearman(df):
+    stats = CPUStatsBackend().collect(
+        df, ProfilerConfig(backend="cpu", spearman=True))
+    sp = stats["correlations"]["spearman"]
+    expected = df[["x", "y_mono", "z"]].corr(method="spearman")
+    np.testing.assert_allclose(sp.to_numpy(), expected.to_numpy(), atol=1e-12)
+    assert sp.loc["x", "y_mono"] > 0.99       # monotone link
+    assert abs(stats["correlations"]["pearson"].loc["x", "y_mono"]) < \
+        sp.loc["x", "y_mono"]                 # pearson weaker than spearman
+
+
+def test_tpu_spearman_matches_oracle(df):
+    cfg = ProfilerConfig(batch_rows=512, spearman=True,
+                         quantile_sketch_size=4096)   # n <= K: exact ranks
+    tpu = TPUStatsBackend().collect(df, cfg)
+    sp = tpu["correlations"]["spearman"]
+    expected = df[["x", "y_mono", "z"]].corr(method="spearman")
+    np.testing.assert_allclose(
+        sp.loc[expected.index, expected.columns].to_numpy(),
+        expected.to_numpy(), atol=2e-3)
+
+
+def test_spearman_off_by_default(df):
+    stats = TPUStatsBackend().collect(df, ProfilerConfig(batch_rows=512))
+    assert "spearman" not in stats["correlations"]
+
+
+def test_spearman_renders(df):
+    report = ProfileReport(
+        df, config=ProfilerConfig(backend="cpu", spearman=True))
+    assert "Correlations (Spearman)" in report.html
+    assert "Correlations (Pearson)" in report.html

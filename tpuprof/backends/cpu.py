@@ -223,11 +223,14 @@ class CPUStatsBackend:
         table = schema.make_table_stats(
             n, variables, memorysize=float(df.memory_usage(deep=True).sum()))
         messages = schema.derive_messages(variables, config)
+        correlations = {"pearson": corr_matrix}
+        if config.spearman and len(numeric_cols) >= 2:
+            correlations["spearman"] = df[numeric_cols].corr(method="spearman")
         return {
             "table": table,
             "variables": variables,
             "freq": freq,
-            "correlations": {"pearson": corr_matrix},
+            "correlations": correlations,
             "messages": messages,
             "sample": df.head(config.sample_rows),
         }

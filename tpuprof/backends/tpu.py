@@ -167,6 +167,7 @@ class TPUStatsBackend:
         hists: Optional[List] = None
         mad: Optional[np.ndarray] = None
         recounter: Optional[Recounter] = None
+        rho_spear: Optional[np.ndarray] = None
         if config.exact_passes and ingest.rescannable and plan.n_num > 0 \
                 and hostagg.n_rows > 0:
             recounter = Recounter(hostagg)
@@ -175,13 +176,28 @@ class TPUStatsBackend:
             lo = np.where(np.isfinite(lo), lo, 0.0)
             hi = np.where(np.isfinite(hi), hi, 0.0)
             mean_c = np.where(np.isfinite(mean), mean, 0.0)
+            spear_state = None
+            if config.spearman:
+                # rank transform through the pass-A sample CDF (+inf pads
+                # the unkept slots past every real value)
+                kept_counts = sample_kept.sum(axis=1).astype(np.int32)
+                sorted_sample = np.sort(
+                    np.where(sample_kept, sample_vals, np.inf),
+                    axis=1).astype(np.float32)
+                spear_state = runner.init_spearman()
             with phase_timer("scan_b"):
                 for rb in ingest.raw_batches():
                     hb = prepare_batch(rb, plan, pad)
                     state_b = runner.step_b(state_b, hb, lo, hi, mean_c)
+                    if spear_state is not None:
+                        spear_state = runner.step_spearman(
+                            spear_state, hb, sorted_sample, kept_counts)
                     recounter.update(hb)
                 res_b = runner.finalize_b(state_b)
                 recounter.counts = merge_recount_arrays(recounter.counts)
+            if spear_state is not None:
+                rho_spear = kcorr.finalize(
+                    runner.finalize_spearman(spear_state))
             hists, mad = khistogram.finalize(
                 res_b, momf["fmin"], momf["fmax"], momf["n"], config.bins)
         elif config.exact_passes and ingest.rescannable and hostagg.n_rows > 0:
@@ -192,7 +208,8 @@ class TPUStatsBackend:
 
         return _assemble(plan, config, ingest.sample(config.sample_rows),
                          hostagg, momf, rho_all, quants, sample_vals,
-                         sample_kept, hll_est, hists, mad, recounter, probes)
+                         sample_kept, hll_est, hists, mad, recounter, probes,
+                         rho_spear=rho_spear)
 
 
 # ---------------------------------------------------------------------------
@@ -211,7 +228,7 @@ def _sample_mode(values: np.ndarray, kept: np.ndarray) -> float:
 
 def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
               sample_vals, sample_kept, hll_est, hists, mad, recounter,
-              probes) -> Dict[str, Any]:
+              probes, rho_spear=None) -> Dict[str, Any]:
     n = hostagg.n_rows
     variables: Dict[str, Dict[str, Any]] = {}
     freq: Dict[str, pd.Series] = {}
@@ -317,11 +334,16 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
 
     table = schema.make_table_stats(n, variables, memorysize=np.nan)
     messages = schema.derive_messages(variables, config)
+    correlations = {"pearson": corr_df}
+    if rho_spear is not None and len(lanes) >= 2:
+        correlations["spearman"] = pd.DataFrame(
+            rho_spear[np.ix_(lanes, lanes)], index=num_names,
+            columns=num_names)
     return {
         "table": table,
         "variables": variables,
         "freq": freq,
-        "correlations": {"pearson": corr_df},
+        "correlations": correlations,
         "messages": messages,
         "sample": sample_df,
     }

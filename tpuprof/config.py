@@ -54,6 +54,12 @@ class ProfilerConfig:
     # ---- quantiles reported (reference: approxQuantile probes) ------------
     quantile_probes: Sequence[float] = (0.05, 0.25, 0.5, 0.75, 0.95)
 
+    # ---- optional parity: Spearman rank correlation -----------------------
+    # (upstream pandas-profiling 1.x computed it; whether the Spark fork
+    # kept it is unverified — SURVEY §2.1 treats it as optional parity.
+    # Rejection stays Pearson-based either way.)
+    spearman: bool = False
+
     def __post_init__(self) -> None:
         if self.bins < 1:
             raise ValueError("bins must be >= 1")

@@ -127,20 +127,53 @@ class MeshRunner:
                 out = histogram.update(s, x, row_valid, lo, hi, mean)
             return _restack(out)
 
+        def merge_corr_local(co, common_shift):
+            wc = jnp.broadcast_to((co["set"] > 0).astype(jnp.float32),
+                                  co["shift"].shape)
+            co = corr.rebase(co, common_shift(co["shift"], wc))
+            return {
+                "shift": co["shift"],
+                "set": jax.lax.pmax(co["set"], "data"),
+                "N": jax.lax.psum(co["N"], "data"),
+                "S1": jax.lax.psum(co["S1"], "data"),
+                "S2": jax.lax.psum(co["S2"], "data"),
+                "P": jax.lax.psum(co["P"], "data"),
+            }
+
+        def _common_shift(shift, weight):
+            wsum = jax.lax.psum(weight, "data")
+            return jax.lax.psum(shift * weight, "data") / jnp.maximum(
+                wsum, 1.0)
+
+        def local_step_spear(state, x, row_valid, sample, kept):
+            """Spearman pass: rank-transform each value through the pass-A
+            sample CDF (average rank of the two searchsorted sides — exact
+            average-tie ranks when the sample holds the whole column) and
+            accumulate the same Gram state Pearson uses (SURVEY §7.2)."""
+            s = _unstack(state)
+            finite = row_valid[:, None] & jnp.isfinite(x)
+            xt = x.T                                        # (c, R)
+            left = jax.vmap(
+                lambda a, v: jnp.searchsorted(a, v, side="left"))(sample, xt)
+            right = jax.vmap(
+                lambda a, v: jnp.searchsorted(a, v, side="right"))(sample, xt)
+            denom = jnp.maximum(kept, 1).astype(jnp.float32)[:, None]
+            ranks = (left + right).astype(jnp.float32) * 0.5 / denom
+            r = jnp.where(finite, ranks.T, jnp.nan)
+            return _restack(corr.update(s, r, row_valid))
+
+        def local_merge_spear(state):
+            return _restack(merge_corr_local(_unstack(state), _common_shift))
+
         def local_merge_a(state):
             """The collective tree-reduce: merge all devices' pass-A states
             into one replicated state."""
             s = _unstack(state)
             # ---- moments + corr: psum additive leaves after rebasing to a
             # collectively agreed shift (weighted mean of device shifts)
-            def common_shift(shift, weight):
-                wsum = jax.lax.psum(weight, "data")
-                return jax.lax.psum(shift * weight, "data") / jnp.maximum(
-                    wsum, 1.0)
-
             mom = s["mom"]
             w = (mom["n"] > 0).astype(jnp.float32)
-            mom = moments.rebase(mom, common_shift(mom["shift"], w))
+            mom = moments.rebase(mom, _common_shift(mom["shift"], w))
             merged_mom = {
                 "shift": mom["shift"],
                 "minv": jax.lax.pmin(mom["minv"], "data"),
@@ -152,18 +185,7 @@ class MeshRunner:
                          "n_zeros", "n_inf", "n_missing"):
                 merged_mom[leaf] = jax.lax.psum(mom[leaf], "data")
 
-            co = s["corr"]
-            wc = jnp.broadcast_to((co["set"] > 0).astype(jnp.float32),
-                                  co["shift"].shape)
-            co = corr.rebase(co, common_shift(co["shift"], wc))
-            merged_corr = {
-                "shift": co["shift"],
-                "set": jax.lax.pmax(co["set"], "data"),
-                "N": jax.lax.psum(co["N"], "data"),
-                "S1": jax.lax.psum(co["S1"], "data"),
-                "S2": jax.lax.psum(co["S2"], "data"),
-                "P": jax.lax.psum(co["P"], "data"),
-            }
+            merged_corr = merge_corr_local(s["corr"], _common_shift)
 
             # ---- sample sketch: gather every device's K candidates, keep
             # the global top-K priorities (exactly the pairwise merge law)
@@ -207,6 +229,14 @@ class MeshRunner:
         self._merge_b = jax.jit(shard_map(
             local_merge_b, mesh=mesh, in_specs=(state_spec,),
             out_specs=state_spec, check_vma=False))
+        self._step_spear = jax.jit(shard_map(
+            local_step_spear, mesh=mesh,
+            in_specs=(state_spec, rows_spec, rows_spec, rep, rep),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
+        self._merge_spear = jax.jit(shard_map(
+            local_merge_spear, mesh=mesh, in_specs=(state_spec,),
+            out_specs=state_spec, check_vma=False))
 
     # -- driver API --------------------------------------------------------
 
@@ -219,6 +249,20 @@ class MeshRunner:
                             jnp.asarray(lo, dtype=jnp.float32),
                             jnp.asarray(hi, dtype=jnp.float32),
                             jnp.asarray(mean, dtype=jnp.float32))
+
+    def init_spearman(self) -> Pytree:
+        return jax.vmap(lambda _: corr.init(self.n_num))(
+            jnp.arange(self.n_dev))
+
+    def step_spearman(self, state: Pytree, hb, sorted_sample,
+                      kept) -> Pytree:
+        return self._step_spear(state, hb.x, hb.row_valid,
+                                jnp.asarray(sorted_sample, dtype=jnp.float32),
+                                jnp.asarray(kept, dtype=jnp.int32))
+
+    def finalize_spearman(self, state: Pytree):
+        return jax.device_get(
+            jax.tree.map(lambda a: a[0], self._merge_spear(state)))
 
     def finalize_a(self, state: Pytree) -> Dict[str, Any]:
         """Collective merge on-device, then pull ONE replica to host."""
