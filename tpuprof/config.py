@@ -50,6 +50,10 @@ class ProfilerConfig:
     use_pallas: Optional[bool] = None   # None = auto (on for real TPU):
                                         # dense pallas histogram kernel vs
                                         # XLA scatter-add
+    approx_topk: Optional[bool] = None  # None = auto (on for real TPU):
+                                        # lax.approx_max_k for the sample
+                                        # sketch's per-batch selection
+                                        # (unbiased; see kernels/quantiles)
 
     # ---- quantiles reported (reference: approxQuantile probes) ------------
     quantile_probes: Sequence[float] = (0.05, 0.25, 0.5, 0.75, 0.95)

@@ -45,10 +45,18 @@ def init(n_cols: int, k: int) -> SketchState:
 
 
 def update(state: SketchState, x: Array, row_valid: Array,
-           key: Array) -> SketchState:
+           key: Array, approx: bool = False) -> SketchState:
     """Fold a batch in.  ``x``: (rows, cols) float32 NaN-for-missing;
     non-finite values get priority −inf (quantiles are over finite values,
-    matching the oracle)."""
+    matching the oracle).
+
+    ``approx=True`` uses ``lax.approx_max_k`` (the TPU-optimized partial
+    reduction) instead of a full ``top_k``.  This is statistically safe
+    for THIS sketch: priorities are i.i.d. uniform and independent of the
+    values, so any selection rule driven purely by priorities — including
+    an approximate one that occasionally swaps in the (K+j)-th priority —
+    still yields an unbiased uniform sample.  The exact path remains the
+    default (and is always used for merges, which are only 2K wide)."""
     rows, cols = x.shape
     finite = row_valid[:, None] & jnp.isfinite(x)       # (rows, cols)
     prio = jax.random.uniform(key, (rows, cols), dtype=jnp.float32)
@@ -57,7 +65,10 @@ def update(state: SketchState, x: Array, row_valid: Array,
     cand_v = jnp.concatenate([state["values"], xt], axis=1)
     cand_p = jnp.concatenate([state["prio"], prio.T], axis=1)
     k = state["prio"].shape[1]
-    top_p, idx = jax.lax.top_k(cand_p, k)
+    if approx:
+        top_p, idx = jax.lax.approx_max_k(cand_p, k)
+    else:
+        top_p, idx = jax.lax.top_k(cand_p, k)
     top_v = jnp.take_along_axis(cand_v, idx, axis=1)
     return {"values": top_v, "prio": top_p}
 

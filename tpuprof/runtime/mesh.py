@@ -73,6 +73,9 @@ class MeshRunner:
                                and self.bins <= 128)
         else:
             self.use_pallas = config.use_pallas and self.bins <= 128
+        self.approx_topk = (devs[0].platform == "tpu"
+                            if config.approx_topk is None
+                            else config.approx_topk)
         self._build_programs()
 
     # -- state ------------------------------------------------------------
@@ -96,6 +99,7 @@ class MeshRunner:
     def _build_programs(self) -> None:
         mesh, seed = self.mesh, self.seed
         precision = self.precision
+        approx_topk = self.approx_topk
 
         def local_step_a(state, x, row_valid, ha, hb, hv, step_idx):
             s = _unstack(state)
@@ -105,7 +109,8 @@ class MeshRunner:
             out = {
                 "mom": moments.update(s["mom"], x, row_valid),
                 "corr": corr.update(s["corr"], x, row_valid),
-                "qs": quantiles.update(s["qs"], x, row_valid, key),
+                "qs": quantiles.update(s["qs"], x, row_valid, key,
+                                       approx=approx_topk),
                 "hll": hll.update(s["hll"], ha, hb, hv, precision),
             }
             return _restack(out)
