@@ -1,0 +1,84 @@
+"""TPU-backend edge cases: degenerate frames must not crash the fused
+engine and must classify like the oracle (SURVEY §4.1 edge distributions)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfilerConfig, schema
+from tpuprof.backends.tpu import TPUStatsBackend
+
+
+def _collect(df, **kw):
+    kw.setdefault("batch_rows", 256)
+    return TPUStatsBackend().collect(df, ProfilerConfig(**kw))
+
+
+def test_empty_frame():
+    stats = _collect(pd.DataFrame({"x": pd.Series([], dtype="float64"),
+                                   "s": pd.Series([], dtype="object")}))
+    assert stats["table"]["n"] == 0
+    assert stats["variables"]["x"]["type"] == schema.CONST
+    assert schema.validate_stats(stats) == []
+
+
+def test_all_null_columns():
+    stats = _collect(pd.DataFrame({
+        "x": [np.nan] * 50,
+        "s": pd.Series([None] * 50, dtype="object"),
+    }))
+    vx = stats["variables"]["x"]
+    assert vx["count"] == 0 and vx["n_missing"] == 50
+    assert vx["type"] == schema.CONST
+    assert np.isnan(vx["mode"]) if isinstance(vx["mode"], float) else True
+    vs = stats["variables"]["s"]
+    assert vs["count"] == 0 and vs["type"] == schema.CONST
+
+
+def test_single_row():
+    stats = _collect(pd.DataFrame({"x": [3.5], "s": ["only"]}))
+    assert stats["table"]["n"] == 1
+    assert stats["variables"]["x"]["type"] == schema.CONST
+    assert stats["variables"]["x"]["mode"] == 3.5
+
+
+def test_constant_and_inf_only():
+    stats = _collect(pd.DataFrame({
+        "k": np.full(100, 7.25),
+        "inf_only": np.full(100, np.inf),
+        "y": np.arange(100.0),
+    }))
+    assert stats["variables"]["k"]["type"] == schema.CONST
+    assert stats["variables"]["k"]["mode"] == 7.25
+    vi = stats["variables"]["inf_only"]
+    assert vi["type"] == schema.CONST          # min == max == inf
+    assert stats["variables"]["y"]["type"] == schema.NUM
+
+
+def test_int64_ids_distinct_not_f32_collided():
+    """ids above 2^24 collide in f32; hashes are computed on the original
+    int64 values so distinct counts must stay correct."""
+    base = 10_000_000_000
+    n = 4000
+    df = pd.DataFrame({"id": np.arange(base, base + n),
+                       "v": np.zeros(n)})
+    stats = _collect(df, batch_rows=512)
+    d = stats["variables"]["id"]["distinct_count"]
+    assert abs(d - n) / n < 0.1                # HLL bounds, no f32 collapse
+
+
+def test_wide_unicode_strings():
+    rng = np.random.default_rng(0)
+    vals = ["Ω" * 50, "λ" * 200, "ascii", ""]
+    df = pd.DataFrame({"s": rng.choice(vals, 500)})
+    stats = _collect(df)
+    v = stats["variables"]["s"]
+    assert v["type"] == schema.CAT and v["distinct_count"] == 4
+    assert stats["freq"]["s"].sum() == 500
+
+
+def test_batch_rows_larger_than_table():
+    df = pd.DataFrame({"x": np.arange(20.0)})
+    stats = _collect(df, batch_rows=1 << 14)
+    assert stats["variables"]["x"]["count"] == 20
+    assert stats["variables"]["x"]["p50"] == pytest.approx(9.5)
