@@ -43,9 +43,7 @@ def main() -> None:
         hb = HostBatch(
             nrows=runner.rows, x=x,
             row_valid=np.ones(runner.rows, dtype=bool),
-            hash_a=np.zeros((runner.rows, 0), dtype=np.uint32),
-            hash_b=np.zeros((runner.rows, 0), dtype=np.uint32),
-            hvalid=np.zeros((runner.rows, 0), dtype=bool),
+            hll=np.zeros((runner.rows, 0), dtype=np.uint16),
             cat_codes={}, date_ints={})
         host_batches.append(hb)
 

@@ -94,9 +94,7 @@ def run_wide1b(scale: float, workdir: str, backend: str) -> dict:
             nrows=runner.rows,
             x=scenarios.wide_batch(rng, runner.rows),
             row_valid=np.ones(runner.rows, dtype=bool),
-            hash_a=np.zeros((runner.rows, 0), dtype=np.uint32),
-            hash_b=np.zeros((runner.rows, 0), dtype=np.uint32),
-            hvalid=np.zeros((runner.rows, 0), dtype=bool),
+            hll=np.zeros((runner.rows, 0), dtype=np.uint16),
             cat_codes={}, date_ints={})
         batches.append(hb)
     state = runner.init_pass_a()

@@ -33,9 +33,10 @@ def test_batch_shapes_and_padding():
     batches = list(ingest.batches())
     assert [b.nrows for b in batches] == [64, 36]
     hb = batches[1]
-    assert hb.x.shape == (64, 1) and hb.hash_a.shape == (64, 3)
+    assert hb.x.shape == (64, 1) and hb.hll.shape == (64, 3)
+    assert hb.hll.dtype == np.uint16
     assert hb.row_valid.sum() == 36
-    assert not hb.hvalid[36:].any()          # padding rows invalid
+    assert (hb.hll[36:] == 0).all()          # padding rows invalid
     assert np.isnan(hb.x[36:, 0]).all()
 
 
@@ -46,8 +47,8 @@ def test_hash_stability_across_batching():
     one = list(ArrowIngest(t, batch_rows=100).batches())[0]
     many = list(ArrowIngest(t, batch_rows=17).batches())
     lane = 1  # "s"
-    got = np.concatenate([b.hash_a[: b.nrows, lane] for b in many])
-    np.testing.assert_array_equal(one.hash_a[:100, lane], got)
+    got = np.concatenate([b.hll[: b.nrows, lane] for b in many])
+    np.testing.assert_array_equal(one.hll[:100, lane], got)
 
 
 def test_fragment_retry_resumes_without_duplicates():

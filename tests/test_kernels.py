@@ -143,39 +143,48 @@ class TestQuantiles:
 
 
 class TestHLL:
-    def _hashes(self, values):
+    def _packed(self, values, valid=None, precision=11):
         import pandas as pd
-        h64 = pd.util.hash_array(np.asarray(values))
-        return ((h64 >> 32).astype(np.uint32), h64.astype(np.uint32))
+        h64 = pd.util.hash_array(np.asarray(values)).astype(np.uint64)
+        if valid is None:
+            valid = np.ones(len(h64), dtype=bool)
+        return hll.pack(h64, valid, precision)[:, None]
 
     def test_small_exact_linear_counting(self):
-        ha, hb = self._hashes(np.arange(37) % 5)     # 5 distinct
+        packed = self._packed(np.arange(37) % 5)     # 5 distinct
         regs = hll.init(1, precision=11)
         regs = jax.jit(hll.update, static_argnames="precision")(
-            regs, jnp.asarray(ha)[:, None], jnp.asarray(hb)[:, None],
-            jnp.ones((37, 1), dtype=bool), precision=11)
+            regs, jnp.asarray(packed), precision=11)
         est = hll.finalize(jax.device_get(regs))
         assert round(est[0]) == 5
 
     def test_error_bound_large(self):
         n = 300_000
-        ha, hb = self._hashes(np.arange(n))          # all distinct
+        packed = self._packed(np.arange(n))          # all distinct
         regs = hll.init(1, precision=11)
         upd = jax.jit(hll.update, static_argnames="precision")
         for s in range(0, n, 50_000):
-            regs = upd(regs, jnp.asarray(ha[s:s+50_000])[:, None],
-                       jnp.asarray(hb[s:s+50_000])[:, None],
-                       jnp.ones((50_000, 1), dtype=bool), precision=11)
+            regs = upd(regs, jnp.asarray(packed[s:s+50_000]), precision=11)
         est = hll.finalize(jax.device_get(regs))
         assert abs(est[0] - n) / n < 5 * 1.04 / np.sqrt(2048)
 
     def test_nulls_ignored(self):
-        ha, hb = self._hashes(np.arange(10))
-        valid = np.zeros((10, 1), dtype=bool)
+        packed = self._packed(np.arange(10),
+                              valid=np.zeros(10, dtype=bool))
         regs = jax.jit(hll.update, static_argnames="precision")(
-            hll.init(1, 11), jnp.asarray(ha)[:, None],
-            jnp.asarray(hb)[:, None], jnp.asarray(valid), precision=11)
+            hll.init(1, 11), jnp.asarray(packed), precision=11)
         assert hll.finalize(jax.device_get(regs))[0] == 0.0
+
+    def test_pack_roundtrip_fields(self):
+        h64 = np.array([0xFFFFFFFFFFFFFFFF, 0x0000000000000001,
+                        0x8000000000000000], dtype=np.uint64)
+        packed = hll.pack(h64, np.ones(3, dtype=bool), 11)
+        idx = packed >> np.uint16(hll.RHO_BITS)
+        rho = packed & np.uint16(hll.RHO_MAX)
+        assert idx.tolist() == [2047, 0, 1024]
+        # h64[1]: next-32 bits are all zero -> rho caps at 31
+        assert rho[1] == 31 and rho[0] == 1
+        assert (packed != 0).all()
 
 
 class TestHistogram:

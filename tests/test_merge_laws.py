@@ -133,11 +133,9 @@ def test_hll_merge_law_exact():
 
     def regs(vals):
         h = pd.util.hash_array(vals).astype(np.uint64)
-        ha = (h >> np.uint64(32)).astype(np.uint32)[:, None]
-        hb_ = h.astype(np.uint32)[:, None]
+        packed = hll.pack(h, np.ones(len(vals), dtype=bool), 10)[:, None]
         return jax.jit(hll.update, static_argnames="precision")(
-            hll.init(1, 10), jnp.asarray(ha), jnp.asarray(hb_),
-            jnp.ones((len(vals), 1), dtype=bool), precision=10)
+            hll.init(1, 10), jnp.asarray(packed), precision=10)
 
     merged = jax.jit(hll.merge)(regs(va), regs(vb))
     direct = regs(np.concatenate([va, vb]))

@@ -144,7 +144,7 @@ class TPUStatsBackend:
         state = runner.init_pass_a()
         with phase_timer("scan_a"):
             for step_idx, rb in enumerate(ingest.raw_batches()):
-                hb = prepare_batch(rb, plan, pad)
+                hb = prepare_batch(rb, plan, pad, config.hll_precision)
                 state = runner.step_a(state, hb, step_idx)
                 hostagg.update(hb)
         with phase_timer("merge"):
@@ -187,7 +187,7 @@ class TPUStatsBackend:
                 spear_state = runner.init_spearman()
             with phase_timer("scan_b"):
                 for rb in ingest.raw_batches():
-                    hb = prepare_batch(rb, plan, pad)
+                    hb = prepare_batch(rb, plan, pad, config.hll_precision)
                     state_b = runner.step_b(state_b, hb, lo, hi, mean_c)
                     if spear_state is not None:
                         spear_state = runner.step_spearman(

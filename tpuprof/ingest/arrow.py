@@ -5,9 +5,11 @@ device step consumes directly:
 
 * ``x``       (G, n_num)  float32 — numeric/boolean lanes, NaN = missing
 * ``row_valid`` (G,)      bool    — masks the padding rows
-* ``hash_a/b`` (G, n_hash) uint32 — two lanes of a 64-bit value hash for
-                                     EVERY column (HLL distinct counts)
-* ``hvalid``  (G, n_hash) bool    — per-value null mask for the hashes
+* ``hll``     (G, n_hash) uint16 — packed HLL observations
+                                     ``(register_idx << 5) | rho`` for
+                                     EVERY column, 0 = null/padding
+                                     (kernels/hll.pack — 2 bytes/cell of
+                                     host→device traffic instead of 9)
 
 plus the host-only side-channel work: Misra-Gries frequency updates for
 categorical columns (on dictionary codes, vectorized), date min/max on
@@ -96,9 +98,7 @@ class HostBatch:
     nrows: int
     x: np.ndarray             # (G, n_num) float32, NaN missing/padding
     row_valid: np.ndarray     # (G,) bool
-    hash_a: np.ndarray        # (G, n_hash) uint32
-    hash_b: np.ndarray        # (G, n_hash) uint32
-    hvalid: np.ndarray        # (G, n_hash) bool
+    hll: np.ndarray           # (G, n_hash) uint16 packed observations
     # host-side views for MG / recount / dates: name -> payload
     cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]]   # (codes, dict_vals)
     date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]]   # (int64 ns, valid)
@@ -142,13 +142,10 @@ def _hash64_dictionary(dictionary, dvals: np.ndarray) -> np.ndarray:
     return pd.util.hash_array(dvals).astype(np.uint64)
 
 
-def _split_hash(h64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    return ((h64 >> np.uint64(32)).astype(np.uint32), h64.astype(np.uint32))
-
-
 def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
-                  pad_rows: int) -> HostBatch:
+                  pad_rows: int, hll_precision: int = 11) -> HostBatch:
     """Decode one Arrow record batch into a fixed-shape HostBatch."""
+    from tpuprof.kernels import hll as khll
     n = batch.num_rows
     g = pad_rows
     n_num, n_hash = plan.n_num, plan.n_hash
@@ -157,9 +154,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     # misses (measured 20x slower at 200 cols).  JAX re-lays-out on
     # transfer either way.
     x = np.full((g, n_num), np.nan, dtype=np.float32, order="F")
-    hash_a = np.zeros((g, n_hash), dtype=np.uint32, order="F")
-    hash_b = np.zeros((g, n_hash), dtype=np.uint32, order="F")
-    hvalid = np.zeros((g, n_hash), dtype=bool, order="F")
+    hll_packed = np.zeros((g, n_hash), dtype=np.uint16, order="F")
     row_valid = np.zeros((g,), dtype=bool)
     row_valid[:n] = True
     cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
@@ -188,20 +183,16 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                     xf = np.where(valid, xf, np.nan)
                 x[:n, spec.num_lane] = xf
             h64 = _hash64(_num_keys(vals))
-            ha, hb = _split_hash(h64)
-            hash_a[:n, spec.hash_lane] = ha
-            hash_b[:n, spec.hash_lane] = hb
-            hvalid[:n, spec.hash_lane] = valid
+            hll_packed[:n, spec.hash_lane] = khll.pack(
+                h64, valid, hll_precision)
         elif spec.role == "date":
             valid = arr.is_valid().to_numpy(zero_copy_only=False)
             ints = arr.cast(pa.timestamp("ns"), safe=False) \
                       .cast(pa.int64(), safe=False) \
                       .fill_null(0).to_numpy(zero_copy_only=False)
             h64 = _hash64(_num_keys(ints))
-            ha, hb = _split_hash(h64)
-            hash_a[:n, spec.hash_lane] = ha
-            hash_b[:n, spec.hash_lane] = hb
-            hvalid[:n, spec.hash_lane] = valid
+            hll_packed[:n, spec.hash_lane] = khll.pack(
+                h64, valid, hll_precision)
             date_ints[spec.name] = (ints, valid)
         else:  # cat
             if not isinstance(arr.type, pa.DictionaryType):
@@ -217,10 +208,8 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                 h64 = dh[codes]
             else:
                 h64 = np.zeros(n, dtype=np.uint64)
-            ha, hb = _split_hash(h64)
-            hash_a[:n, spec.hash_lane] = ha
-            hash_b[:n, spec.hash_lane] = hb
-            hvalid[:n, spec.hash_lane] = valid
+            hll_packed[:n, spec.hash_lane] = khll.pack(
+                h64, valid, hll_precision)
             cat_codes[spec.name] = (np.where(valid, codes, -1), dvals)
 
     # Column decode is embarrassingly parallel (disjoint output columns)
@@ -236,9 +225,8 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
         for i, spec in enumerate(plan.specs):
             decode_column(i, spec)
 
-    return HostBatch(nrows=n, x=x, row_valid=row_valid, hash_a=hash_a,
-                     hash_b=hash_b, hvalid=hvalid, cat_codes=cat_codes,
-                     date_ints=date_ints)
+    return HostBatch(nrows=n, x=x, row_valid=row_valid, hll=hll_packed,
+                     cat_codes=cat_codes, date_ints=date_ints)
 
 
 def _decode_threads() -> int:

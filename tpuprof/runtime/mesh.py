@@ -101,7 +101,7 @@ class MeshRunner:
         precision = self.precision
         approx_topk = self.approx_topk
 
-        def local_step_a(state, x, row_valid, ha, hb, hv, step_idx):
+        def local_step_a(state, x, row_valid, hll_packed, step_idx):
             s = _unstack(state)
             key = jax.random.fold_in(
                 jax.random.fold_in(jax.random.key(seed), step_idx),
@@ -111,7 +111,7 @@ class MeshRunner:
                 "corr": corr.update(s["corr"], x, row_valid),
                 "qs": quantiles.update(s["qs"], x, row_valid, key,
                                        approx=approx_topk),
-                "hll": hll.update(s["hll"], ha, hb, hv, precision),
+                "hll": hll.update(s["hll"], hll_packed, precision),
             }
             return _restack(out)
 
@@ -219,8 +219,7 @@ class MeshRunner:
 
         self._step_a = jax.jit(shard_map(
             local_step_a, mesh=mesh,
-            in_specs=(state_spec, rows_spec, rows_spec, rows_spec, rows_spec,
-                      rows_spec, rep),
+            in_specs=(state_spec, rows_spec, rows_spec, rows_spec, rep),
             out_specs=state_spec, check_vma=False),
             donate_argnums=(0,))
         self._step_b = jax.jit(shard_map(
@@ -246,8 +245,8 @@ class MeshRunner:
     # -- driver API --------------------------------------------------------
 
     def step_a(self, state: Pytree, hb, step_idx: int) -> Pytree:
-        return self._step_a(state, hb.x, hb.row_valid, hb.hash_a, hb.hash_b,
-                            hb.hvalid, jnp.int32(step_idx))
+        return self._step_a(state, hb.x, hb.row_valid, hb.hll,
+                            jnp.int32(step_idx))
 
     def step_b(self, state: Pytree, hb, lo, hi, mean) -> Pytree:
         return self._step_b(state, hb.x, hb.row_valid,

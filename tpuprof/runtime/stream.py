@@ -111,7 +111,8 @@ class StreamingProfiler:
             # micro-batches larger than the device batch are chunked
             for start in range(0, rb.num_rows, self.runner.rows):
                 chunk = rb.slice(start, self.runner.rows)
-                hb = prepare_batch(chunk, self.plan, self.runner.rows)
+                hb = prepare_batch(chunk, self.plan, self.runner.rows,
+                                   self.config.hll_precision)
                 self.state = self.runner.step_a(self.state, hb, self.cursor)
                 self.hostagg.update(hb)
                 self.cursor += 1
