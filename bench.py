@@ -39,7 +39,10 @@ def main() -> None:
     rng = np.random.default_rng(0)
     host_batches = []
     for i in range(4):
-        x = rng.normal(50.0, 10.0, (runner.rows, N_COLS)).astype(np.float32)
+        # F-order, exactly as ingest's prepare_batch lays batches out (its
+        # transpose is the zero-copy C-order view put_batch ships)
+        x = np.asfortranarray(
+            rng.normal(50.0, 10.0, (runner.rows, N_COLS)).astype(np.float32))
         hb = HostBatch(
             nrows=runner.rows, x=x,
             row_valid=np.ones(runner.rows, dtype=bool),

@@ -85,14 +85,21 @@ def run_wide1b(scale: float, workdir: str, backend: str) -> dict:
     from tpuprof.runtime.mesh import MeshRunner
 
     total_rows = max(int(1e9 * scale), 1 << 18)
-    config = ProfilerConfig(batch_rows=1 << 16)
+    # a fake multi-device CPU mesh timeshares nproc cores; TPU-sized
+    # batches then starve XLA's collective rendezvous (40s hard timeout),
+    # so CPU smoke runs use a batch each core can turn around quickly
+    on_cpu = jax.devices()[0].platform == "cpu"
+    config = ProfilerConfig(batch_rows=1 << (12 if on_cpu else 16))
     runner = MeshRunner(config, n_num=200, n_hash=0)
     rng = np.random.default_rng(0)
     batches = []
     for _ in range(4):
         hb = HostBatch(
             nrows=runner.rows,
-            x=scenarios.wide_batch(rng, runner.rows),
+            # F-order, as ingest lays batches out (its transpose is the
+            # zero-copy view put_batch ships — C-order would add a 50 MB
+            # host transpose copy to every timed step)
+            x=np.asfortranarray(scenarios.wide_batch(rng, runner.rows)),
             row_valid=np.ones(runner.rows, dtype=bool),
             hll=np.zeros((runner.rows, 0), dtype=np.uint16),
             cat_codes={}, date_ints={})
@@ -104,6 +111,11 @@ def run_wide1b(scale: float, workdir: str, backend: str) -> dict:
     t0 = time.perf_counter()
     for i in range(steps):
         state = runner.step_a(state, batches[i % 4], i + 1)
+        if on_cpu:
+            # fake devices timeshare the cores: without a sync, the first
+            # device reaches finalize's all-reduce while the last still
+            # has queued steps, tripping XLA's 40s rendezvous abort
+            jax.block_until_ready(state)
     runner.finalize_a(state)
     elapsed = time.perf_counter() - t0
     rows = steps * runner.rows

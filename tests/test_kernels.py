@@ -153,8 +153,7 @@ class TestHLL:
     def test_small_exact_linear_counting(self):
         packed = self._packed(np.arange(37) % 5)     # 5 distinct
         regs = hll.init(1, precision=11)
-        regs = jax.jit(hll.update, static_argnames="precision")(
-            regs, jnp.asarray(packed), precision=11)
+        regs = jax.jit(hll.update)(regs, jnp.asarray(packed))
         est = hll.finalize(jax.device_get(regs))
         assert round(est[0]) == 5
 
@@ -162,17 +161,16 @@ class TestHLL:
         n = 300_000
         packed = self._packed(np.arange(n))          # all distinct
         regs = hll.init(1, precision=11)
-        upd = jax.jit(hll.update, static_argnames="precision")
+        upd = jax.jit(hll.update)
         for s in range(0, n, 50_000):
-            regs = upd(regs, jnp.asarray(packed[s:s+50_000]), precision=11)
+            regs = upd(regs, jnp.asarray(packed[s:s+50_000]))
         est = hll.finalize(jax.device_get(regs))
         assert abs(est[0] - n) / n < 5 * 1.04 / np.sqrt(2048)
 
     def test_nulls_ignored(self):
         packed = self._packed(np.arange(10),
                               valid=np.zeros(10, dtype=bool))
-        regs = jax.jit(hll.update, static_argnames="precision")(
-            hll.init(1, 11), jnp.asarray(packed), precision=11)
+        regs = jax.jit(hll.update)(hll.init(1, 11), jnp.asarray(packed))
         assert hll.finalize(jax.device_get(regs))[0] == 0.0
 
     def test_pack_roundtrip_fields(self):

@@ -134,8 +134,7 @@ def test_hll_merge_law_exact():
     def regs(vals):
         h = pd.util.hash_array(vals).astype(np.uint64)
         packed = hll.pack(h, np.ones(len(vals), dtype=bool), 10)[:, None]
-        return jax.jit(hll.update, static_argnames="precision")(
-            hll.init(1, 10), jnp.asarray(packed), precision=10)
+        return jax.jit(hll.update)(hll.init(1, 10), jnp.asarray(packed))
 
     merged = jax.jit(hll.merge)(regs(va), regs(vb))
     direct = regs(np.concatenate([va, vb]))

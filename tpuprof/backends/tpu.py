@@ -143,10 +143,11 @@ class TPUStatsBackend:
         hostagg = HostAgg(plan, config)
         state = runner.init_pass_a()
         with phase_timer("scan_a"):
-            for step_idx, rb in enumerate(ingest.raw_batches()):
+            for rb in ingest.raw_batches():
                 hb = prepare_batch(rb, plan, pad, config.hll_precision)
-                state = runner.step_a(state, hb, step_idx)
-                hostagg.update(hb)
+                db = runner.put_batch(hb)      # async transfer starts now
+                state = runner.step_a(state, db)
+                hostagg.update(hb)             # overlaps the device step
         with phase_timer("merge"):
             res_a = runner.finalize_a(state)
             # cross-host: device sketches already merged by the mesh
@@ -176,22 +177,27 @@ class TPUStatsBackend:
             lo = np.where(np.isfinite(lo), lo, 0.0)
             hi = np.where(np.isfinite(hi), hi, 0.0)
             mean_c = np.where(np.isfinite(mean), mean, 0.0)
+            lo_d = runner.put_replicated(lo, dtype=np.float32)
+            hi_d = runner.put_replicated(hi, dtype=np.float32)
+            mean_d = runner.put_replicated(mean_c, dtype=np.float32)
             spear_state = None
             if config.spearman:
                 # rank transform through the pass-A sample CDF (+inf pads
                 # the unkept slots past every real value)
-                kept_counts = sample_kept.sum(axis=1).astype(np.int32)
-                sorted_sample = np.sort(
+                kept_counts = runner.put_replicated(
+                    sample_kept.sum(axis=1), dtype=np.int32)
+                sorted_sample = runner.put_replicated(np.sort(
                     np.where(sample_kept, sample_vals, np.inf),
-                    axis=1).astype(np.float32)
+                    axis=1), dtype=np.float32)
                 spear_state = runner.init_spearman()
             with phase_timer("scan_b"):
                 for rb in ingest.raw_batches():
                     hb = prepare_batch(rb, plan, pad, config.hll_precision)
-                    state_b = runner.step_b(state_b, hb, lo, hi, mean_c)
+                    db = runner.put_batch(hb, with_hll=False)
+                    state_b = runner.step_b(state_b, db, lo_d, hi_d, mean_d)
                     if spear_state is not None:
                         spear_state = runner.step_spearman(
-                            spear_state, hb, sorted_sample, kept_counts)
+                            spear_state, db, sorted_sample, kept_counts)
                     recounter.update(hb)
                 res_b = runner.finalize_b(state_b)
                 recounter.counts = merge_recount_arrays(recounter.counts)
@@ -203,7 +209,7 @@ class TPUStatsBackend:
         elif config.exact_passes and ingest.rescannable and hostagg.n_rows > 0:
             # no numeric columns — only the top-k recount matters
             recounter = Recounter(hostagg)
-            for hb in ingest.batches():
+            for hb in ingest.batches(config.hll_precision):
                 recounter.update(hb)
 
         return _assemble(plan, config, ingest.sample(config.sample_rows),

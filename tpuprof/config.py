@@ -69,8 +69,12 @@ class ProfilerConfig:
             raise ValueError("bins must be >= 1")
         if not 0.0 < self.corr_reject <= 1.0:
             raise ValueError("corr_reject must be in (0, 1]")
-        if self.hll_precision < 4 or self.hll_precision > 16:
-            raise ValueError("hll_precision must be in [4, 16]")
+        from tpuprof.kernels.hll import MAX_PRECISION
+        if self.hll_precision < 4 or self.hll_precision > MAX_PRECISION:
+            # upper bound set by the uint16 packed-observation format
+            # (11 idx bits + 5 rho bits), not by HLL itself
+            raise ValueError(
+                f"hll_precision must be in [4, {MAX_PRECISION}]")
 
     @classmethod
     def from_kwargs(cls, **kwargs) -> "ProfilerConfig":

@@ -102,6 +102,10 @@ class HostBatch:
     # host-side views for MG / recount / dates: name -> payload
     cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]]   # (codes, dict_vals)
     date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]]   # (int64 ns, valid)
+    # precision the hll column was packed with — MeshRunner refuses a
+    # batch whose packing disagrees with its register width (a mismatched
+    # idx would silently scatter into NEIGHBORING columns' registers)
+    hll_precision: int = 11
 
 
 def _hash64(keys: np.ndarray) -> np.ndarray:
@@ -226,7 +230,8 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
             decode_column(i, spec)
 
     return HostBatch(nrows=n, x=x, row_valid=row_valid, hll=hll_packed,
-                     cat_codes=cat_codes, date_ints=date_ints)
+                     cat_codes=cat_codes, date_ints=date_ints,
+                     hll_precision=hll_precision)
 
 
 def _decode_threads() -> int:
@@ -321,9 +326,10 @@ class ArrowIngest:
         pidx, pcount = self.process_shard
         return assign_fragments(self._dataset.get_fragments(), pidx, pcount)
 
-    def batches(self) -> Iterator[HostBatch]:
+    def batches(self, hll_precision: int = 11) -> Iterator[HostBatch]:
         for rb in self.raw_batches():
-            yield prepare_batch(rb, self.plan, self.batch_rows)
+            yield prepare_batch(rb, self.plan, self.batch_rows,
+                                hll_precision)
 
     def sample(self, n_rows: int) -> pd.DataFrame:
         if self._table is not None:

@@ -58,13 +58,18 @@ def pack(h64: np.ndarray, valid: np.ndarray, precision: int) -> np.ndarray:
     return np.where(valid, packed, np.uint16(0))
 
 
-def update(regs: Array, packed: Array, precision: int) -> Array:
-    """``packed``: (rows, cols) uint16 observations (0 = null/padding)."""
+def update(regs: Array, packed: Array) -> Array:
+    """``packed``: (rows, cols) uint16 observations (0 = null/padding).
+
+    The packing precision is implied by ``regs.shape[1]``; observations
+    whose index exceeds the register count (a batch packed with a larger
+    precision than the registers were allocated for) are routed to the
+    spill slot rather than scattered into neighboring columns."""
     n_cols, m = regs.shape
     p32 = packed.astype(jnp.int32)
     idx = p32 >> RHO_BITS
     rho = p32 & RHO_MAX
-    valid = p32 != 0
+    valid = (p32 != 0) & (idx < m)
     col_ids = jnp.arange(n_cols, dtype=jnp.int32)[None, :]
     flat_ids = jnp.where(valid, col_ids * m + idx, n_cols * m)  # spill slot
     flat = jnp.zeros((n_cols * m + 1,), dtype=jnp.int32)

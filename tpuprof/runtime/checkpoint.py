@@ -22,7 +22,10 @@ from typing import Any, Dict, Tuple
 import jax
 import numpy as np
 
-FORMAT_VERSION = 1
+# v2: pass-A state gained the "step" RNG-counter leaf and HLL switched to
+# uint16 packed observations — v1 checkpoints neither restore nor merge
+# correctly, so they must be rejected at load time.
+FORMAT_VERSION = 2
 
 
 def _flatten(tree: Any) -> Dict[str, np.ndarray]:
