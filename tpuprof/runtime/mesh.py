@@ -53,6 +53,16 @@ class DeviceBatch(NamedTuple):
     hllt: Any       # (n_hash, rows) uint16, sharded P(None, "data")
 
 
+class StackedBatch(NamedTuple):
+    """Several host batches shipped as one stacked device placement, for
+    the multi-batch ``scan_a`` dispatch (leading axis = batch index)."""
+
+    xts: Any          # (S, n_num, rows) float32, sharded P(None, None, "data")
+    row_valids: Any   # (S, rows) bool, sharded P(None, "data")
+    hllts: Any        # (S, n_hash, rows) uint16, sharded P(None, None, "data")
+    n_batches: int
+
+
 def _unstack(tree: Pytree) -> Pytree:
     """Inside shard_map each state leaf arrives as a (1, ...) block of the
     device-stacked axis; strip it for the kernel code."""
@@ -121,6 +131,37 @@ class MeshRunner:
             jax.device_put(rv, self._sh_rows),
             jax.device_put(ht, self._sh_cols_rows))
 
+    def stage_batches(self, hbs, with_hll: bool = True) -> "StackedBatch":
+        """Ship several HostBatches as ONE stacked placement so they can be
+        folded by a single ``scan_a`` dispatch.  Multi-batch dispatch exists
+        because per-program dispatch latency (~15ms through a tunneled
+        device) would otherwise dominate the fused step's ~1ms of compute."""
+        xts, rvs, hts = [], [], []
+        for hb in hbs:
+            x = hb.x
+            h = hb.hll if with_hll else hb.hll[:, :0]
+            if with_hll and self.n_hash and hb.hll_precision != self.precision:
+                raise ValueError(
+                    f"batch packed with hll_precision={hb.hll_precision} but "
+                    f"runner registers use precision={self.precision}")
+            xts.append(x.T if x.flags.f_contiguous
+                       else np.ascontiguousarray(x.T))
+            hts.append(h.T if h.flags.f_contiguous
+                       else np.ascontiguousarray(h.T))
+            rvs.append(np.ascontiguousarray(hb.row_valid))
+        return StackedBatch(
+            jax.device_put(np.stack(xts),
+                           NamedSharding(self.mesh, P(None, None, "data"))),
+            jax.device_put(np.stack(rvs),
+                           NamedSharding(self.mesh, P(None, "data"))),
+            jax.device_put(np.stack(hts),
+                           NamedSharding(self.mesh, P(None, None, "data"))),
+            len(hbs))
+
+    def scan_a(self, state: Pytree, sb: "StackedBatch") -> Pytree:
+        """Fold ``sb.n_batches`` staged batches in one compiled dispatch."""
+        return self._scan_a(state, sb.xts, sb.row_valids, sb.hllts)
+
     def put_replicated(self, arr, dtype=None):
         """Place a small constant (e.g. histogram lo/hi/mean) once, so the
         per-step calls do not re-transfer it.  Device arrays pass through
@@ -157,13 +198,15 @@ class MeshRunner:
         mesh, seed = self.mesh, self.seed
         approx_topk = self.approx_topk
 
-        def local_step_a(state, xt, row_valid, hllt):
-            s = _unstack(state)
+        def step_a_core(s, xt, row_valid, hllt):
+            """One batch folded into an UNSTACKED per-device state — shared
+            by the single-batch program and the multi-batch lax.scan
+            program (which amortizes per-dispatch latency)."""
             x = xt.T
             key = jax.random.fold_in(
                 jax.random.fold_in(jax.random.key(seed), s["step"]),
                 jax.lax.axis_index("data"))
-            out = {
+            return {
                 "mom": moments.update(s["mom"], x, row_valid),
                 "corr": corr.update(s["corr"], x, row_valid),
                 "qs": quantiles.update(s["qs"], x, row_valid, key,
@@ -171,6 +214,15 @@ class MeshRunner:
                 "hll": hll.update(s["hll"], hllt.T),
                 "step": s["step"] + 1,
             }
+
+        def local_step_a(state, xt, row_valid, hllt):
+            return _restack(step_a_core(_unstack(state), xt, row_valid, hllt))
+
+        def local_scan_a(state, xts, row_valids, hllts):
+            def body(carry, inp):
+                return step_a_core(carry, *inp), None
+            out, _ = jax.lax.scan(
+                body, _unstack(state), (xts, row_valids, hllts))
             return _restack(out)
 
         use_pallas = self.use_pallas
@@ -280,6 +332,12 @@ class MeshRunner:
         self._step_a = jax.jit(shard_map(
             local_step_a, mesh=mesh,
             in_specs=(state_spec, cols_rows_spec, rows_spec, cols_rows_spec),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
+        self._scan_a = jax.jit(shard_map(
+            local_scan_a, mesh=mesh,
+            in_specs=(state_spec, P(None, None, "data"), P(None, "data"),
+                      P(None, None, "data")),
             out_specs=state_spec, check_vma=False),
             donate_argnums=(0,))
         self._step_b = jax.jit(shard_map(
