@@ -95,8 +95,8 @@ def main() -> None:
     print(json.dumps({
         "metric": "fused_profile_scan_rows_per_sec_per_chip",
         "value": round(rows_per_sec_per_chip, 1),
-        "unit": (f"rows/s/chip ({N_COLS} f32 cols: "
-                 f"moments+quantile-sketch+pearson, HBM-staged batches)"),
+        "unit": (f"rows/s/chip ({N_COLS} f32 cols: fused moments+minmax+"
+                 f"counts+pearson-gram pass, HBM-staged batches)"),
         "vs_baseline": round(rows_per_sec_per_chip
                              / TARGET_ROWS_PER_SEC_PER_CHIP, 3),
     }))

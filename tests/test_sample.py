@@ -1,0 +1,119 @@
+"""Host-side row sampler (ingest/sample.py): exactness when n <= K,
+merge law, priority-threshold correctness, rank-error bounds."""
+
+import numpy as np
+import pytest
+
+from tpuprof.ingest.sample import RowSampler
+
+
+def _feed(sampler, x, batch=256):
+    for start in range(0, x.shape[0], batch):
+        chunk = x[start:start + batch]
+        sampler.update(chunk, chunk.shape[0])
+
+
+def test_exact_when_small():
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (500, 3)).astype(np.float32)
+    s = RowSampler(k=1024, n_num=3)
+    _feed(s, x)
+    q = s.quantiles([0.25, 0.5, 0.75])
+    expect = np.quantile(x.astype(np.float64), [0.25, 0.5, 0.75], axis=0)
+    np.testing.assert_allclose(q, expect, rtol=1e-6)
+
+
+def test_rank_error_bound():
+    rng = np.random.default_rng(1)
+    n, k = 200_000, 4096
+    x = rng.lognormal(0, 1, (n, 1)).astype(np.float32)
+    s = RowSampler(k=k, n_num=1)
+    _feed(s, x, batch=8192)
+    assert s.prio.size == k
+    for p in (0.05, 0.5, 0.95):
+        est = s.quantiles([p])[0, 0]
+        rank = (x[:, 0] <= est).mean()
+        assert abs(rank - p) < 5.0 / np.sqrt(k)    # ~5 sigma
+
+
+def test_merge_law_equals_single_stream():
+    """merge(sample(A), sample(B)) keeps exactly the global top-K
+    priorities — identical kept set to a sampler that saw A then B with
+    the same RNG streams."""
+    rng = np.random.default_rng(2)
+    xa = rng.normal(0, 1, (3000, 2)).astype(np.float32)
+    xb = rng.normal(5, 2, (4000, 2)).astype(np.float32)
+    k = 512
+    sa = RowSampler(k=k, n_num=2, seed=7, process_index=0)
+    sb = RowSampler(k=k, n_num=2, seed=7, process_index=1)
+    _feed(sa, xa)
+    _feed(sb, xb)
+    merged = RowSampler(k=k, n_num=2, seed=7, process_index=0)
+    _feed(merged, xa)
+    sb2 = RowSampler(k=k, n_num=2, seed=7, process_index=1)
+    _feed(sb2, xb)
+    merged.merge(sb2)
+
+    ref = RowSampler(k=k, n_num=2, seed=7, process_index=0)
+    _feed(ref, xa)
+    ref2 = RowSampler(k=k, n_num=2, seed=7, process_index=1)
+    _feed(ref2, xb)
+    got = sa.merge(sb)
+    order = np.argsort(got.prio)
+    order2 = np.argsort(merged.prio)
+    np.testing.assert_array_equal(got.prio[order], merged.prio[order2])
+    np.testing.assert_array_equal(got.values[order], merged.values[order2])
+    assert got.prio.size == k
+
+
+def test_missing_and_inf_filtered_at_finalize():
+    x = np.array([[1.0, np.nan], [2.0, np.inf], [3.0, 7.0]],
+                 dtype=np.float32)
+    s = RowSampler(k=16, n_num=2)
+    s.update(x, 3)
+    vals, kept = s.columns()
+    assert kept[0].sum() == 3 and kept[1].sum() == 1
+    q = s.quantiles([0.5])
+    assert q[0, 0] == 2.0 and q[0, 1] == 7.0
+
+
+def test_padding_rows_never_sampled():
+    x = np.zeros((10, 1), dtype=np.float32)
+    x[5:] = 99.0                      # padding region
+    s = RowSampler(k=64, n_num=1)
+    s.update(x, 5)
+    vals, kept = s.columns()
+    assert kept.sum() == 5
+    assert not np.any(vals[kept] == 99.0)
+
+
+def test_threshold_filter_matches_naive_topk():
+    """The tau fast-path must keep exactly the top-K priorities overall."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, (50_000, 1)).astype(np.float32)
+    k = 256
+    s = RowSampler(k=k, n_num=1, seed=11)
+    _feed(s, x, batch=1024)
+    # reproduce all priorities independently
+    prios, rows = [], []
+    step = 0
+    for start in range(0, x.shape[0], 1024):
+        nrows = min(1024, x.shape[0] - start)
+        r = np.random.default_rng((11, 0, step)).random(nrows)
+        step += 1
+        prios.append(r)
+        rows.append(x[start:start + nrows])
+    allp = np.concatenate(prios)
+    allr = np.concatenate(rows)
+    top = np.argsort(allp)[-k:]
+    np.testing.assert_array_equal(np.sort(s.prio), np.sort(allp[top]))
+    np.testing.assert_array_equal(
+        np.sort(s.values[:, 0]), np.sort(allr[top, 0]))
+
+
+def test_sorted_padded_shapes():
+    s = RowSampler(k=8, n_num=2)
+    s.update(np.array([[1.0, np.nan]], dtype=np.float32), 1)
+    srt, kept = s.sorted_padded()
+    assert srt.shape == (2, 8) and kept.tolist() == [1, 0]
+    assert srt[0, 0] == 1.0 and np.isinf(srt[0, 1])

@@ -109,6 +109,12 @@ def test_checkpoint_shape_mismatch_rejected(tmp_path):
     prof.update(batches[0])
     path = str(tmp_path / "p.ckpt")
     prof.checkpoint(path)
+    # the quantile sample is host-side (its k travels inside the blob),
+    # so the shape guard is exercised via a device-state knob: the HLL
+    # register width
     with pytest.raises(ValueError, match="shape|mismatch"):
+        StreamingProfiler.restore(path, config=_cfg(hll_precision=7))
+    # the host sampler's k is guarded explicitly
+    with pytest.raises(ValueError, match="quantile_sketch_size"):
         StreamingProfiler.restore(
             path, config=_cfg(quantile_sketch_size=128))

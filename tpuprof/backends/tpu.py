@@ -30,6 +30,7 @@ are computed on the original 64-bit values host-side).
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -39,14 +40,27 @@ from tpuprof import schema
 from tpuprof.config import ProfilerConfig
 from tpuprof.ingest.arrow import (ArrowIngest, ColumnPlan, HostBatch,
                                   prepare_batch)
+from tpuprof.ingest.sample import RowSampler
 from tpuprof.kernels import corr as kcorr
 from tpuprof.kernels import hll as khll
 from tpuprof.kernels import moments as kmoments
-from tpuprof.kernels import quantiles as kquantiles
 from tpuprof.kernels import histogram as khistogram
 from tpuprof.kernels.topk import MisraGries
 from tpuprof.runtime.mesh import MeshRunner
 from tpuprof.utils.trace import log_event, phase_timer
+
+
+def estimate_shift(hb: HostBatch) -> np.ndarray:
+    """Per-column centering values from a prefix of the first batch (the
+    fused kernel's shift input — see kernels/fused.py).  Exactness does
+    not matter, only scale; all-missing columns center at 0."""
+    prefix = hb.x[: min(hb.nrows, 4096)]
+    if prefix.shape[0] == 0:
+        return np.zeros(prefix.shape[1], dtype=np.float32)
+    finite = np.isfinite(prefix)
+    cnt = finite.sum(axis=0)
+    sums = np.where(finite, prefix, 0.0).sum(axis=0)
+    return (sums / np.maximum(cnt, 1)).astype(np.float32)
 
 
 class HostAgg:
@@ -128,7 +142,9 @@ class TPUStatsBackend:
         import jax
 
         from tpuprof.runtime.distributed import (merge_host_aggs,
-                                                 merge_recount_arrays)
+                                                 merge_recount_arrays,
+                                                 merge_samplers,
+                                                 merge_shift_estimates)
         pshard = (jax.process_index(), jax.process_count())
         ingest = ArrowIngest(source, config.batch_rows, process_shard=pshard)
         plan = ingest.plan
@@ -141,27 +157,41 @@ class TPUStatsBackend:
         pad = runner.rows
 
         hostagg = HostAgg(plan, config)
-        state = runner.init_pass_a()
+        sampler = RowSampler(config.quantile_sketch_size, plan.n_num,
+                             seed=config.seed, process_index=pshard[0])
         with phase_timer("scan_a"):
-            for rb in ingest.raw_batches():
-                hb = prepare_batch(rb, plan, pad, config.hll_precision)
-                db = runner.put_batch(hb)      # async transfer starts now
-                state = runner.step_a(state, db)
-                hostagg.update(hb)             # overlaps the device step
+            # centering shift from the first batch's prefix — any value
+            # near the data scale conditions the f32 sums equally well.
+            # The estimate is agreed ACROSS hosts (deadlock-safe even for
+            # a host with an empty fragment stripe) so every device in
+            # the global mesh carries the same shift and the collective
+            # merge's rebase is exactly the identity.
+            batches = (prepare_batch(rb, plan, pad, config.hll_precision)
+                       for rb in ingest.raw_batches())
+            first_hb = next(batches, None)
+            shift = merge_shift_estimates(
+                estimate_shift(first_hb) if first_hb is not None else None)
+            state = runner.init_pass_a(shift)
+            if first_hb is not None:
+                for hb in itertools.chain((first_hb,), batches):
+                    db = runner.put_batch(hb)  # async transfer starts now
+                    state = runner.step_a(state, db)
+                    sampler.update(hb.x, hb.nrows)  # host-side, overlaps
+                    hostagg.update(hb)              # the device step
         with phase_timer("merge"):
             res_a = runner.finalize_a(state)
             # cross-host: device sketches already merged by the mesh
             # collectives; host-side aggregates ride one DCN gather
             hostagg = merge_host_aggs(hostagg)
+            sampler = merge_samplers(sampler)
         log_event("pass_a", rows=hostagg.n_rows, devices=runner.n_dev,
                   n_num=plan.n_num, n_hash=plan.n_hash)
 
         momf = kmoments.finalize(res_a["mom"])
         rho_all = kcorr.finalize(res_a["corr"])
         probes = list(config.quantile_probes)
-        quants = kquantiles.finalize(res_a["qs"], probes)
-        sample_vals = np.asarray(res_a["qs"]["values"], dtype=np.float64)
-        sample_kept = np.asarray(res_a["qs"]["prio"]) > -np.inf
+        quants = sampler.quantiles(probes)
+        sample_vals, sample_kept = sampler.columns()
         hll_est = khll.finalize(res_a["hll"])
 
         # ---- pass B: exact histograms + MAD + top-k recount --------------
@@ -184,11 +214,9 @@ class TPUStatsBackend:
             if config.spearman:
                 # rank transform through the pass-A sample CDF (+inf pads
                 # the unkept slots past every real value)
-                kept_counts = runner.put_replicated(
-                    sample_kept.sum(axis=1), dtype=np.int32)
-                sorted_sample = runner.put_replicated(np.sort(
-                    np.where(sample_kept, sample_vals, np.inf),
-                    axis=1), dtype=np.float32)
+                srt, kept_n = sampler.sorted_padded()
+                kept_counts = runner.put_replicated(kept_n, dtype=np.int32)
+                sorted_sample = runner.put_replicated(srt, dtype=np.float32)
                 spear_state = runner.init_spearman()
             with phase_timer("scan_b"):
                 for rb in ingest.raw_batches():

@@ -34,8 +34,10 @@ class ProfilerConfig:
 
     # ---- TPU runtime knobs ------------------------------------------------
     batch_rows: int = 1 << 16       # rows per Arrow batch fed to the device
-    quantile_sketch_size: int = 4096  # K: mergeable uniform-sample size per
-                                      # numeric column; rank error ~ 1/sqrt(K)
+    quantile_sketch_size: int = 4096  # K: uniform row-sample size shared by
+                                      # all numeric columns (ingest/sample.py);
+                                      # a column keeps ~K*(1-p_missing) finite
+                                      # values, rank error ~ 1/sqrt(kept)
     hll_precision: int = 11         # p: 2^p registers per column; rel. error
                                     # ~= 1.04 / sqrt(2^p) (~2.3% at p=11)
     topk_capacity: int = 4096       # Misra-Gries candidate capacity per CAT
@@ -50,10 +52,10 @@ class ProfilerConfig:
     use_pallas: Optional[bool] = None   # None = auto (on for real TPU):
                                         # dense pallas histogram kernel vs
                                         # XLA scatter-add
-    approx_topk: Optional[bool] = None  # None = auto (on for real TPU):
-                                        # lax.approx_max_k for the sample
-                                        # sketch's per-batch selection
-                                        # (unbiased; see kernels/quantiles)
+    use_fused: Optional[bool] = None    # None = auto (on for real TPU):
+                                        # single-read fused pallas pass A
+                                        # (kernels/fused.py) vs the
+                                        # per-kernel XLA formulation
 
     # ---- quantiles reported (reference: approxQuantile probes) ------------
     quantile_probes: Sequence[float] = (0.05, 0.25, 0.5, 0.75, 0.95)

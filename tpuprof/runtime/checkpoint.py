@@ -22,10 +22,11 @@ from typing import Any, Dict, Tuple
 import jax
 import numpy as np
 
-# v2: pass-A state gained the "step" RNG-counter leaf and HLL switched to
-# uint16 packed observations — v1 checkpoints neither restore nor merge
-# correctly, so they must be rejected at load time.
-FORMAT_VERSION = 2
+# v3: the quantile sample moved off-device (ingest/sample.RowSampler in
+# the host blob); the pass-A device state lost its "qs" and "step"
+# leaves.  v2 and earlier checkpoints neither restore nor merge
+# correctly, so they are rejected at load time.
+FORMAT_VERSION = 3
 
 
 def _flatten(tree: Any) -> Dict[str, np.ndarray]:

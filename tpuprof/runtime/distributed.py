@@ -83,6 +83,30 @@ def merge_host_aggs(hostagg):
     return merged
 
 
+def merge_shift_estimates(local_shift):
+    """Agree on ONE centering shift across hosts (mean of the hosts that
+    saw data; None if none did).  Every process MUST call this exactly
+    once before init_pass_a — a host whose fragment stripe is empty
+    passes None and still participates, so the collective cannot
+    deadlock.  A shared shift makes the device-state merge's rebase the
+    identity (runtime/mesh.init_pass_a)."""
+    parts = [p for p in allgather_objects(local_shift) if p is not None]
+    if not parts:
+        return None
+    return np.mean(np.stack(parts), axis=0).astype(np.float32)
+
+
+def merge_samplers(sampler):
+    """Merge every host's RowSampler (ingest/sample.py) into a complete
+    one — the host-side analogue of the device sketch collectives; the
+    bottom-k priority merge law makes the result order-independent."""
+    parts = allgather_objects(sampler)
+    merged = parts[0]
+    for other in parts[1:]:
+        merged = merged.merge(other)
+    return merged
+
+
 def merge_recount_arrays(counts_by_col):
     """Sum each host's exact pass-B recount vectors (candidate sets are
     identical on every host: they derive from the merged HostAgg)."""

@@ -32,7 +32,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpuprof.kernels import corr, histogram, hll, moments, quantiles
+from tpuprof.kernels import corr, fused, histogram, hll, moments
 
 Pytree = Any
 
@@ -88,10 +88,8 @@ class MeshRunner:
         self.rows = -(-config.batch_rows // self.n_dev) * self.n_dev
         self.n_num = n_num
         self.n_hash = n_hash
-        self.k = config.quantile_sketch_size
         self.precision = config.hll_precision
         self.bins = config.bins
-        self.seed = config.seed
         # dense pallas binning beats XLA's serialized scatter on real TPU;
         # the scatter path stays for CPU meshes and as an opt-out
         if config.use_pallas is None:
@@ -99,9 +97,10 @@ class MeshRunner:
                                and self.bins <= 128)
         else:
             self.use_pallas = config.use_pallas and self.bins <= 128
-        self.approx_topk = (devs[0].platform == "tpu"
-                            if config.approx_topk is None
-                            else config.approx_topk)
+        # fused single-read pallas pass A (kernels/fused.py) on real TPU;
+        # the per-kernel XLA formulation on CPU meshes
+        self.use_fused = (devs[0].platform == "tpu"
+                          if config.use_fused is None else config.use_fused)
         self._sh_rows = NamedSharding(self.mesh, P("data"))
         self._sh_cols_rows = NamedSharding(self.mesh, P(None, "data"))
         self._sh_rep = NamedSharding(self.mesh, P())
@@ -174,17 +173,31 @@ class MeshRunner:
 
     # -- state ------------------------------------------------------------
 
-    def init_pass_a(self) -> Pytree:
+    def init_pass_a(self, shift=None) -> Pytree:
+        """``shift``: optional (n_num,) centering values (the backend
+        estimates them from a prefix of the first batch).  With a shared
+        explicit shift every device accumulates about the same center and
+        the collective merge's rebase is exactly the identity; the fused
+        pallas path requires it for well-conditioned f32 sums.  Without
+        it the XLA path falls back to adapting each device's shift to its
+        first batch's means."""
+        if shift is None:
+            shift_arr = jnp.zeros((self.n_num,), dtype=jnp.float32)
+            set_flag = jnp.zeros((), dtype=jnp.int32)
+        else:
+            shift_arr = jnp.asarray(shift, dtype=jnp.float32)
+            set_flag = jnp.ones((), dtype=jnp.int32)
+
         def one_device(_):
+            mom = moments.init(self.n_num)
+            mom["shift"] = shift_arr
+            co = corr.init(self.n_num)
+            co["shift"] = shift_arr
+            co["set"] = set_flag
             return {
-                "mom": moments.init(self.n_num),
-                "corr": corr.init(self.n_num),
-                "qs": quantiles.init(self.n_num, self.k),
+                "mom": mom,
+                "corr": co,
                 "hll": hll.init(self.n_hash, self.precision),
-                # RNG step counter lives IN the carried state: no per-step
-                # host scalar transfer, and checkpoint/restore reproduces
-                # the same priority stream automatically
-                "step": jnp.zeros((), dtype=jnp.int32),
             }
         return jax.vmap(one_device)(jnp.arange(self.n_dev))
 
@@ -195,24 +208,22 @@ class MeshRunner:
     # -- compiled programs -------------------------------------------------
 
     def _build_programs(self) -> None:
-        mesh, seed = self.mesh, self.seed
-        approx_topk = self.approx_topk
+        mesh = self.mesh
+        use_fused = self.use_fused
 
         def step_a_core(s, xt, row_valid, hllt):
             """One batch folded into an UNSTACKED per-device state — shared
             by the single-batch program and the multi-batch lax.scan
             program (which amortizes per-dispatch latency)."""
-            x = xt.T
-            key = jax.random.fold_in(
-                jax.random.fold_in(jax.random.key(seed), s["step"]),
-                jax.lax.axis_index("data"))
+            if use_fused:
+                mom, co = fused.update(s["mom"], s["corr"], xt, row_valid)
+            else:
+                mom, co = fused.update_xla(s["mom"], s["corr"], xt,
+                                           row_valid)
             return {
-                "mom": moments.update(s["mom"], x, row_valid),
-                "corr": corr.update(s["corr"], x, row_valid),
-                "qs": quantiles.update(s["qs"], x, row_valid, key,
-                                       approx=approx_topk),
+                "mom": mom,
+                "corr": co,
                 "hll": hll.update(s["hll"], hllt.T),
-                "step": s["step"] + 1,
             }
 
         def local_step_a(state, xt, row_valid, hllt):
@@ -303,22 +314,11 @@ class MeshRunner:
 
             merged_corr = merge_corr_local(s["corr"], _common_shift)
 
-            # ---- sample sketch: gather every device's K candidates, keep
-            # the global top-K priorities (exactly the pairwise merge law)
-            vals = jax.lax.all_gather(s["qs"]["values"], "data", axis=0)
-            prio = jax.lax.all_gather(s["qs"]["prio"], "data", axis=0)
-            d, c, k = vals.shape
-            vals = jnp.moveaxis(vals, 0, 1).reshape(c, d * k)
-            prio = jnp.moveaxis(prio, 0, 1).reshape(c, d * k)
-            top_p, idx = jax.lax.top_k(prio, k)
-            merged_qs = {"values": jnp.take_along_axis(vals, idx, axis=1),
-                         "prio": top_p}
-
             # ---- HLL: registers are max-mergeable
             merged_hll = jax.lax.pmax(s["hll"], "data")
 
             return _restack({"mom": merged_mom, "corr": merged_corr,
-                             "qs": merged_qs, "hll": merged_hll})
+                             "hll": merged_hll})
 
         def local_merge_b(state):
             return _restack(jax.tree.map(
@@ -368,8 +368,8 @@ class MeshRunner:
     def step_a(self, state: Pytree, hb, step_idx: int = 0) -> Pytree:
         """Fold one batch (HostBatch or pre-placed DeviceBatch).
 
-        ``step_idx`` is accepted for caller convenience but the RNG stream
-        position is carried in the state itself (see ``init_pass_a``)."""
+        ``step_idx`` is accepted for caller convenience (cursor-style
+        loops); the update itself is deterministic and order-free."""
         db = self._as_device(hb)
         return self._step_a(state, db.xt, db.row_valid, db.hllt)
 

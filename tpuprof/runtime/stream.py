@@ -23,10 +23,10 @@ import pyarrow as pa
 
 from tpuprof.config import ProfilerConfig
 from tpuprof.ingest.arrow import ColumnPlan, prepare_batch
+from tpuprof.ingest.sample import RowSampler
 from tpuprof.kernels import corr as kcorr
 from tpuprof.kernels import hll as khll
 from tpuprof.kernels import moments as kmoments
-from tpuprof.kernels import quantiles as kquantiles
 from tpuprof.runtime import checkpoint as ckpt
 from tpuprof.runtime.mesh import MeshRunner
 from tpuprof.utils.trace import log_event
@@ -77,7 +77,11 @@ class StreamingProfiler:
                                  self.plan.n_hash, devices=devices)
         from tpuprof.backends.tpu import HostAgg
         self.hostagg = HostAgg(self.plan, self.config)
-        self.state = self.runner.init_pass_a()
+        self.sampler = RowSampler(self.config.quantile_sketch_size,
+                                  self.plan.n_num, seed=self.config.seed)
+        # device state is created on the first micro-batch so the fused
+        # kernel's centering shift can come from real data
+        self.state = None
         self.cursor = 0                      # micro-batches folded in
         self._sample: Optional[pd.DataFrame] = None
 
@@ -113,7 +117,11 @@ class StreamingProfiler:
                 chunk = rb.slice(start, self.runner.rows)
                 hb = prepare_batch(chunk, self.plan, self.runner.rows,
                                    self.config.hll_precision)
+                if self.state is None:
+                    from tpuprof.backends.tpu import estimate_shift
+                    self.state = self.runner.init_pass_a(estimate_shift(hb))
                 self.state = self.runner.step_a(self.state, hb, self.cursor)
+                self.sampler.update(hb.x, hb.nrows)
                 self.hostagg.update(hb)
                 self.cursor += 1
         log_event("stream_update", cursor=self.cursor,
@@ -126,16 +134,17 @@ class StreamingProfiler:
         from tpuprof.backends.tpu import _assemble, _empty_stats
         if not self.plan.specs:
             return _empty_stats(self.config)
-        res = self.runner.finalize_a(self.state)
+        state = self.state if self.state is not None \
+            else self.runner.init_pass_a()
+        res = self.runner.finalize_a(state)
         momf = kmoments.finalize(res["mom"])
         probes = list(self.config.quantile_probes)
+        sample_vals, sample_kept = self.sampler.columns()
         return _assemble(
             self.plan, self.config,
             self._sample if self._sample is not None else pd.DataFrame(),
             self.hostagg, momf, kcorr.finalize(res["corr"]),
-            kquantiles.finalize(res["qs"], probes),
-            np.asarray(res["qs"]["values"], dtype=np.float64),
-            np.asarray(res["qs"]["prio"]) > -np.inf,
+            self.sampler.quantiles(probes), sample_vals, sample_kept,
             khll.finalize(res["hll"]), None, None, None, probes)
 
     def report_html(self) -> str:
@@ -148,6 +157,7 @@ class StreamingProfiler:
         """Persist (device state, host aggregators, cursor) atomically."""
         host_blob = {
             "hostagg": self.hostagg,
+            "sampler": self.sampler,
             "sample": self._sample,
             "schema": self.arrow_schema.serialize().to_pybytes(),
         }
@@ -155,6 +165,7 @@ class StreamingProfiler:
         ckpt.save(path, self.state, host_blob, self.cursor,
                   meta={"n_num": self.plan.n_num, "n_hash": self.plan.n_hash,
                         "batch_rows": self.config.batch_rows,
+                        "has_state": self.state is not None,
                         # HLL registers only merge with same-impl hashes
                         "native_hash": native.available()})
 
@@ -174,10 +185,20 @@ class StreamingProfiler:
                 "not merge consistently")
         arrow_schema = pa.ipc.read_schema(pa.py_buffer(host_blob["schema"]))
         prof = cls(arrow_schema, config=config, devices=devices)
-        # leave leaves as host numpy (uncommitted): the first sharded step
-        # places them onto the mesh exactly like freshly-init'd state
-        prof.state = ckpt.materialize(payload, prof.state)
+        if payload["meta"].get("has_state", True):
+            # leave leaves as host numpy (uncommitted): the first sharded
+            # step places them onto the mesh like freshly-init'd state
+            prof.state = ckpt.materialize(payload,
+                                          prof.runner.init_pass_a())
         prof.hostagg = host_blob["hostagg"]
+        saved_sampler = host_blob["sampler"]
+        if saved_sampler.k != prof.config.quantile_sketch_size:
+            raise ValueError(
+                f"checkpoint sampler has k={saved_sampler.k} but config "
+                f"requests quantile_sketch_size="
+                f"{prof.config.quantile_sketch_size} — the sample cannot "
+                "be re-sized after the fact")
+        prof.sampler = saved_sampler
         prof._sample = host_blob["sample"]
         prof.cursor = payload["cursor"]
         return prof
