@@ -66,3 +66,37 @@ def test_fallback_when_native_absent(monkeypatch):
     dvals = np.array(["a", "b"], dtype=object)
     out = ia._hash64_dictionary(pa.array(["a", "b"]), dvals)
     assert out.dtype == np.uint64 and len(np.unique(out)) == 2
+
+
+@requires_native
+def test_hll_update_native_matches_device_path():
+    """The native host fold must be bit-identical to kernels/hll.update."""
+    import jax.numpy as jnp
+    from tpuprof.kernels import hll as khll
+    rng = np.random.default_rng(7)
+    rows, cols, p = 4096, 5, 8
+    h64 = rng.integers(0, 1 << 64, (rows, cols), dtype=np.uint64)
+    valid = rng.random((rows, cols)) < 0.9
+    packed = khll.pack(h64, valid, p)
+    dev = np.asarray(khll.update(khll.init(cols, p), jnp.asarray(packed)))
+    host = khll.HostRegisters(cols, p)
+    host.update(packed, rows)
+    np.testing.assert_array_equal(host.regs, dev)
+    # F-order plane (ingest layout) walks via strides, same result
+    host_f = khll.HostRegisters(cols, p)
+    host_f.update(np.asfortranarray(packed), rows)
+    np.testing.assert_array_equal(host_f.regs, dev)
+
+
+def test_hll_host_numpy_fallback(monkeypatch):
+    from tpuprof.kernels import hll as khll
+    monkeypatch.setattr(native, "hll_update", lambda regs, packed: False)
+    rng = np.random.default_rng(8)
+    rows, cols, p = 512, 3, 6
+    h64 = rng.integers(0, 1 << 64, (rows, cols), dtype=np.uint64)
+    packed = khll.pack(h64, np.ones((rows, cols), bool), p)
+    import jax.numpy as jnp
+    dev = np.asarray(khll.update(khll.init(cols, p), jnp.asarray(packed)))
+    host = khll.HostRegisters(cols, p)
+    host.update(packed, rows)
+    np.testing.assert_array_equal(host.regs, dev)

@@ -34,10 +34,15 @@ def test_matches_reference(rows, cols, nbins):
     x[rng.random((rows, cols)) < 0.01] = np.inf
     lo = np.nanmin(np.where(np.isinf(x), np.nan, x), axis=0)
     hi = np.nanmax(np.where(np.isinf(x), np.nan, x), axis=0)
-    got = np.asarray(pallas_hist.histogram_tiles(
-        jnp.asarray(x), jnp.asarray(lo), jnp.asarray(hi), nbins,
-        interpret=True))
-    np.testing.assert_array_equal(got, _reference(x, lo, hi, nbins))
+    mean = np.nanmean(np.where(np.isinf(x), np.nan, x), axis=0)
+    got, dev = pallas_hist.histogram_tiles(
+        jnp.asarray(x), jnp.asarray(lo), jnp.asarray(hi),
+        jnp.asarray(mean), nbins, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  _reference(x, lo, hi, nbins))
+    masked = np.where(np.isfinite(x), x, np.nan)
+    expect_dev = np.nansum(np.abs(masked - mean[None, :]), axis=0)
+    np.testing.assert_allclose(np.asarray(dev), expect_dev, rtol=1e-5)
 
 
 def test_matches_xla_scatter_path():
@@ -55,14 +60,17 @@ def test_matches_xla_scatter_path():
         histogram.init(cols, nbins), jnp.asarray(x), jnp.asarray(row_valid),
         jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(mean))
     scatter_counts = np.asarray(state["counts"])
-    pallas_counts = np.asarray(pallas_hist.histogram_batch(
+    pallas_counts, pallas_dev = pallas_hist.histogram_batch(
         jnp.asarray(x), jnp.asarray(row_valid), jnp.asarray(lo),
-        jnp.asarray(hi), nbins, interpret=True))
-    np.testing.assert_array_equal(pallas_counts, scatter_counts)
+        jnp.asarray(hi), jnp.asarray(mean), nbins, interpret=True)
+    np.testing.assert_array_equal(np.asarray(pallas_counts),
+                                  scatter_counts)
+    np.testing.assert_allclose(np.asarray(pallas_dev),
+                               np.asarray(state["abs_dev"]), rtol=1e-5)
 
 
 def test_rejects_too_many_bins():
     with pytest.raises(ValueError, match="bins"):
         pallas_hist.histogram_tiles(
-            jnp.zeros((8, 2)), jnp.zeros(2), jnp.ones(2), 200,
-            interpret=True)
+            jnp.zeros((8, 2)), jnp.zeros(2), jnp.ones(2), jnp.zeros(2),
+            200, interpret=True)

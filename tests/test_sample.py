@@ -38,8 +38,7 @@ def test_rank_error_bound():
 
 def test_merge_law_equals_single_stream():
     """merge(sample(A), sample(B)) keeps exactly the global top-K
-    priorities — identical kept set to a sampler that saw A then B with
-    the same RNG streams."""
+    priorities, independent of merge association order."""
     rng = np.random.default_rng(2)
     xa = rng.normal(0, 1, (3000, 2)).astype(np.float32)
     xb = rng.normal(5, 2, (4000, 2)).astype(np.float32)
@@ -48,17 +47,15 @@ def test_merge_law_equals_single_stream():
     sb = RowSampler(k=k, n_num=2, seed=7, process_index=1)
     _feed(sa, xa)
     _feed(sb, xb)
-    merged = RowSampler(k=k, n_num=2, seed=7, process_index=0)
-    _feed(merged, xa)
-    sb2 = RowSampler(k=k, n_num=2, seed=7, process_index=1)
-    _feed(sb2, xb)
-    merged.merge(sb2)
-
-    ref = RowSampler(k=k, n_num=2, seed=7, process_index=0)
-    _feed(ref, xa)
-    ref2 = RowSampler(k=k, n_num=2, seed=7, process_index=1)
-    _feed(ref2, xb)
     got = sa.merge(sb)
+
+    # same streams, opposite merge direction
+    merged = RowSampler(k=k, n_num=2, seed=7, process_index=1)
+    _feed(merged, xb)
+    sa2 = RowSampler(k=k, n_num=2, seed=7, process_index=0)
+    _feed(sa2, xa)
+    merged.merge(sa2)
+
     order = np.argsort(got.prio)
     order2 = np.argsort(merged.prio)
     np.testing.assert_array_equal(got.prio[order], merged.prio[order2])

@@ -159,6 +159,15 @@ class TPUStatsBackend:
         hostagg = HostAgg(plan, config)
         sampler = RowSampler(config.quantile_sketch_size, plan.n_num,
                              seed=config.seed, process_index=pshard[0])
+        # HLL registers fold on host when the native extension is usable
+        # on EVERY process (register merges must mix like with like);
+        # otherwise the packed plane ships to the device scatter path
+        from tpuprof import native
+        from tpuprof.runtime.distributed import allgather_objects
+        use_host_hll = plan.n_hash > 0 and all(
+            allgather_objects(native.available()))
+        host_hll = khll.HostRegisters(plan.n_hash, config.hll_precision) \
+            if use_host_hll else None
         with phase_timer("scan_a"):
             # centering shift from the first batch's prefix — any value
             # near the data scale conditions the f32 sums equally well.
@@ -174,10 +183,13 @@ class TPUStatsBackend:
             state = runner.init_pass_a(shift)
             if first_hb is not None:
                 for hb in itertools.chain((first_hb,), batches):
-                    db = runner.put_batch(hb)  # async transfer starts now
-                    state = runner.step_a(state, db)
-                    sampler.update(hb.x, hb.nrows)  # host-side, overlaps
-                    hostagg.update(hb)              # the device step
+                    db = runner.put_batch(hb, with_hll=host_hll is None)
+                    state = runner.step_a(state, db)  # transfer is async —
+                    # the host-side folds below overlap the device step
+                    sampler.update(hb.x, hb.nrows)
+                    if host_hll is not None:
+                        host_hll.update(hb.hll, hb.nrows)
+                    hostagg.update(hb)
         with phase_timer("merge"):
             res_a = runner.finalize_a(state)
             # cross-host: device sketches already merged by the mesh
@@ -192,7 +204,11 @@ class TPUStatsBackend:
         probes = list(config.quantile_probes)
         quants = sampler.quantiles(probes)
         sample_vals, sample_kept = sampler.columns()
-        hll_est = khll.finalize(res_a["hll"])
+        if host_hll is not None:
+            from tpuprof.runtime.distributed import merge_hll_registers
+            hll_est = khll.finalize(merge_hll_registers(host_hll).regs)
+        else:
+            hll_est = khll.finalize(res_a["hll"])
 
         # ---- pass B: exact histograms + MAD + top-k recount --------------
         hists: Optional[List] = None

@@ -50,7 +50,10 @@ from tpuprof.kernels import moments as kmoments
 Array = jnp.ndarray
 
 R_TILE = 1024          # lane-axis (row) tile
-C_ALIGN = 128          # sublane-axis (column) padding multiple
+C_ALIGN = 8            # sublane-axis (column) padding multiple — the f32
+                       # min sublane tile; 128 alignment is only required
+                       # on the LANE axis, so typical column counts
+                       # (e.g. 200) need no padding copy at all
 _HI = jax.lax.Precision.HIGHEST
 
 

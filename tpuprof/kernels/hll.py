@@ -66,6 +66,11 @@ def update(regs: Array, packed: Array) -> Array:
     precision than the registers were allocated for) are routed to the
     spill slot rather than scattered into neighboring columns."""
     n_cols, m = regs.shape
+    if n_cols == 0 or packed.shape[1] == 0:
+        # empty observation plane: hash columns absent, or the fold
+        # happens host-side this run (kernels/hll.HostRegisters) and the
+        # plane was never shipped
+        return regs
     p32 = packed.astype(jnp.int32)
     idx = p32 >> RHO_BITS
     rho = p32 & RHO_MAX
@@ -79,6 +84,45 @@ def update(regs: Array, packed: Array) -> Array:
 
 def merge(a: Array, b: Array) -> Array:
     return jnp.maximum(a, b)
+
+
+class HostRegisters:
+    """Host-side HLL registers, updated while the packed observations are
+    still in host RAM (via the native C++ fold — tpuprof/native).
+
+    Exists because on the target device the register scatter-max is the
+    XLA op that serializes (measured ~37ms/batch at 24 hash columns),
+    and the observations originate host-side anyway (hashing happens at
+    Arrow decode, SURVEY §7.2).  With host registers the packed plane is
+    never shipped to the device at all.  Register contents are
+    BIT-IDENTICAL to the device path — same packed format, same max
+    fold — so estimates, checkpoints and merges are interchangeable.
+
+    ``update`` uses the native library when available and a numpy
+    fallback otherwise (slow but correct — only reached when a
+    checkpoint written with host registers is restored in a process
+    whose toolchain cannot build the extension)."""
+
+    def __init__(self, n_cols: int, precision: int):
+        self.regs = np.zeros((n_cols, 1 << precision), dtype=np.int32)
+
+    def update(self, packed: np.ndarray, nrows: int) -> None:
+        from tpuprof import native
+        obs = packed[:nrows]
+        if obs.size == 0:
+            return
+        if not native.hll_update(self.regs, obs):
+            p32 = obs.astype(np.int32)
+            idx = p32 >> RHO_BITS
+            rho = p32 & RHO_MAX
+            m = self.regs.shape[1]
+            for c in range(self.regs.shape[0]):
+                ok = (p32[:, c] != 0) & (idx[:, c] < m)
+                np.maximum.at(self.regs[c], idx[ok, c], rho[ok, c])
+
+    def merge(self, other: "HostRegisters") -> "HostRegisters":
+        np.maximum(self.regs, other.regs, out=self.regs)
+        return self
 
 
 def finalize(regs) -> "object":

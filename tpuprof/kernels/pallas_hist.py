@@ -34,11 +34,13 @@ C_TILE = 128
 BINS_PAD = 128          # lane width; bins <= BINS_PAD
 
 
-def _hist_kernel(x_ref, lo_ref, scale_ref, out_ref, *, nbins: int):
+def _hist_kernel(x_ref, lo_ref, scale_ref, mean_ref, out_ref, dev_ref, *,
+                 nbins: int):
     i = pl.program_id(1)                      # row tile (fastest)
     x = x_ref[...]                            # (R_TILE, C_TILE)
     lo = lo_ref[...]                          # (1, C_TILE)
     scale = scale_ref[...]                    # (1, C_TILE)
+    mean = mean_ref[...]                      # (1, C_TILE)
     finite = jnp.isfinite(x)
     idx = jnp.floor((x - lo) * scale)
     idx = jnp.clip(idx, 0, nbins - 1).astype(jnp.int32)
@@ -50,23 +52,33 @@ def _hist_kernel(x_ref, lo_ref, scale_ref, out_ref, *, nbins: int):
     counts = jnp.stack(cols, axis=1)          # (C_TILE, nbins)
     counts = jnp.pad(counts, ((0, 0), (0, BINS_PAD - nbins)))
 
+    # MAD numerator rides the same read: Σ|x − mean| over finite values
+    # (a separate XLA reduction measured as expensive as the histogram
+    # itself on the target device)
+    dev = jnp.sum(jnp.where(finite, jnp.abs(x - mean), 0.0),
+                  axis=0)[:, None]            # (C_TILE, 1)
+
     @pl.when(i == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
+        dev_ref[...] = jnp.zeros_like(dev_ref)
 
     out_ref[...] += counts
+    dev_ref[...] += dev
 
 
 @functools.partial(jax.jit,
                    static_argnames=("nbins", "interpret"))
 def histogram_tiles(x: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
-                    nbins: int, interpret: bool = False) -> jnp.ndarray:
-    """(rows, cols) f32 (NaN = skip) → (cols, nbins) int32 counts.
+                    mean: jnp.ndarray, nbins: int,
+                    interpret: bool = False):
+    """(rows, cols) f32 (NaN = skip) → ((cols, nbins) int32 counts,
+    (cols,) f32 Σ|x−mean|).
 
     ``lo``/``hi`` are per-column finite ranges (pass-A min/max); values
     land in ``clip(floor((x-lo)/(hi-lo)*nbins), 0, nbins-1)`` — identical
     semantics to kernels/histogram.py and np.histogram's inclusive last
-    edge."""
+    edge.  ``mean`` is the pass-A mean feeding the exact-MAD numerator."""
     if nbins > BINS_PAD:
         raise ValueError(f"pallas histogram supports bins <= {BINS_PAD}")
     rows, cols = x.shape
@@ -76,27 +88,35 @@ def histogram_tiles(x: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
     lo_p = jnp.pad(lo.astype(jnp.float32), (0, cpad))[None, :]
     width = jnp.maximum(hi - lo, 1e-30).astype(jnp.float32)
     scale_p = jnp.pad(nbins / width, (0, cpad))[None, :]
+    mean_p = jnp.pad(mean.astype(jnp.float32), (0, cpad))[None, :]
 
     n_ct = (cols + cpad) // C_TILE
     n_rt = (rows + rpad) // R_TILE
-    out = pl.pallas_call(
+    counts, dev = pl.pallas_call(
         functools.partial(_hist_kernel, nbins=nbins),
         grid=(n_ct, n_rt),
         in_specs=[
             pl.BlockSpec((R_TILE, C_TILE), lambda j, i: (i, j)),
             pl.BlockSpec((1, C_TILE), lambda j, i: (0, j)),
             pl.BlockSpec((1, C_TILE), lambda j, i: (0, j)),
+            pl.BlockSpec((1, C_TILE), lambda j, i: (0, j)),
         ],
-        out_specs=pl.BlockSpec((C_TILE, BINS_PAD), lambda j, i: (j, 0)),
-        out_shape=jax.ShapeDtypeStruct((cols + cpad, BINS_PAD), jnp.int32),
+        out_specs=[
+            pl.BlockSpec((C_TILE, BINS_PAD), lambda j, i: (j, 0)),
+            pl.BlockSpec((C_TILE, 1), lambda j, i: (j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((cols + cpad, BINS_PAD), jnp.int32),
+            jax.ShapeDtypeStruct((cols + cpad, 1), jnp.float32),
+        ],
         interpret=interpret,
-    )(x, lo_p, scale_p)
-    return out[:cols, :nbins]
+    )(x, lo_p, scale_p, mean_p)
+    return counts[:cols, :nbins], dev[:cols, 0]
 
 
-def histogram_batch(x, row_valid, lo, hi, nbins: int,
-                    interpret: bool = False) -> jnp.ndarray:
+def histogram_batch(x, row_valid, lo, hi, mean, nbins: int,
+                    interpret: bool = False):
     """Batch entry point matching kernels/histogram.update semantics:
-    padding rows masked via ``row_valid``."""
+    padding rows masked via ``row_valid``; returns (counts, abs_dev)."""
     x = jnp.where(row_valid[:, None], x, jnp.nan)
-    return histogram_tiles(x, lo, hi, nbins, interpret=interpret)
+    return histogram_tiles(x, lo, hi, mean, nbins, interpret=interpret)

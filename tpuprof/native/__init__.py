@@ -56,6 +56,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tpuprof_hash_bytes.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_size_t]
+        lib.tpuprof_hll_update.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_ssize_t, ctypes.c_ssize_t, ctypes.c_void_p,
+            ctypes.c_size_t]
         _lib = lib
         return _lib
 
@@ -73,6 +77,24 @@ def hash_u64_array(bits: np.ndarray) -> Optional[np.ndarray]:
     out = np.empty(bits.shape, dtype=np.uint64)
     lib.tpuprof_hash_u64(bits.ctypes.data, out.ctypes.data, bits.size)
     return out
+
+
+def hll_update(regs: np.ndarray, packed: np.ndarray) -> bool:
+    """Fold a (rows, cols) uint16 packed-observation plane into
+    (cols, m) int32 HLL registers in place; False if native is
+    unavailable (caller falls back to the device scatter or numpy)."""
+    lib = _load()
+    if lib is None:
+        return False
+    assert regs.dtype == np.int32 and regs.flags.c_contiguous
+    packed = packed if packed.dtype == np.uint16 else \
+        packed.astype(np.uint16)
+    n_rows, n_cols = packed.shape
+    assert regs.shape[0] == n_cols
+    rs, cs = (s // packed.itemsize for s in packed.strides)
+    lib.tpuprof_hll_update(packed.ctypes.data, n_rows, n_cols, rs, cs,
+                           regs.ctypes.data, regs.shape[1])
+    return True
 
 
 def hash_string_dictionary(arr) -> Optional[np.ndarray]:

@@ -129,4 +129,27 @@ void tpuprof_hash_bytes(const uint8_t* data, const int64_t* offsets,
   }
 }
 
+// Fold packed HLL observations into registers on the host: each cell is
+// (idx << 5) | rho in a uint16 (0 = null/padding — kernels/hll.pack);
+// regs is (n_cols x m) int32 row-major, updated in place with
+// regs[c][idx] = max(regs[c][idx], rho).  Strides are in ELEMENTS so
+// both C- and F-order observation planes walk without a copy.  Exactly
+// the semantics of the device scatter path (kernels/hll.update) — the
+// two must agree bit-for-bit for checkpoints and merges to mix.
+void tpuprof_hll_update(const uint16_t* packed, size_t n_rows,
+                        size_t n_cols, ptrdiff_t row_stride,
+                        ptrdiff_t col_stride, int32_t* regs, size_t m) {
+  for (size_t c = 0; c < n_cols; ++c) {
+    int32_t* r = regs + c * m;
+    const uint16_t* p = packed + static_cast<ptrdiff_t>(c) * col_stride;
+    for (size_t i = 0; i < n_rows; ++i) {
+      const uint16_t v = p[static_cast<ptrdiff_t>(i) * row_stride];
+      if (!v) continue;
+      const uint32_t idx = v >> 5;
+      const int32_t rho = v & 31;
+      if (idx < m && rho > r[idx]) r[idx] = rho;
+    }
+  }
+}
+
 }  // extern "C"

@@ -107,6 +107,16 @@ def merge_samplers(sampler):
     return merged
 
 
+def merge_hll_registers(host_hll):
+    """Elementwise-max every host's HLL registers (kernels/hll.py
+    HostRegisters) — same law as the device pmax merge, over DCN."""
+    parts = allgather_objects(host_hll)
+    merged = parts[0]
+    for other in parts[1:]:
+        merged = merged.merge(other)
+    return merged
+
+
 def merge_recount_arrays(counts_by_col):
     """Sum each host's exact pass-B recount vectors (candidate sets are
     identical on every host: they derive from the merged HostAgg)."""

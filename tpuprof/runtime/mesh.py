@@ -108,13 +108,9 @@ class MeshRunner:
 
     # -- explicit host->device placement ------------------------------------
 
-    def put_batch(self, hb, with_hll: bool = True) -> DeviceBatch:
-        """Ship a HostBatch to the mesh with explicit shardings (async —
-        returns immediately; the transfer overlaps host work).
-
-        ``with_hll=False`` skips the packed-HLL plane — pass B and the
-        spearman pass never read it, and for wide categorical tables it
-        is a large share of the transfer volume."""
+    def _host_views(self, hb, with_hll: bool):
+        """(xt, row_valid, hllt) host views of one batch — zero-copy when
+        ingest delivered its F-order buffers."""
         x = hb.x
         h = hb.hll if with_hll else hb.hll[:, :0]
         if with_hll and self.n_hash and hb.hll_precision != self.precision:
@@ -124,7 +120,17 @@ class MeshRunner:
                 "mismatched index would scatter into neighboring columns")
         xt = x.T if x.flags.f_contiguous else np.ascontiguousarray(x.T)
         ht = h.T if h.flags.f_contiguous else np.ascontiguousarray(h.T)
-        rv = np.ascontiguousarray(hb.row_valid)
+        return xt, np.ascontiguousarray(hb.row_valid), ht
+
+    def put_batch(self, hb, with_hll: bool = True) -> DeviceBatch:
+        """Ship a HostBatch to the mesh with explicit shardings (async —
+        returns immediately; the transfer overlaps host work).
+
+        ``with_hll=False`` skips the packed-HLL plane — pass B, the
+        spearman pass and host-side register folds never read it, and
+        for wide categorical tables it is a large share of the transfer
+        volume."""
+        xt, rv, ht = self._host_views(hb, with_hll)
         return DeviceBatch(
             jax.device_put(xt, self._sh_cols_rows),
             jax.device_put(rv, self._sh_rows),
@@ -134,26 +140,14 @@ class MeshRunner:
         """Ship several HostBatches as ONE stacked placement so they can be
         folded by a single ``scan_a`` dispatch.  Multi-batch dispatch exists
         because per-program dispatch latency (~15ms through a tunneled
-        device) would otherwise dominate the fused step's ~1ms of compute."""
-        xts, rvs, hts = [], [], []
-        for hb in hbs:
-            x = hb.x
-            h = hb.hll if with_hll else hb.hll[:, :0]
-            if with_hll and self.n_hash and hb.hll_precision != self.precision:
-                raise ValueError(
-                    f"batch packed with hll_precision={hb.hll_precision} but "
-                    f"runner registers use precision={self.precision}")
-            xts.append(x.T if x.flags.f_contiguous
-                       else np.ascontiguousarray(x.T))
-            hts.append(h.T if h.flags.f_contiguous
-                       else np.ascontiguousarray(h.T))
-            rvs.append(np.ascontiguousarray(hb.row_valid))
+        device) would otherwise dominate the fused step's compute."""
+        views = [self._host_views(hb, with_hll) for hb in hbs]
         return StackedBatch(
-            jax.device_put(np.stack(xts),
+            jax.device_put(np.stack([v[0] for v in views]),
                            NamedSharding(self.mesh, P(None, None, "data"))),
-            jax.device_put(np.stack(rvs),
+            jax.device_put(np.stack([v[1] for v in views]),
                            NamedSharding(self.mesh, P(None, "data"))),
-            jax.device_put(np.stack(hts),
+            jax.device_put(np.stack([v[2] for v in views]),
                            NamedSharding(self.mesh, P(None, None, "data"))),
             len(hbs))
 
@@ -243,11 +237,8 @@ class MeshRunner:
             x = xt.T
             if use_pallas:
                 from tpuprof.kernels import pallas_hist
-                counts = pallas_hist.histogram_batch(
-                    x, row_valid, lo, hi, s["counts"].shape[1])
-                finite = row_valid[:, None] & jnp.isfinite(x)
-                abs_dev = jnp.where(
-                    finite, jnp.abs(x - mean[None, :]), 0.0).sum(axis=0)
+                counts, abs_dev = pallas_hist.histogram_batch(
+                    x, row_valid, lo, hi, mean, s["counts"].shape[1])
                 out = {"counts": s["counts"] + counts,
                        "abs_dev": s["abs_dev"] + abs_dev}
             else:

@@ -79,6 +79,10 @@ class StreamingProfiler:
         self.hostagg = HostAgg(self.plan, self.config)
         self.sampler = RowSampler(self.config.quantile_sketch_size,
                                   self.plan.n_num, seed=self.config.seed)
+        from tpuprof import native
+        self.host_hll = khll.HostRegisters(
+            self.plan.n_hash, self.config.hll_precision) \
+            if self.plan.n_hash > 0 and native.available() else None
         # device state is created on the first micro-batch so the fused
         # kernel's centering shift can come from real data
         self.state = None
@@ -120,8 +124,12 @@ class StreamingProfiler:
                 if self.state is None:
                     from tpuprof.backends.tpu import estimate_shift
                     self.state = self.runner.init_pass_a(estimate_shift(hb))
-                self.state = self.runner.step_a(self.state, hb, self.cursor)
+                db = self.runner.put_batch(
+                    hb, with_hll=self.host_hll is None)
+                self.state = self.runner.step_a(self.state, db, self.cursor)
                 self.sampler.update(hb.x, hb.nrows)
+                if self.host_hll is not None:
+                    self.host_hll.update(hb.hll, hb.nrows)
                 self.hostagg.update(hb)
                 self.cursor += 1
         log_event("stream_update", cursor=self.cursor,
@@ -140,12 +148,14 @@ class StreamingProfiler:
         momf = kmoments.finalize(res["mom"])
         probes = list(self.config.quantile_probes)
         sample_vals, sample_kept = self.sampler.columns()
+        hll_regs = self.host_hll.regs if self.host_hll is not None \
+            else res["hll"]
         return _assemble(
             self.plan, self.config,
             self._sample if self._sample is not None else pd.DataFrame(),
             self.hostagg, momf, kcorr.finalize(res["corr"]),
             self.sampler.quantiles(probes), sample_vals, sample_kept,
-            khll.finalize(res["hll"]), None, None, None, probes)
+            khll.finalize(hll_regs), None, None, None, probes)
 
     def report_html(self) -> str:
         from tpuprof.report.render import to_standalone_html
@@ -158,6 +168,7 @@ class StreamingProfiler:
         host_blob = {
             "hostagg": self.hostagg,
             "sampler": self.sampler,
+            "host_hll": self.host_hll,
             "sample": self._sample,
             "schema": self.arrow_schema.serialize().to_pybytes(),
         }
@@ -199,6 +210,22 @@ class StreamingProfiler:
                 f"{prof.config.quantile_sketch_size} — the sample cannot "
                 "be re-sized after the fact")
         prof.sampler = saved_sampler
+        # registers are interchangeable between host and device paths
+        # (bit-identical fold), so restore whichever side wrote them —
+        # a process without the native lib continues via the numpy
+        # fallback rather than dropping observations.  Absent key = the
+        # registers live in the device state (blob layouts without it
+        # are same-version; .get keeps them loadable).
+        saved_hll = host_blob.get("host_hll")
+        if saved_hll is not None:
+            m = saved_hll.regs.shape[1]
+            if m != 1 << prof.config.hll_precision:
+                raise ValueError(
+                    f"checkpoint HLL registers are {m} wide but config "
+                    f"requests hll_precision={prof.config.hll_precision} "
+                    f"(2^p={1 << prof.config.hll_precision}) — register "
+                    "planes of different widths cannot merge")
+        prof.host_hll = saved_hll
         prof._sample = host_blob["sample"]
         prof.cursor = payload["cursor"]
         return prof
