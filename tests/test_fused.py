@@ -100,3 +100,37 @@ def test_fused_all_missing_column():
     assert f["n"][0] == 128 and f["n"][1] == 0
     assert f["n_missing"][1] == 128
     assert np.isnan(f["mean"][1])
+
+
+def test_spearman_grid_kernel_close_to_exact():
+    """The pallas grid-rank Spearman (interpret mode) must agree with an
+    exact scipy-free rank correlation within the documented 1/G tier."""
+    import pandas as pd
+    from tpuprof.ingest.sample import RowSampler
+
+    rng = np.random.default_rng(0)
+    n, cols = 6000, 4
+    base = rng.normal(0, 1, n)
+    x = np.stack([
+        base + rng.normal(0, 0.3, n),          # strong monotone relation
+        np.exp(base) + rng.normal(0, 0.2, n),  # nonlinear but monotone
+        rng.normal(0, 1, n),                   # independent
+        -base ** 3 + rng.normal(0, 0.5, n),    # negative monotone
+    ], axis=1).astype(np.float32)
+    x[rng.random((n, cols)) < 0.05] = np.nan
+    rv = np.ones(n, dtype=bool)
+
+    sampler = RowSampler(k=8192, n_num=cols)   # n < k: sample == data
+    sampler.update(x, n)
+    grid = sampler.cdf_grid(256)
+
+    co = corr.init(cols)
+    co["shift"] = jnp.full((cols,), 0.5, dtype=jnp.float32)
+    co["set"] = jnp.ones((), dtype=jnp.int32)
+    co = fused.spearman_update(
+        co, jnp.asarray(np.ascontiguousarray(x.T)), jnp.asarray(rv),
+        jnp.asarray(grid), interpret=True)
+    got = corr.finalize(jax.device_get(co))
+
+    expect = pd.DataFrame(x).corr(method="spearman").to_numpy()
+    np.testing.assert_allclose(got, expect, atol=0.02)

@@ -228,20 +228,32 @@ class TPUStatsBackend:
             mean_d = runner.put_replicated(mean_c, dtype=np.float32)
             spear_state = None
             if config.spearman:
-                # rank transform through the pass-A sample CDF (+inf pads
-                # the unkept slots past every real value)
-                srt, kept_n = sampler.sorted_padded()
-                kept_counts = runner.put_replicated(kept_n, dtype=np.int32)
-                sorted_sample = runner.put_replicated(srt, dtype=np.float32)
                 spear_state = runner.init_spearman()
+                if runner.use_fused:
+                    # pallas tier: dense-compare ranks on a G-point grid
+                    spear_grid = runner.put_replicated(
+                        sampler.cdf_grid(config.spearman_grid),
+                        dtype=np.float32)
+                else:
+                    # exact tier: rank transform through the pass-A sample
+                    # CDF (+inf pads unkept slots past every real value)
+                    srt, kept_n = sampler.sorted_padded()
+                    kept_counts = runner.put_replicated(kept_n,
+                                                        dtype=np.int32)
+                    sorted_sample = runner.put_replicated(srt,
+                                                          dtype=np.float32)
             with phase_timer("scan_b"):
                 for rb in ingest.raw_batches():
                     hb = prepare_batch(rb, plan, pad, config.hll_precision)
                     db = runner.put_batch(hb, with_hll=False)
                     state_b = runner.step_b(state_b, db, lo_d, hi_d, mean_d)
                     if spear_state is not None:
-                        spear_state = runner.step_spearman(
-                            spear_state, db, sorted_sample, kept_counts)
+                        if runner.use_fused:
+                            spear_state = runner.step_spearman_grid(
+                                spear_state, db, spear_grid)
+                        else:
+                            spear_state = runner.step_spearman(
+                                spear_state, db, sorted_sample, kept_counts)
                     recounter.update(hb)
                 res_b = runner.finalize_b(state_b)
                 recounter.counts = merge_recount_arrays(recounter.counts)

@@ -65,12 +65,18 @@ class ProfilerConfig:
     # kept it is unverified — SURVEY §2.1 treats it as optional parity.
     # Rejection stays Pearson-based either way.)
     spearman: bool = False
+    spearman_grid: int = 256        # G: CDF-grid resolution of the pallas
+                                    # Spearman tier (rank error ~1/G on top
+                                    # of the sample CDF error; the CPU-mesh
+                                    # tier keeps exact average-tie ranks)
 
     def __post_init__(self) -> None:
         if self.bins < 1:
             raise ValueError("bins must be >= 1")
         if not 0.0 < self.corr_reject <= 1.0:
             raise ValueError("corr_reject must be in (0, 1]")
+        if self.spearman_grid < 2:
+            raise ValueError("spearman_grid must be >= 2")
         from tpuprof.kernels.hll import MAX_PRECISION
         if self.hll_precision < 4 or self.hll_precision > MAX_PRECISION:
             # upper bound set by the uint16 packed-observation format

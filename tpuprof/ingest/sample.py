@@ -111,6 +111,21 @@ class RowSampler:
                 out[:, c] = np.quantile(v, list(probes))
         return out
 
+    def cdf_grid(self, n_grid: int) -> np.ndarray:
+        """(n_num, n_grid) float32 per-column sample quantiles at probes
+        (j+0.5)/n_grid — the rank grid for the pallas Spearman kernel
+        (kernels/fused.spearman_update).  Columns with no finite sample
+        are all +inf (their ranks collapse to 0 and the correlation
+        finalizes to NaN via the zero-variance guard)."""
+        vals, kept = self.columns()
+        probes = (np.arange(n_grid) + 0.5) / n_grid
+        out = np.full((self.n_num, n_grid), np.inf, dtype=np.float32)
+        for c in range(self.n_num):
+            v = vals[c, kept[c]]
+            if v.size:
+                out[c] = np.quantile(v, probes).astype(np.float32)
+        return out
+
     def sorted_padded(self) -> Tuple[np.ndarray, np.ndarray]:
         """For the Spearman rank-CDF pass: per-column ascending finite
         sample padded with +inf to k, plus kept counts."""

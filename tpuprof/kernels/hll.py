@@ -99,9 +99,11 @@ class HostRegisters:
     fold — so estimates, checkpoints and merges are interchangeable.
 
     ``update`` uses the native library when available and a numpy
-    fallback otherwise (slow but correct — only reached when a
-    checkpoint written with host registers is restored in a process
-    whose toolchain cannot build the extension)."""
+    fallback otherwise (slow but correct).  In production the fallback
+    is defensive only: both the backend and the streaming profiler gate
+    host registers on ``native.available()``, and checkpoint restore
+    separately rejects native/pandas hash mismatches (hashes, not
+    register folds, are what differ between the implementations)."""
 
     def __init__(self, n_cols: int, precision: int):
         self.regs = np.zeros((n_cols, 1 << precision), dtype=np.int32)

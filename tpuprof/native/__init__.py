@@ -41,6 +41,18 @@ def _build() -> Optional[str]:
         return None
 
 
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.tpuprof_hash_u64.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.tpuprof_hash_bytes.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_size_t]
+    lib.tpuprof_hll_update.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+        ctypes.c_ssize_t, ctypes.c_ssize_t, ctypes.c_void_p,
+        ctypes.c_size_t]
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
@@ -50,16 +62,24 @@ def _load() -> Optional[ctypes.CDLL]:
         so = _build()
         if so is None:
             return None
-        lib = ctypes.CDLL(so)
-        lib.tpuprof_hash_u64.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
-        lib.tpuprof_hash_bytes.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_size_t]
-        lib.tpuprof_hll_update.argtypes = [
-            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
-            ctypes.c_ssize_t, ctypes.c_ssize_t, ctypes.c_void_p,
-            ctypes.c_size_t]
+        try:
+            lib = ctypes.CDLL(so)
+            _bind(lib)
+        except (OSError, AttributeError):
+            # a cached .so from an older source (mtime-preserving deploys)
+            # may predate a symbol: rebuild once from current source, and
+            # fall back cleanly if that still fails
+            try:
+                os.remove(so)
+                rebuilt = _build()
+                if rebuilt is None:
+                    return None
+                lib = ctypes.CDLL(rebuilt)
+                _bind(lib)
+            except (OSError, AttributeError) as exc:
+                logger.info("tpuprof native hash unusable (%s); using "
+                            "fallbacks", exc)
+                return None
         _lib = lib
         return _lib
 

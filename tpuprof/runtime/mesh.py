@@ -264,10 +264,11 @@ class MeshRunner:
                 wsum, 1.0)
 
         def local_step_spear(state, xt, row_valid, sample, kept):
-            """Spearman pass: rank-transform each value through the pass-A
-            sample CDF (average rank of the two searchsorted sides — exact
-            average-tie ranks when the sample holds the whole column) and
-            accumulate the same Gram state Pearson uses (SURVEY §7.2)."""
+            """Spearman pass, exact tier: rank-transform each value through
+            the pass-A sample CDF (average rank of the two searchsorted
+            sides — exact average-tie ranks when the sample holds the whole
+            column) and accumulate the same Gram state Pearson uses
+            (SURVEY §7.2)."""
             s = _unstack(state)
             x = xt.T
             finite = row_valid[:, None] & jnp.isfinite(x)
@@ -279,6 +280,12 @@ class MeshRunner:
             ranks = (left + right).astype(jnp.float32) * 0.5 / denom
             r = jnp.where(finite, ranks.T, jnp.nan)
             return _restack(corr.update(s, r, row_valid))
+
+        def local_step_spear_grid(state, xt, row_valid, grid):
+            """Spearman pass, pallas tier: dense compare against a G-point
+            CDF grid (kernels/fused.spearman_update; rank resolution 1/G)."""
+            s = _unstack(state)
+            return _restack(fused.spearman_update(s, xt, row_valid, grid))
 
         def local_merge_spear(state):
             return _restack(merge_corr_local(_unstack(state), _common_shift))
@@ -347,6 +354,11 @@ class MeshRunner:
             in_specs=(state_spec, cols_rows_spec, rows_spec, rep, rep),
             out_specs=state_spec, check_vma=False),
             donate_argnums=(0,))
+        self._step_spear_grid = jax.jit(shard_map(
+            local_step_spear_grid, mesh=mesh,
+            in_specs=(state_spec, cols_rows_spec, rows_spec, rep),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
         self._merge_spear = jax.jit(shard_map(
             local_merge_spear, mesh=mesh, in_specs=(state_spec,),
             out_specs=state_spec, check_vma=False))
@@ -372,8 +384,16 @@ class MeshRunner:
                             self.put_replicated(mean, dtype=jnp.float32))
 
     def init_spearman(self) -> Pytree:
-        return jax.vmap(lambda _: corr.init(self.n_num))(
-            jnp.arange(self.n_dev))
+        def one_device(_):
+            co = corr.init(self.n_num)
+            if self.use_fused:
+                # grid ranks live in [0,1]: a constant 0.5 shift is the
+                # perfectly conditioned center (fused.spearman_update)
+                co["shift"] = jnp.full((self.n_num,), 0.5,
+                                       dtype=jnp.float32)
+                co["set"] = jnp.ones((), dtype=jnp.int32)
+            return co
+        return jax.vmap(one_device)(jnp.arange(self.n_dev))
 
     def step_spearman(self, state: Pytree, hb, sorted_sample,
                       kept) -> Pytree:
@@ -382,6 +402,14 @@ class MeshRunner:
             state, db.xt, db.row_valid,
             self.put_replicated(sorted_sample, dtype=jnp.float32),
             self.put_replicated(kept, dtype=jnp.int32))
+
+    def step_spearman_grid(self, state: Pytree, hb, grid) -> Pytree:
+        """Pallas-tier Spearman step: ``grid`` is the (n_num, G) host CDF
+        grid (RowSampler.cdf_grid)."""
+        db = self._as_device(hb)
+        return self._step_spear_grid(
+            state, db.xt, db.row_valid,
+            self.put_replicated(grid, dtype=jnp.float32))
 
     def finalize_spearman(self, state: Pytree):
         return jax.device_get(
