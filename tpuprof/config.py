@@ -75,8 +75,10 @@ class ProfilerConfig:
             raise ValueError("bins must be >= 1")
         if not 0.0 < self.corr_reject <= 1.0:
             raise ValueError("corr_reject must be in (0, 1]")
-        if self.spearman_grid < 2:
-            raise ValueError("spearman_grid must be >= 2")
+        if not 2 <= self.spearman_grid <= 4096:
+            # upper bound keeps the fully-unrolled compare loop and the
+            # (cols, G) VMEM grid block inside sane compile/memory limits
+            raise ValueError("spearman_grid must be in [2, 4096]")
         from tpuprof.kernels.hll import MAX_PRECISION
         if self.hll_precision < 4 or self.hll_precision > MAX_PRECISION:
             # upper bound set by the uint16 packed-observation format

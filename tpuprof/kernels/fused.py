@@ -140,7 +140,7 @@ def _fused_tiles(xt: Array, row_valid: Array, shift: Array,
     shift_p = jnp.pad(shift.astype(jnp.float32), (0, cpad))[:, None]
     C = cols + cpad
     n_rt = (rows + rpad) // R_TILE
-    kernel = pl.pallas_call(
+    out = pl.pallas_call(
         _kernel,
         grid=(n_rt,),
         in_specs=[
@@ -162,10 +162,29 @@ def _fused_tiles(xt: Array, row_valid: Array, shift: Array,
         ],
         interpret=interpret,
     )(xt_p, rv_p, shift_p)
-    sums, counts, g1, g2 = kernel
-    return (sums[:cols], counts[:cols],
-            g1[:cols, :cols], g1[:cols, C:C + cols],      # P, S1
-            g2[:cols, :cols], g2[C:C + cols, :cols])      # S2, N
+    sums, counts, g1, g2 = out
+    return (sums[:cols], counts[:cols]) + _slice_grams(g1, g2, cols, C)
+
+
+def _slice_grams(g1, g2, cols: int, C: int):
+    """(P, S1, S2, N) from the two stacked Gram outputs — the one block
+    convention shared by the Pearson and Spearman kernels."""
+    return (g1[:cols, :cols], g1[:cols, C:C + cols],
+            g2[:cols, :cols], g2[C:C + cols, :cols])
+
+
+def _fold_corr(co: Dict[str, Array], P: Array, S1: Array, S2: Array,
+               N: Array) -> Dict[str, Array]:
+    """Add one batch's Gram blocks into a corr.py state (shift must be
+    pre-set; counts round exactly — batch rows < 2²⁴ in f32)."""
+    return {
+        "shift": co["shift"],
+        "set": jnp.ones((), dtype=jnp.int32),
+        "N": co["N"] + jnp.round(N).astype(jnp.int32),
+        "S1": co["S1"] + S1,
+        "S2": co["S2"] + S2,
+        "P": co["P"] + P,
+    }
 
 
 def update(mom: Dict[str, Array], co: Dict[str, Array], xt: Array,
@@ -191,15 +210,7 @@ def update(mom: Dict[str, Array], co: Dict[str, Array], xt: Array,
         "n_inf": mom["n_inf"] + counts[:, 2],
         "n_missing": mom["n_missing"] + counts[:, 3],
     }
-    co_out = {
-        "shift": co["shift"],
-        "set": jnp.ones((), dtype=jnp.int32),
-        "N": co["N"] + jnp.round(N).astype(jnp.int32),
-        "S1": co["S1"] + S1,
-        "S2": co["S2"] + S2,
-        "P": co["P"] + P,
-    }
-    return mom_out, co_out
+    return mom_out, _fold_corr(co, P, S1, S2, N)
 
 
 def update_xla(mom: Dict[str, Array], co: Dict[str, Array], xt: Array,
@@ -293,8 +304,7 @@ def _spear_tiles(xt: Array, row_valid: Array, grid: Array,
         ],
         interpret=interpret,
     )(xt_p, rv_p, grid_p)
-    return (g1[:cols, :cols], g1[:cols, C:C + cols],   # P, S1
-            g2[:cols, :cols], g2[C:C + cols, :cols])   # S2, N
+    return _slice_grams(g1, g2, cols, C)
 
 
 def spearman_update(co: Dict[str, Array], xt: Array, row_valid: Array,
@@ -303,11 +313,4 @@ def spearman_update(co: Dict[str, Array], xt: Array, row_valid: Array,
     """Fold one batch of grid ranks into a corr.py state (whose shift
     must be the constant 0.5 — ranks are in [0,1])."""
     P, S1, S2, N = _spear_tiles(xt, row_valid, grid, interpret=interpret)
-    return {
-        "shift": co["shift"],
-        "set": jnp.ones((), dtype=jnp.int32),
-        "N": co["N"] + jnp.round(N).astype(jnp.int32),
-        "S1": co["S1"] + S1,
-        "S2": co["S2"] + S2,
-        "P": co["P"] + P,
-    }
+    return _fold_corr(co, P, S1, S2, N)
