@@ -93,3 +93,50 @@ def test_two_process_simulation_on_dataset(tmp_path):
     for rb in single.raw_batches():
         sagg.update(prepare_batch(rb, single.plan, 512))
     assert merged.mg["c"].counts == sagg.mg["c"].counts
+
+
+def test_scan_a_matches_sequential_steps():
+    """The multi-batch scan_a dispatch must fold exactly like repeated
+    step_a calls, on a full 8-device mesh."""
+    import jax
+    from tpuprof.config import ProfilerConfig
+    from tpuprof.ingest.arrow import HostBatch
+    from tpuprof.kernels import moments as kmoments
+    from tpuprof.runtime.mesh import MeshRunner
+
+    rng = np.random.default_rng(0)
+    config = ProfilerConfig(batch_rows=64, hll_precision=6)
+    runner = MeshRunner(config, n_num=5, n_hash=2,
+                        devices=jax.devices()[:8])
+    hbs = []
+    for i in range(3):
+        x = np.asfortranarray(
+            rng.normal(3.0, 2.0, (runner.rows, 5)).astype(np.float32))
+        x[rng.random((runner.rows, 5)) < 0.1] = np.nan
+        from tpuprof.kernels import hll as khll
+        h64 = rng.integers(0, 1 << 64, (runner.rows, 2), dtype=np.uint64)
+        packed = np.asfortranarray(khll.pack(
+            h64, np.ones((runner.rows, 2), bool), 6))
+        rv = np.ones(runner.rows, dtype=bool)
+        rv[-5:] = False
+        hbs.append(HostBatch(nrows=runner.rows - 5, x=x, row_valid=rv,
+                             hll=packed, cat_codes={}, date_ints={},
+                             hll_precision=6))
+
+    shift = np.full(5, 3.0, dtype=np.float32)
+    s1 = runner.init_pass_a(shift)
+    for i, hb in enumerate(hbs):
+        s1 = runner.step_a(s1, hb, i)
+    r1 = runner.finalize_a(s1)
+
+    s2 = runner.init_pass_a(shift)
+    s2 = runner.scan_a(s2, runner.stage_batches(hbs))
+    r2 = runner.finalize_a(s2)
+
+    f1 = kmoments.finalize(r1["mom"])
+    f2 = kmoments.finalize(r2["mom"])
+    np.testing.assert_array_equal(f1["n"], f2["n"])
+    np.testing.assert_allclose(f1["mean"], f2["mean"], rtol=1e-6)
+    np.testing.assert_allclose(f1["variance"], f2["variance"], rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(r1["hll"]),
+                                  np.asarray(r2["hll"]))

@@ -36,7 +36,8 @@ def test_matches_reference(rows, cols, nbins):
     hi = np.nanmax(np.where(np.isinf(x), np.nan, x), axis=0)
     mean = np.nanmean(np.where(np.isinf(x), np.nan, x), axis=0)
     got, dev = pallas_hist.histogram_tiles(
-        jnp.asarray(x), jnp.asarray(lo), jnp.asarray(hi),
+        jnp.asarray(np.ascontiguousarray(x.T)),
+        jnp.ones(rows, dtype=bool), jnp.asarray(lo), jnp.asarray(hi),
         jnp.asarray(mean), nbins, interpret=True)
     np.testing.assert_array_equal(np.asarray(got),
                                   _reference(x, lo, hi, nbins))
@@ -61,8 +62,9 @@ def test_matches_xla_scatter_path():
         jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(mean))
     scatter_counts = np.asarray(state["counts"])
     pallas_counts, pallas_dev = pallas_hist.histogram_batch(
-        jnp.asarray(x), jnp.asarray(row_valid), jnp.asarray(lo),
-        jnp.asarray(hi), jnp.asarray(mean), nbins, interpret=True)
+        jnp.asarray(np.ascontiguousarray(x.T)), jnp.asarray(row_valid),
+        jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(mean), nbins,
+        interpret=True)
     np.testing.assert_array_equal(np.asarray(pallas_counts),
                                   scatter_counts)
     np.testing.assert_allclose(np.asarray(pallas_dev),
@@ -72,5 +74,5 @@ def test_matches_xla_scatter_path():
 def test_rejects_too_many_bins():
     with pytest.raises(ValueError, match="bins"):
         pallas_hist.histogram_tiles(
-            jnp.zeros((8, 2)), jnp.zeros(2), jnp.ones(2), jnp.zeros(2),
-            200, interpret=True)
+            jnp.zeros((2, 8)), jnp.ones(8, dtype=bool), jnp.zeros(2),
+            jnp.ones(2), jnp.zeros(2), 200, interpret=True)

@@ -39,7 +39,7 @@ import pandas as pd
 from tpuprof import schema
 from tpuprof.config import ProfilerConfig
 from tpuprof.ingest.arrow import (ArrowIngest, ColumnPlan, HostBatch,
-                                  prepare_batch)
+                                  prefetch_prepared, prepare_batch)
 from tpuprof.ingest.sample import RowSampler
 from tpuprof.kernels import corr as kcorr
 from tpuprof.kernels import hll as khll
@@ -175,8 +175,8 @@ class TPUStatsBackend:
             # a host with an empty fragment stripe) so every device in
             # the global mesh carries the same shift and the collective
             # merge's rebase is exactly the identity.
-            batches = (prepare_batch(rb, plan, pad, config.hll_precision)
-                       for rb in ingest.raw_batches())
+            batches = prefetch_prepared(ingest, plan, pad,
+                                        config.hll_precision)
             first_hb = next(batches, None)
             shift = merge_shift_estimates(
                 estimate_shift(first_hb) if first_hb is not None else None)
@@ -243,8 +243,11 @@ class TPUStatsBackend:
                     sorted_sample = runner.put_replicated(srt,
                                                           dtype=np.float32)
             with phase_timer("scan_b"):
-                for rb in ingest.raw_batches():
-                    hb = prepare_batch(rb, plan, pad, config.hll_precision)
+                # hashes=False: pass B never reads the HLL plane, so the
+                # host hash loop is skipped on the second scan
+                for hb in prefetch_prepared(ingest, plan, pad,
+                                            config.hll_precision,
+                                            hashes=False):
                     db = runner.put_batch(hb, with_hll=False)
                     state_b = runner.step_b(state_b, db, lo_d, hi_d, mean_d)
                     if spear_state is not None:

@@ -147,8 +147,13 @@ def _hash64_dictionary(dictionary, dvals: np.ndarray) -> np.ndarray:
 
 
 def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
-                  pad_rows: int, hll_precision: int = 11) -> HostBatch:
-    """Decode one Arrow record batch into a fixed-shape HostBatch."""
+                  pad_rows: int, hll_precision: int = 11,
+                  hashes: bool = True) -> HostBatch:
+    """Decode one Arrow record batch into a fixed-shape HostBatch.
+
+    ``hashes=False`` skips hashing + HLL packing (the host hot loop) and
+    leaves the packed plane zeros — pass B only needs values and
+    categorical codes."""
     from tpuprof.kernels import hll as khll
     n = batch.num_rows
     g = pad_rows
@@ -158,7 +163,11 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     # misses (measured 20x slower at 200 cols).  JAX re-lays-out on
     # transfer either way.
     x = np.full((g, n_num), np.nan, dtype=np.float32, order="F")
-    hll_packed = np.zeros((g, n_hash), dtype=np.uint16, order="F")
+    # hashes=False leaves no consumer for the plane — skip its
+    # allocation+memset entirely (zero-width, so downstream slicing and
+    # transposes stay shape-consistent)
+    hll_packed = np.zeros((g, n_hash if hashes else 0), dtype=np.uint16,
+                          order="F")
     row_valid = np.zeros((g,), dtype=bool)
     row_valid[:n] = True
     cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
@@ -186,17 +195,19 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                 if arr.null_count:
                     xf = np.where(valid, xf, np.nan)
                 x[:n, spec.num_lane] = xf
-            h64 = _hash64(_num_keys(vals))
-            hll_packed[:n, spec.hash_lane] = khll.pack(
-                h64, valid, hll_precision)
+            if hashes:
+                h64 = _hash64(_num_keys(vals))
+                hll_packed[:n, spec.hash_lane] = khll.pack(
+                    h64, valid, hll_precision)
         elif spec.role == "date":
             valid = arr.is_valid().to_numpy(zero_copy_only=False)
             ints = arr.cast(pa.timestamp("ns"), safe=False) \
                       .cast(pa.int64(), safe=False) \
                       .fill_null(0).to_numpy(zero_copy_only=False)
-            h64 = _hash64(_num_keys(ints))
-            hll_packed[:n, spec.hash_lane] = khll.pack(
-                h64, valid, hll_precision)
+            if hashes:
+                h64 = _hash64(_num_keys(ints))
+                hll_packed[:n, spec.hash_lane] = khll.pack(
+                    h64, valid, hll_precision)
             date_ints[spec.name] = (ints, valid)
         else:  # cat
             if not isinstance(arr.type, pa.DictionaryType):
@@ -207,13 +218,14 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
             codes = combined.indices.fill_null(0).to_numpy(
                 zero_copy_only=False).astype(np.int64)
             dvals = np.asarray(combined.dictionary.to_pandas(), dtype=object)
-            if dvals.size:
-                dh = _hash64_dictionary(combined.dictionary, dvals)
-                h64 = dh[codes]
-            else:
-                h64 = np.zeros(n, dtype=np.uint64)
-            hll_packed[:n, spec.hash_lane] = khll.pack(
-                h64, valid, hll_precision)
+            if hashes:
+                if dvals.size:
+                    dh = _hash64_dictionary(combined.dictionary, dvals)
+                    h64 = dh[codes]
+                else:
+                    h64 = np.zeros(n, dtype=np.uint64)
+                hll_packed[:n, spec.hash_lane] = khll.pack(
+                    h64, valid, hll_precision)
             cat_codes[spec.name] = (np.where(valid, codes, -1), dvals)
 
     # Column decode is embarrassingly parallel (disjoint output columns)
@@ -232,6 +244,59 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     return HostBatch(nrows=n, x=x, row_valid=row_valid, hll=hll_packed,
                      cat_codes=cat_codes, date_ints=date_ints,
                      hll_precision=hll_precision)
+
+
+def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
+                      hll_precision: int, depth: int = 2,
+                      hashes: bool = True):
+    """Yield prepared HostBatches with a background thread running
+    ``depth`` batches ahead, so Arrow decode + hashing + buffer layout
+    overlap the device scan instead of serializing with it.  Exceptions
+    from the reader (including the fragment-retry path) re-raise in the
+    consumer."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    sentinel = object()
+    failure = []
+    cancelled = threading.Event()
+
+    def _put(item) -> bool:
+        # bounded put that notices consumer abandonment: if the consumer
+        # stops draining (exception mid-scan, generator GC'd), the
+        # worker must not block on the full queue forever — that would
+        # leak the thread, depth+1 prepared batches, and the reader
+        while not cancelled.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for rb in ingest.raw_batches():
+                if not _put(prepare_batch(rb, plan, pad, hll_precision,
+                                          hashes=hashes)):
+                    return
+        except BaseException as exc:          # re-raised consumer-side
+            failure.append(exc)
+        finally:
+            _put(sentinel)
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        if failure:
+            raise failure[0]
+    finally:
+        cancelled.set()
 
 
 def _decode_threads() -> int:

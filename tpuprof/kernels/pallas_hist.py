@@ -1,18 +1,22 @@
-"""Pallas TPU kernel: all-column fixed-bin histograms.
+"""Pallas TPU kernel: all-column fixed-bin histograms + exact-MAD sums.
 
 Why a custom kernel: XLA lowers the scatter-add in kernels/histogram.py
 to a serialized per-element scatter on TPU — the one op in the profile
 scan that doesn't vectorize.  Binning is really a *dense* computation:
-for bins ≤ ~64, comparing every element against every bin id is only
+for bins ≤ ~128, comparing every element against every bin id is only
 ``bins`` VPU passes over the tile, with all accumulation in registers/
-VMEM — no scatter at all.
+VMEM — no scatter at all.  The MAD numerator Σ|x−mean| rides the same
+read (a separate XLA reduction measured as expensive as the histogram
+itself on the target device — PERF.md).
 
-Layout (per /opt/skills/guides/pallas_guide.md tiling rules):
-* grid = (col_tiles, row_tiles); row tiles iterate fastest so each
-  output block stays resident in VMEM while its rows stream through;
-* x block (R_TILE=512, C_TILE=128) f32; per-column lo/scale ride along
-  as (1, C_TILE) blocks; output block (C_TILE, BINS_PAD=128) int32 is
-  zero-initialized at the first row tile and accumulated in place.
+Layout (per /opt/skills/guides/pallas_guide.md tiling rules, matching
+kernels/fused.py): the batch arrives as the mesh ships it — ``xt`` is
+(cols, rows), columns on the sublane axis (8-aligned for f32, so
+typical column counts need no padding copy), rows on the lane axis,
+grid over row tiles; all reductions run along lanes.  Output blocks
+have constant index maps so Mosaic keeps them VMEM-resident across the
+grid and writes them back once.  ``row_valid`` masks padding in-kernel
+(no NaN-masking pre-pass over the batch).
 
 The kernel is exact (same clip semantics as the XLA path) and is tested
 in interpreter mode on CPU against both numpy and the scatter version
@@ -29,34 +33,31 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-R_TILE = 512
-C_TILE = 128
-BINS_PAD = 128          # lane width; bins <= BINS_PAD
+R_TILE = 1024           # lane-axis (row) tile
+C_ALIGN = 8             # sublane-axis (column) alignment, f32
+MAX_BINS = 128
 
 
-def _hist_kernel(x_ref, lo_ref, scale_ref, mean_ref, out_ref, dev_ref, *,
-                 nbins: int):
-    i = pl.program_id(1)                      # row tile (fastest)
-    x = x_ref[...]                            # (R_TILE, C_TILE)
-    lo = lo_ref[...]                          # (1, C_TILE)
-    scale = scale_ref[...]                    # (1, C_TILE)
-    mean = mean_ref[...]                      # (1, C_TILE)
-    finite = jnp.isfinite(x)
+def _hist_kernel(xt_ref, rv_ref, lo_ref, scale_ref, mean_ref, out_ref,
+                 dev_ref, *, nbins: int):
+    i = pl.program_id(0)
+    x = xt_ref[...]                           # (C, R)
+    rv = rv_ref[...] > 0                      # (1, R)
+    lo = lo_ref[...]                          # (C, 1)
+    scale = scale_ref[...]                    # (C, 1)
+    mean = mean_ref[...]                      # (C, 1)
+    finite = rv & jnp.isfinite(x)
     idx = jnp.floor((x - lo) * scale)
     idx = jnp.clip(idx, 0, nbins - 1).astype(jnp.int32)
     idx = jnp.where(finite, idx, -1)          # -1 never matches a bin id
 
-    # dense bin counting: one vectorized compare+reduce per bin
-    cols = [jnp.sum((idx == b).astype(jnp.int32), axis=0)
-            for b in range(nbins)]            # each (C_TILE,)
-    counts = jnp.stack(cols, axis=1)          # (C_TILE, nbins)
-    counts = jnp.pad(counts, ((0, 0), (0, BINS_PAD - nbins)))
+    # dense bin counting: one vectorized compare+lane-reduce per bin
+    counts = jnp.concatenate(
+        [jnp.sum((idx == b).astype(jnp.int32), axis=1, keepdims=True)
+         for b in range(nbins)], axis=1)      # (C, nbins)
 
-    # MAD numerator rides the same read: Σ|x − mean| over finite values
-    # (a separate XLA reduction measured as expensive as the histogram
-    # itself on the target device)
     dev = jnp.sum(jnp.where(finite, jnp.abs(x - mean), 0.0),
-                  axis=0)[:, None]            # (C_TILE, 1)
+                  axis=1, keepdims=True)      # (C, 1)
 
     @pl.when(i == 0)
     def _init():
@@ -67,56 +68,57 @@ def _hist_kernel(x_ref, lo_ref, scale_ref, mean_ref, out_ref, dev_ref, *,
     dev_ref[...] += dev
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("nbins", "interpret"))
-def histogram_tiles(x: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
-                    mean: jnp.ndarray, nbins: int,
-                    interpret: bool = False):
-    """(rows, cols) f32 (NaN = skip) → ((cols, nbins) int32 counts,
-    (cols,) f32 Σ|x−mean|).
+@functools.partial(jax.jit, static_argnames=("nbins", "interpret"))
+def histogram_tiles(xt: jnp.ndarray, row_valid: jnp.ndarray,
+                    lo: jnp.ndarray, hi: jnp.ndarray, mean: jnp.ndarray,
+                    nbins: int, interpret: bool = False):
+    """(cols, rows) f32 (NaN = skip; padding rows via ``row_valid``) →
+    ((cols, nbins) int32 counts, (cols,) f32 Σ|x−mean|).
 
     ``lo``/``hi`` are per-column finite ranges (pass-A min/max); values
     land in ``clip(floor((x-lo)/(hi-lo)*nbins), 0, nbins-1)`` — identical
     semantics to kernels/histogram.py and np.histogram's inclusive last
     edge.  ``mean`` is the pass-A mean feeding the exact-MAD numerator."""
-    if nbins > BINS_PAD:
-        raise ValueError(f"pallas histogram supports bins <= {BINS_PAD}")
-    rows, cols = x.shape
+    if nbins > MAX_BINS:
+        raise ValueError(f"pallas histogram supports bins <= {MAX_BINS}")
+    cols, rows = xt.shape
+    cpad = -cols % C_ALIGN
     rpad = -rows % R_TILE
-    cpad = -cols % C_TILE
-    x = jnp.pad(x, ((0, rpad), (0, cpad)), constant_values=jnp.nan)
-    lo_p = jnp.pad(lo.astype(jnp.float32), (0, cpad))[None, :]
+    xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
+    rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
+    lo_p = jnp.pad(lo.astype(jnp.float32), (0, cpad))[:, None]
     width = jnp.maximum(hi - lo, 1e-30).astype(jnp.float32)
-    scale_p = jnp.pad(nbins / width, (0, cpad))[None, :]
-    mean_p = jnp.pad(mean.astype(jnp.float32), (0, cpad))[None, :]
+    scale_p = jnp.pad(nbins / width, (0, cpad))[:, None]
+    mean_p = jnp.pad(mean.astype(jnp.float32), (0, cpad))[:, None]
 
-    n_ct = (cols + cpad) // C_TILE
+    C = cols + cpad
     n_rt = (rows + rpad) // R_TILE
     counts, dev = pl.pallas_call(
         functools.partial(_hist_kernel, nbins=nbins),
-        grid=(n_ct, n_rt),
+        grid=(n_rt,),
         in_specs=[
-            pl.BlockSpec((R_TILE, C_TILE), lambda j, i: (i, j)),
-            pl.BlockSpec((1, C_TILE), lambda j, i: (0, j)),
-            pl.BlockSpec((1, C_TILE), lambda j, i: (0, j)),
-            pl.BlockSpec((1, C_TILE), lambda j, i: (0, j)),
+            pl.BlockSpec((C, R_TILE), lambda i: (0, i)),
+            pl.BlockSpec((1, R_TILE), lambda i: (0, i)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((C_TILE, BINS_PAD), lambda j, i: (j, 0)),
-            pl.BlockSpec((C_TILE, 1), lambda j, i: (j, 0)),
+            pl.BlockSpec((C, nbins), lambda i: (0, 0)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((cols + cpad, BINS_PAD), jnp.int32),
-            jax.ShapeDtypeStruct((cols + cpad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((C, nbins), jnp.int32),
+            jax.ShapeDtypeStruct((C, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(x, lo_p, scale_p, mean_p)
-    return counts[:cols, :nbins], dev[:cols, 0]
+    )(xt_p, rv_p, lo_p, scale_p, mean_p)
+    return counts[:cols], dev[:cols, 0]
 
 
-def histogram_batch(x, row_valid, lo, hi, mean, nbins: int,
+def histogram_batch(xt, row_valid, lo, hi, mean, nbins: int,
                     interpret: bool = False):
-    """Batch entry point matching kernels/histogram.update semantics:
-    padding rows masked via ``row_valid``; returns (counts, abs_dev)."""
-    x = jnp.where(row_valid[:, None], x, jnp.nan)
-    return histogram_tiles(x, lo, hi, mean, nbins, interpret=interpret)
+    """Batch entry point matching kernels/histogram.update semantics;
+    ``xt`` is (cols, rows) as the mesh ships batches."""
+    return histogram_tiles(xt, row_valid, lo, hi, mean, nbins,
+                           interpret=interpret)

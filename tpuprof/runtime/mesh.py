@@ -234,15 +234,14 @@ class MeshRunner:
 
         def local_step_b(state, xt, row_valid, lo, hi, mean):
             s = _unstack(state)
-            x = xt.T
             if use_pallas:
                 from tpuprof.kernels import pallas_hist
                 counts, abs_dev = pallas_hist.histogram_batch(
-                    x, row_valid, lo, hi, mean, s["counts"].shape[1])
+                    xt, row_valid, lo, hi, mean, s["counts"].shape[1])
                 out = {"counts": s["counts"] + counts,
                        "abs_dev": s["abs_dev"] + abs_dev}
             else:
-                out = histogram.update(s, x, row_valid, lo, hi, mean)
+                out = histogram.update(s, xt.T, row_valid, lo, hi, mean)
             return _restack(out)
 
         def merge_corr_local(co, common_shift):
