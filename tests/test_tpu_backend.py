@@ -190,3 +190,15 @@ def test_streaming_single_pass_mode(fixture_df):
     counts, edges = v["histogram"]
     assert counts.sum() > 0 and len(edges) == 11
     assert stats["variables"]["vendor_id"]["freq"] > 0
+
+
+def test_memorysize_accumulated_from_arrow_buffers(fixture_df):
+    stats = TPUStatsBackend().collect(fixture_df, _cfg())
+    table = stats["table"]
+    assert np.isfinite(table["memorysize"]) and table["memorysize"] > 0
+    v = stats["variables"]["fare_amount"]
+    # float64 column of 2000 rows: at least 8 bytes/row of Arrow buffers
+    assert v["memorysize"] >= 2000 * 8
+    assert table["memorysize"] >= sum(
+        var["memorysize"] for var in stats["variables"].values()
+        if np.isfinite(var["memorysize"]))

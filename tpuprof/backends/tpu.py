@@ -69,6 +69,8 @@ class HostAgg:
     def __init__(self, plan: ColumnPlan, config: ProfilerConfig):
         self.config = config
         self.n_rows = 0
+        self.col_nbytes: Dict[str, int] = {}        # summed buffer bytes
+        self.col_dict_nbytes: Dict[str, int] = {}   # shared dicts: max
         self.mg: Dict[str, MisraGries] = {
             s.name: MisraGries(config.topk_capacity)
             for s in plan.by_role("cat")}
@@ -81,6 +83,11 @@ class HostAgg:
     def update(self, hb: HostBatch) -> None:
         first = self.n_rows == 0
         self.n_rows += hb.nrows
+        for name, nb in (hb.col_nbytes or {}).items():
+            self.col_nbytes[name] = self.col_nbytes.get(name, 0) + nb
+        for name, nb in (hb.col_dict_nbytes or {}).items():
+            self.col_dict_nbytes[name] = max(
+                self.col_dict_nbytes.get(name, 0), nb)
         for name, (codes, dvals) in hb.cat_codes.items():
             codes = codes[: hb.nrows]
             valid = codes >= 0
@@ -99,6 +106,13 @@ class HostAgg:
                 lo, hi = int(ints[valid].min()), int(ints[valid].max())
                 self.date_min[name] = min(self.date_min.get(name, lo), lo)
                 self.date_max[name] = max(self.date_max.get(name, hi), hi)
+
+    def memorysize(self, name: str) -> float:
+        """Arrow buffer bytes for one column (NaN if never observed)."""
+        if name not in self.col_nbytes:
+            return float("nan")
+        return float(self.col_nbytes[name]
+                     + self.col_dict_nbytes.get(name, 0))
 
 
 class Recounter:
@@ -265,7 +279,16 @@ class TPUStatsBackend:
                     runner.finalize_spearman(spear_state))
             hists, mad = khistogram.finalize(
                 res_b, momf["fmin"], momf["fmax"], momf["n"], config.bins)
-        elif config.exact_passes and ingest.rescannable and hostagg.n_rows > 0:
+        elif config.spearman and hostagg.n_rows > 0 and plan.n_num > 1:
+            # requested but the rank pass cannot run (single-pass mode or
+            # a non-rescannable source) — say so instead of silently
+            # omitting the matrix
+            import logging
+            logging.getLogger("tpuprof").warning(
+                "spearman=True requires a rescannable source and "
+                "exact_passes=True; the spearman matrix was skipped")
+        if recounter is None and config.exact_passes \
+                and ingest.rescannable and hostagg.n_rows > 0:
             # no numeric columns — only the top-k recount matters
             recounter = Recounter(hostagg)
             for hb in ingest.batches(config.hll_precision):
@@ -333,7 +356,9 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
             "distinct_count": distinct,
             "p_unique": distinct / count if count else 0.0,
             "is_unique": count > 0 and distinct == count,
-            "memorysize": np.nan,   # not meaningful for a streamed source
+            # Arrow buffer bytes (the streamed-source analogue of the
+            # reference's series.memory_usage)
+            "memorysize": hostagg.memorysize(spec.name),
         }
         kinds[spec.name] = schema.classify(spec.base_kind, distinct, count)
 
@@ -397,7 +422,11 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
         stats["type"] = kind
         variables[name] = stats
 
-    table = schema.make_table_stats(n, variables, memorysize=np.nan)
+    table = schema.make_table_stats(
+        n, variables,
+        memorysize=float(sum(hostagg.memorysize(c)
+                             for c in hostagg.col_nbytes))
+        if hostagg.col_nbytes else np.nan)
     messages = schema.derive_messages(variables, config)
     correlations = {"pearson": corr_df}
     if rho_spear is not None and len(lanes) >= 2:

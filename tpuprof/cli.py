@@ -31,6 +31,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hll-precision", type=int, default=11)
     p.add_argument("--single-pass", action="store_true",
                    help="one scan only (sketch-derived histograms/top-k)")
+    p.add_argument("--spearman", action="store_true",
+                   help="also compute Spearman rank correlations")
     p.add_argument("--stats-json", metavar="PATH",
                    help="also dump the stats dict as JSON")
     p.add_argument("--trace", metavar="DIR",
@@ -42,10 +44,16 @@ def cmd_profile(args: argparse.Namespace) -> int:
     from tpuprof import ProfileReport, ProfilerConfig
     from tpuprof.utils.trace import phase_timer, trace_to
 
+    if args.spearman and args.single_pass:
+        print("tpuprof: error: --spearman needs the second scan "
+              "(incompatible with --single-pass)", file=sys.stderr)
+        return 2
+
     config = ProfilerConfig(
         backend=args.backend, bins=args.bins, corr_reject=args.corr_reject,
         batch_rows=args.batch_rows, quantile_sketch_size=args.sketch_size,
-        hll_precision=args.hll_precision, exact_passes=not args.single_pass)
+        hll_precision=args.hll_precision, exact_passes=not args.single_pass,
+        spearman=args.spearman)
 
     t0 = time.perf_counter()
     with trace_to(args.trace):

@@ -106,6 +106,13 @@ class HostBatch:
     # batch whose packing disagrees with its register width (a mismatched
     # idx would silently scatter into NEIGHBORING columns' registers)
     hll_precision: int = 11
+    # Arrow buffer bytes per column — feeds the report's "size in
+    # memory" parity fields (reference: df.memory_usage).  Dictionary
+    # buffers are tracked separately because batches SHARE them: their
+    # sizes merge by max, not sum (a per-batch sum counts the one
+    # dictionary once per batch — measured ~6x overstatement)
+    col_nbytes: Optional[Dict[str, int]] = None
+    col_dict_nbytes: Optional[Dict[str, int]] = None
 
 
 def _hash64(keys: np.ndarray) -> np.ndarray:
@@ -173,8 +180,17 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
     date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
 
+    col_nbytes: Dict[str, int] = {}
+    col_dict_nbytes: Dict[str, int] = {}
+
     def decode_column(i: int, spec: ColumnSpec) -> None:
         arr = batch.column(i)
+        # distinct keys per column: thread-safe dict writes
+        if isinstance(arr, pa.DictionaryArray):
+            col_nbytes[spec.name] = arr.indices.nbytes
+            col_dict_nbytes[spec.name] = arr.dictionary.nbytes
+        else:
+            col_nbytes[spec.name] = arr.nbytes
         if spec.role == "num":
             t = arr.type
             if pa.types.is_floating(t) and t.bit_width == 32:
@@ -243,7 +259,8 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
 
     return HostBatch(nrows=n, x=x, row_valid=row_valid, hll=hll_packed,
                      cat_codes=cat_codes, date_ints=date_ints,
-                     hll_precision=hll_precision)
+                     hll_precision=hll_precision, col_nbytes=col_nbytes,
+                     col_dict_nbytes=col_dict_nbytes)
 
 
 def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,

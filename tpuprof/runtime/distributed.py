@@ -132,6 +132,10 @@ def _merge_pair(a, b):
     """Combine two HostAggs (commutative — same laws as the device
     sketches; see tests/test_distributed.py)."""
     a.n_rows += b.n_rows
+    for name, nb in b.col_nbytes.items():
+        a.col_nbytes[name] = a.col_nbytes.get(name, 0) + nb
+    for name, nb in b.col_dict_nbytes.items():
+        a.col_dict_nbytes[name] = max(a.col_dict_nbytes.get(name, 0), nb)
     for name, mg in b.mg.items():
         a.mg[name].merge(mg)
     for name, cnt in b.cat_null.items():
