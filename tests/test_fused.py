@@ -134,3 +134,23 @@ def test_spearman_grid_kernel_close_to_exact():
 
     expect = pd.DataFrame(x).corr(method="spearman").to_numpy()
     np.testing.assert_allclose(got, expect, atol=0.02)
+
+
+def test_wide_tables_fall_back_to_xla():
+    """Past the kernels' VMEM width limits the runner must pick the XLA
+    formulations rather than fail at compile time."""
+    import jax
+    from tpuprof.config import ProfilerConfig
+    from tpuprof.runtime.mesh import MeshRunner
+
+    config = ProfilerConfig(batch_rows=64, use_fused=True, use_pallas=True)
+    runner = MeshRunner(config, n_num=fused.MAX_FUSED_COLS + 1, n_hash=0,
+                        devices=jax.devices()[:1])
+    assert not runner.use_fused
+    from tpuprof.kernels.pallas_hist import MAX_HIST_COLS
+    runner2 = MeshRunner(config, n_num=MAX_HIST_COLS + 1, n_hash=0,
+                         devices=jax.devices()[:1])
+    assert not runner2.use_pallas
+    narrow = MeshRunner(config, n_num=16, n_hash=0,
+                        devices=jax.devices()[:1])
+    assert narrow.use_fused and narrow.use_pallas

@@ -283,8 +283,8 @@ class TPUStatsBackend:
             # requested but the rank pass cannot run (single-pass mode or
             # a non-rescannable source) — say so instead of silently
             # omitting the matrix
-            import logging
-            logging.getLogger("tpuprof").warning(
+            from tpuprof.utils.trace import logger
+            logger.warning(
                 "spearman=True requires a rescannable source and "
                 "exact_passes=True; the spearman matrix was skipped")
         if recounter is None and config.exact_passes \

@@ -49,11 +49,27 @@ from tpuprof.kernels import moments as kmoments
 
 Array = jnp.ndarray
 
-R_TILE = 1024          # lane-axis (row) tile
 C_ALIGN = 8            # sublane-axis (column) padding multiple — the f32
                        # min sublane tile; 128 alignment is only required
                        # on the LANE axis, so typical column counts
                        # (e.g. 200) need no padding copy at all
+# The kernel holds the two (C, 2C) Gram blocks VMEM-resident plus ~6
+# (2C, R) temporaries per block, so the row tile shrinks as columns grow
+# and the whole formulation stops fitting VMEM past ~512 columns —
+# MeshRunner falls back to the XLA path beyond MAX_FUSED_COLS (empirical
+# compile probe on v5e; PERF.md).
+MAX_FUSED_COLS = 512
+R_TILE = 1024          # lane-axis (row) tile at narrow widths
+
+
+def _pick_r_tile(C: int) -> int:
+    if C <= 256:
+        return 1024
+    if C <= 384:
+        return 512
+    return 256
+
+
 _HI = jax.lax.Precision.HIGHEST
 
 
@@ -133,19 +149,20 @@ def _fused_tiles(xt: Array, row_valid: Array, shift: Array,
                  interpret: bool = False):
     cols, rows = xt.shape
     cpad = -cols % C_ALIGN
-    rpad = -rows % R_TILE
+    C = cols + cpad
+    r_tile = _pick_r_tile(C)
+    rpad = -rows % r_tile
     # row padding is marked invalid via rv; column padding rows are NaN
     xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
     rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
     shift_p = jnp.pad(shift.astype(jnp.float32), (0, cpad))[:, None]
-    C = cols + cpad
-    n_rt = (rows + rpad) // R_TILE
+    n_rt = (rows + rpad) // r_tile
     out = pl.pallas_call(
         _kernel,
         grid=(n_rt,),
         in_specs=[
-            pl.BlockSpec((C, R_TILE), lambda i: (0, i)),
-            pl.BlockSpec((1, R_TILE), lambda i: (0, i)),
+            pl.BlockSpec((C, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((1, r_tile), lambda i: (0, i)),
             pl.BlockSpec((C, 1), lambda i: (0, 0)),
         ],
         out_specs=[
@@ -279,19 +296,20 @@ def _spear_tiles(xt: Array, row_valid: Array, grid: Array,
     cols, rows = xt.shape
     n_grid = grid.shape[1]
     cpad = -cols % C_ALIGN
-    rpad = -rows % R_TILE
+    C = cols + cpad
+    r_tile = _pick_r_tile(C)
+    rpad = -rows % r_tile
     xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
     rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
     grid_p = jnp.pad(grid.astype(jnp.float32), ((0, cpad), (0, 0)),
                      constant_values=jnp.inf)
-    C = cols + cpad
-    n_rt = (rows + rpad) // R_TILE
+    n_rt = (rows + rpad) // r_tile
     g1, g2 = pl.pallas_call(
         functools.partial(_spear_kernel, n_grid=n_grid),
         grid=(n_rt,),
         in_specs=[
-            pl.BlockSpec((C, R_TILE), lambda i: (0, i)),
-            pl.BlockSpec((1, R_TILE), lambda i: (0, i)),
+            pl.BlockSpec((C, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((1, r_tile), lambda i: (0, i)),
             pl.BlockSpec((C, n_grid), lambda i: (0, 0)),
         ],
         out_specs=[

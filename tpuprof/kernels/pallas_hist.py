@@ -33,9 +33,17 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-R_TILE = 1024           # lane-axis (row) tile
 C_ALIGN = 8             # sublane-axis (column) alignment, f32
 MAX_BINS = 128
+# ~5 (C, R) f32/int32 temporaries live per block; the row tile shrinks
+# with width to stay inside VMEM (empirical compile probe on v5e), and
+# the mesh runtime falls back to the XLA scatter beyond MAX_HIST_COLS
+MAX_HIST_COLS = 1024
+R_TILE = 1024           # lane-axis (row) tile at narrow widths
+
+
+def _pick_r_tile(C: int) -> int:
+    return 1024 if C <= 512 else 256
 
 
 def _hist_kernel(xt_ref, rv_ref, lo_ref, scale_ref, mean_ref, out_ref,
@@ -83,7 +91,9 @@ def histogram_tiles(xt: jnp.ndarray, row_valid: jnp.ndarray,
         raise ValueError(f"pallas histogram supports bins <= {MAX_BINS}")
     cols, rows = xt.shape
     cpad = -cols % C_ALIGN
-    rpad = -rows % R_TILE
+    C = cols + cpad
+    r_tile = _pick_r_tile(C)
+    rpad = -rows % r_tile
     xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
     rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
     lo_p = jnp.pad(lo.astype(jnp.float32), (0, cpad))[:, None]
@@ -91,14 +101,13 @@ def histogram_tiles(xt: jnp.ndarray, row_valid: jnp.ndarray,
     scale_p = jnp.pad(nbins / width, (0, cpad))[:, None]
     mean_p = jnp.pad(mean.astype(jnp.float32), (0, cpad))[:, None]
 
-    C = cols + cpad
-    n_rt = (rows + rpad) // R_TILE
+    n_rt = (rows + rpad) // r_tile
     counts, dev = pl.pallas_call(
         functools.partial(_hist_kernel, nbins=nbins),
         grid=(n_rt,),
         in_specs=[
-            pl.BlockSpec((C, R_TILE), lambda i: (0, i)),
-            pl.BlockSpec((1, R_TILE), lambda i: (0, i)),
+            pl.BlockSpec((C, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((1, r_tile), lambda i: (0, i)),
             pl.BlockSpec((C, 1), lambda i: (0, 0)),
             pl.BlockSpec((C, 1), lambda i: (0, 0)),
             pl.BlockSpec((C, 1), lambda i: (0, 0)),

@@ -135,7 +135,10 @@ def _merge_pair(a, b):
     for name, nb in b.col_nbytes.items():
         a.col_nbytes[name] = a.col_nbytes.get(name, 0) + nb
     for name, nb in b.col_dict_nbytes.items():
-        a.col_dict_nbytes[name] = max(a.col_dict_nbytes.get(name, 0), nb)
+        # SUM across hosts: batches share a dictionary within a host's
+        # fragment stripe (hence per-host max in HostAgg.update) but each
+        # host holds its own dictionary object
+        a.col_dict_nbytes[name] = a.col_dict_nbytes.get(name, 0) + nb
     for name, mg in b.mg.items():
         a.mg[name].merge(mg)
     for name, cnt in b.cat_null.items():

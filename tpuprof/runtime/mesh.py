@@ -91,16 +91,22 @@ class MeshRunner:
         self.precision = config.hll_precision
         self.bins = config.bins
         # dense pallas binning beats XLA's serialized scatter on real TPU;
-        # the scatter path stays for CPU meshes and as an opt-out
+        # the scatter path stays for CPU meshes, very wide tables (the
+        # kernels keep per-column blocks VMEM-resident — see the
+        # MAX_*_COLS probes in each kernel module), and as an opt-out
+        from tpuprof.kernels.pallas_hist import MAX_BINS, MAX_HIST_COLS
+        hist_fits = self.bins <= MAX_BINS and n_num <= MAX_HIST_COLS
         if config.use_pallas is None:
-            self.use_pallas = (devs[0].platform == "tpu"
-                               and self.bins <= 128)
+            self.use_pallas = devs[0].platform == "tpu" and hist_fits
         else:
-            self.use_pallas = config.use_pallas and self.bins <= 128
+            self.use_pallas = config.use_pallas and hist_fits
         # fused single-read pallas pass A (kernels/fused.py) on real TPU;
-        # the per-kernel XLA formulation on CPU meshes
-        self.use_fused = (devs[0].platform == "tpu"
-                          if config.use_fused is None else config.use_fused)
+        # the per-kernel XLA formulation on CPU meshes and past the
+        # kernel's VMEM width limit
+        fused_fits = n_num <= fused.MAX_FUSED_COLS
+        self.use_fused = (devs[0].platform == "tpu" and fused_fits
+                          if config.use_fused is None
+                          else bool(config.use_fused) and fused_fits)
         self._sh_rows = NamedSharding(self.mesh, P("data"))
         self._sh_cols_rows = NamedSharding(self.mesh, P(None, "data"))
         self._sh_rep = NamedSharding(self.mesh, P())
