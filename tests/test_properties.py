@@ -1,0 +1,168 @@
+"""Property-based tests (SURVEY §4.1-4.2): every sketch state is a
+commutative monoid — ``merge(s(A), s(B)) ≡ s(A ∪ B)`` — under arbitrary
+data splits and value classes (uniform/zipf/constant/all-null/±inf/NaN),
+and sketch estimates respect their published bounds.  Hypothesis drives
+the data generation; shapes stay small so the suite remains CI-fast."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from hypothesis import given, settings, strategies as st
+
+from tpuprof.ingest.sample import RowSampler
+from tpuprof.kernels import corr, fused, hll, moments
+
+SETTINGS = dict(max_examples=25, deadline=None)
+
+
+@st.composite
+def column_batches(draw):
+    """(full array, split point) over a mixed bag of value classes."""
+    n = draw(st.integers(8, 300))
+    kind = draw(st.sampled_from(
+        ["normal", "uniform", "zipf", "constant", "allnan", "mixed"]))
+    seed = draw(st.integers(0, 2**31 - 1))
+    rng = np.random.default_rng(seed)
+    if kind == "normal":
+        x = rng.normal(draw(st.floats(-1e3, 1e3)), 10.0, n)
+    elif kind == "uniform":
+        x = rng.uniform(-5, 5, n)
+    elif kind == "zipf":
+        x = rng.zipf(1.8, n).astype(np.float64)
+    elif kind == "constant":
+        x = np.full(n, draw(st.floats(-1e3, 1e3)))
+    elif kind == "allnan":
+        x = np.full(n, np.nan)
+    else:
+        x = rng.normal(0, 1, n)
+        x[rng.random(n) < 0.2] = np.nan
+        x[rng.random(n) < 0.05] = np.inf
+        x[rng.random(n) < 0.05] = -np.inf
+        x[rng.random(n) < 0.1] = 0.0
+    split = draw(st.integers(1, n - 1)) if n > 1 else 0
+    return x.astype(np.float32), split
+
+
+def _mom_state(x):
+    s = moments.init(1)
+    rv = jnp.ones(x.shape[0], dtype=bool)
+    return jax.jit(moments.update)(s, jnp.asarray(x)[:, None], rv)
+
+
+@given(column_batches())
+@settings(**SETTINGS)
+def test_moments_merge_law(batch):
+    x, split = batch
+    whole = moments.finalize(jax.device_get(_mom_state(x)))
+    merged = moments.finalize(jax.device_get(jax.jit(moments.merge)(
+        _mom_state(x[:split]), _mom_state(x[split:]))))
+    np.testing.assert_array_equal(whole["n"], merged["n"])
+    np.testing.assert_array_equal(whole["n_missing"], merged["n_missing"])
+    np.testing.assert_array_equal(whole["min"], merged["min"])
+    np.testing.assert_array_equal(whole["max"], merged["max"])
+    for k in ("mean", "variance", "sum"):
+        np.testing.assert_allclose(whole[k], merged[k], rtol=5e-4,
+                                   atol=1e-4, equal_nan=True, err_msg=k)
+
+
+@given(column_batches(), st.integers(0, 2**31 - 1))
+@settings(**SETTINGS)
+def test_corr_merge_law(batch, seed):
+    x, split = batch
+    rng = np.random.default_rng(seed)
+    y = (x * rng.uniform(-2, 2) + rng.normal(0, 1, x.shape[0])).astype(
+        np.float32)
+    m = np.stack([x, y], axis=1)
+
+    def state(part):
+        return jax.jit(corr.update)(
+            corr.init(2), jnp.asarray(part),
+            jnp.ones(part.shape[0], dtype=bool))
+
+    whole = corr.finalize(jax.device_get(state(m)))
+    merged = corr.finalize(jax.device_get(jax.jit(corr.merge)(
+        state(m[:split]), state(m[split:]))))
+    np.testing.assert_allclose(whole, merged, atol=5e-3, equal_nan=True)
+
+
+@given(st.integers(1, 5000), st.integers(4, 8), st.integers(0, 2**31 - 1))
+@settings(**SETTINGS)
+def test_hll_merge_and_error_bound(n_distinct, precision, seed):
+    rng = np.random.default_rng(seed)
+    vals = rng.integers(0, n_distinct, 4000)
+    # splitmix-style avalanche, mirrors ingest hashing determinism
+    z = vals.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    h64 = z ^ (z >> np.uint64(31))
+    packed = hll.pack(h64[:, None], np.ones((4000, 1), bool), precision)
+
+    upd = jax.jit(hll.update)
+    whole = upd(hll.init(1, precision), jnp.asarray(packed))
+    a = upd(hll.init(1, precision), jnp.asarray(packed[:1500]))
+    b = upd(hll.init(1, precision), jnp.asarray(packed[1500:]))
+    merged = jax.jit(hll.merge)(a, b)
+    np.testing.assert_array_equal(np.asarray(whole), np.asarray(merged))
+
+    true = len(np.unique(vals))
+    est = hll.finalize(np.asarray(whole))[0]
+    rel_err = abs(est - true) / max(true, 1)
+    assert rel_err < 6 * 1.04 / np.sqrt(2 ** precision)  # ~6 sigma
+
+
+@given(st.integers(2, 2000), st.integers(1, 6), st.integers(0, 2**31 - 1))
+@settings(**SETTINGS)
+def test_row_sampler_is_exact_topk(total, n_batches, seed):
+    """The sampler's kept set must equal the global top-k priorities no
+    matter how the stream is batched."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (total, 1)).astype(np.float32)
+    k = 64
+    s = RowSampler(k=k, n_num=1, seed=seed % 1000)
+    bounds = np.sort(rng.choice(np.arange(1, total), size=min(
+        n_batches - 1, total - 1), replace=False)) if n_batches > 1 else []
+    prios = []
+    start = 0
+    step = 0
+    for end in list(bounds) + [total]:
+        chunk = x[start:end]
+        s.update(chunk, chunk.shape[0])
+        prios.append(np.random.default_rng(
+            (seed % 1000, 0, step)).random(chunk.shape[0]))
+        step += 1
+        start = end
+    allp = np.concatenate(prios)
+    top = np.sort(allp)[-k:] if allp.size >= k else np.sort(allp)
+    np.testing.assert_array_equal(np.sort(s.prio), top)
+
+
+@given(st.integers(8, 200), st.integers(1, 60), st.integers(0, 2**31 - 1))
+@settings(max_examples=10, deadline=None)
+def test_fused_kernel_property_vs_xla(rows, cols, seed):
+    """Interpret-mode fused kernel ≡ XLA twin over random shapes and
+    value classes (the §4.1 oracle property, one level down)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 50, (rows, cols)).astype(np.float32)
+    x[rng.random((rows, cols)) < 0.15] = np.nan
+    x[rng.random((rows, cols)) < 0.03] = np.inf
+    rv = rng.random(rows) < 0.9
+    shift = np.zeros(cols, dtype=np.float32)
+    mom = moments.init(cols)
+    co = dict(corr.init(cols), set=jnp.ones((), jnp.int32))
+    xt = jnp.asarray(np.ascontiguousarray(x.T))
+    mp, cp = fused.update(dict(mom, shift=jnp.asarray(shift)),
+                          dict(co, shift=jnp.asarray(shift)),
+                          xt, jnp.asarray(rv), interpret=True)
+    mx, cx = fused.update_xla(dict(mom, shift=jnp.asarray(shift)),
+                              dict(co, shift=jnp.asarray(shift)),
+                              xt, jnp.asarray(rv))
+    fp = moments.finalize(jax.device_get(mp))
+    fx = moments.finalize(jax.device_get(mx))
+    for k in ("n", "n_zeros", "n_inf", "n_missing", "min", "max"):
+        np.testing.assert_array_equal(fp[k], fx[k], err_msg=k)
+    for k in ("mean", "variance", "skewness", "kurtosis"):
+        np.testing.assert_allclose(fp[k], fx[k], rtol=2e-3, atol=1e-3,
+                                   equal_nan=True, err_msg=k)
+    np.testing.assert_allclose(
+        corr.finalize(jax.device_get(cp)),
+        corr.finalize(jax.device_get(cx)), atol=5e-3, equal_nan=True)
