@@ -1,0 +1,138 @@
+"""Batch-profile checkpoint/resume (SURVEY §5): a crashed pass-A scan
+must resume from the last checkpoint and finish with stats identical to
+an uninterrupted run."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof import ProfilerConfig
+from tpuprof.backends.tpu import HostAgg, TPUStatsBackend
+
+
+@pytest.fixture()
+def parquet_source(tmp_path):
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame({
+        "a": rng.normal(7.0, 2.0, 4000),
+        "b": rng.exponential(1.5, 4000),
+        "c": rng.choice(["x", "y", "z"], 4000),
+    })
+    df.loc[rng.choice(4000, 200, replace=False), "a"] = np.nan
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+    return path
+
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("batch_rows", 256)
+    return ProfilerConfig(backend="tpu",
+                          checkpoint_path=str(tmp_path / "scan.ckpt"),
+                          checkpoint_every_batches=3, **kw)
+
+
+def _key_stats(stats):
+    out = {}
+    for name, v in stats["variables"].items():
+        out[name] = {k: v.get(k) for k in
+                     ("count", "n_missing", "mean", "std", "p50",
+                      "distinct_count", "type")}
+    return out
+
+
+def test_clean_run_removes_checkpoint(tmp_path, parquet_source):
+    cfg = _cfg(tmp_path)
+    stats = TPUStatsBackend().collect(parquet_source, cfg)
+    assert stats["table"]["n"] == 4000
+    assert not (tmp_path / "scan.ckpt").exists()
+
+
+def test_crash_then_resume_matches_uninterrupted(tmp_path, parquet_source,
+                                                 monkeypatch):
+    control = TPUStatsBackend().collect(
+        parquet_source, ProfilerConfig(backend="tpu", batch_rows=256))
+
+    cfg = _cfg(tmp_path)
+    calls = {"n": 0}
+    real_update = HostAgg.update
+
+    def crashing_update(self, hb):
+        calls["n"] += 1
+        if calls["n"] == 8:
+            raise RuntimeError("injected crash mid-scan")
+        return real_update(self, hb)
+
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        TPUStatsBackend().collect(parquet_source, cfg)
+    monkeypatch.setattr(HostAgg, "update", real_update)
+    assert (tmp_path / "scan.ckpt").exists()
+
+    resumed = TPUStatsBackend().collect(parquet_source, cfg)
+    assert resumed["table"]["n"] == 4000
+    assert not (tmp_path / "scan.ckpt").exists()
+
+    ctrl, got = _key_stats(control), _key_stats(resumed)
+    for name in ctrl:
+        for field, expect in ctrl[name].items():
+            value = got[name][field]
+            if isinstance(expect, float) and np.isfinite(expect):
+                assert value == pytest.approx(expect, rel=1e-5), \
+                    (name, field)
+            else:
+                assert value == expect or (
+                    value != value and expect != expect), (name, field)
+
+
+def test_mismatched_checkpoint_rejected(tmp_path, parquet_source,
+                                        monkeypatch):
+    cfg = _cfg(tmp_path)
+    calls = {"n": 0}
+    real_update = HostAgg.update
+
+    def crashing_update(self, hb):
+        calls["n"] += 1
+        if calls["n"] == 5:
+            raise RuntimeError("boom")
+        return real_update(self, hb)
+
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    with pytest.raises(RuntimeError):
+        TPUStatsBackend().collect(parquet_source, cfg)
+    monkeypatch.setattr(HostAgg, "update", real_update)
+
+    bad = _cfg(tmp_path, batch_rows=512)
+    with pytest.raises(ValueError, match="batch_rows"):
+        TPUStatsBackend().collect(parquet_source, bad)
+
+
+def test_mismatched_source_rejected(tmp_path, parquet_source, monkeypatch):
+    """Resuming against different data (same schema) must be refused."""
+    cfg = _cfg(tmp_path)
+    calls = {"n": 0}
+    real_update = HostAgg.update
+
+    def crashing_update(self, hb):
+        calls["n"] += 1
+        if calls["n"] == 5:
+            raise RuntimeError("boom")
+        return real_update(self, hb)
+
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    with pytest.raises(RuntimeError):
+        TPUStatsBackend().collect(parquet_source, cfg)
+    monkeypatch.setattr(HostAgg, "update", real_update)
+
+    rng = np.random.default_rng(9)
+    other = pd.DataFrame({
+        "a": rng.normal(0.0, 1.0, 3000),
+        "b": rng.exponential(2.0, 3000),
+        "c": rng.choice(["x", "y", "z"], 3000),
+    })
+    other_path = str(tmp_path / "other.parquet")
+    pq.write_table(pa.Table.from_pandas(other, preserve_index=False),
+                   other_path)
+    with pytest.raises(ValueError, match="source_fp"):
+        TPUStatsBackend().collect(other_path, cfg)

@@ -144,6 +144,87 @@ class Recounter:
                          ).sort_values(ascending=False)
 
 
+class _CollectCheckpoint:
+    """Batch-granular resumability for the pass-A scan (SURVEY §5):
+    persist (device state, host sketches, batch cursor) every N batches;
+    resume = load + skip the already-folded prefix of the deterministic
+    batch stream.  Single-process only in v1 — each host would otherwise
+    need its own artifact and a coordinated cursor.  Known cost: the
+    skipped prefix is still read+Arrow-decoded on resume (the skip is
+    per-batch, not per-fragment); the folds and transfers it saves are
+    the dominant share of scan time."""
+
+    _META_KEYS = ("n_num", "n_hash", "batch_rows", "hll_precision",
+                  "native_hash", "source_fp", "quantile_sketch_size",
+                  "topk_capacity", "seed")
+
+    def __init__(self, config: ProfilerConfig, plan, runner, pshard,
+                 source_fp: str):
+        if pshard[1] != 1:
+            raise ValueError(
+                "checkpoint_path is single-process only; multi-host "
+                "profiles restart from the beginning on failure")
+        self.path = config.checkpoint_path
+        self.every = max(int(config.checkpoint_every_batches), 1)
+        self.config = config
+        self.plan = plan
+        self.runner = runner
+        self.source_fp = source_fp
+
+    def exists(self) -> bool:
+        import os
+        return os.path.exists(self.path)
+
+    def due(self, cursor: int) -> bool:
+        return cursor % self.every == 0
+
+    def _meta(self) -> Dict[str, Any]:
+        from tpuprof import native
+        return {"n_num": self.plan.n_num, "n_hash": self.plan.n_hash,
+                "batch_rows": self.config.batch_rows,
+                "hll_precision": self.config.hll_precision,
+                "native_hash": native.available(),
+                "source_fp": self.source_fp,
+                "quantile_sketch_size": self.config.quantile_sketch_size,
+                "topk_capacity": self.config.topk_capacity,
+                "seed": self.config.seed}
+
+    def save(self, state, sampler, hostagg, host_hll, cursor) -> None:
+        from tpuprof.runtime import checkpoint as ckpt
+        ckpt.save(self.path, state,
+                  {"sampler": sampler, "hostagg": hostagg,
+                   "host_hll": host_hll}, cursor, meta=self._meta())
+        log_event("collect_checkpoint", cursor=cursor, path=self.path)
+
+    def load(self):
+        """(state, sampler, hostagg, host_hll, cursor) from the artifact,
+        after refusing any config/source divergence from the saved
+        prefix."""
+        from tpuprof.runtime import checkpoint as ckpt
+        payload = ckpt.load_payload(self.path)
+        meta = payload["meta"]
+        mine = self._meta()
+        for key in self._META_KEYS:
+            if meta.get(key) != mine[key]:
+                raise ValueError(
+                    f"checkpoint {key}={meta.get(key)!r} does not match "
+                    f"this run's {mine[key]!r} — the batch stream or "
+                    "sketch shapes would diverge from the saved prefix")
+        state = ckpt.materialize(payload, self.runner.init_pass_a())
+        blob = payload["host_blob"]
+        log_event("collect_resume", cursor=payload["cursor"],
+                  path=self.path)
+        return (state, blob["sampler"], blob["hostagg"],
+                blob["host_hll"], payload["cursor"])
+
+    def clear(self) -> None:
+        import os
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+
 class TPUStatsBackend:
     """Profile Arrow-readable sources with the fused sharded scan."""
 
@@ -182,6 +263,20 @@ class TPUStatsBackend:
             allgather_objects(native.available()))
         host_hll = khll.HostRegisters(plan.n_hash, config.hll_precision) \
             if use_host_hll else None
+        # ---- batch-granular resumability (SURVEY §5 checkpoint/resume):
+        # the pass-A scan persists (device state, host sketches, batch
+        # cursor) every N batches; a crashed profile resumes by skipping
+        # the already-folded prefix of the (deterministic) batch stream.
+        resume = _CollectCheckpoint(config, plan, runner, pshard,
+                                    ingest.fingerprint()) \
+            if config.checkpoint_path else None
+        skip = 0
+        if resume is not None and resume.exists():
+            state, sampler, hostagg, host_hll, skip = resume.load()
+        else:
+            state = None
+        cursor = skip
+
         with phase_timer("scan_a"):
             # centering shift from the first batch's prefix — any value
             # near the data scale conditions the f32 sums equally well.
@@ -190,11 +285,14 @@ class TPUStatsBackend:
             # the global mesh carries the same shift and the collective
             # merge's rebase is exactly the identity.
             batches = prefetch_prepared(ingest, plan, pad,
-                                        config.hll_precision)
+                                        config.hll_precision,
+                                        skip_batches=skip)
             first_hb = next(batches, None)
-            shift = merge_shift_estimates(
-                estimate_shift(first_hb) if first_hb is not None else None)
-            state = runner.init_pass_a(shift)
+            if state is None:
+                shift = merge_shift_estimates(
+                    estimate_shift(first_hb)
+                    if first_hb is not None else None)
+                state = runner.init_pass_a(shift)
             if first_hb is not None:
                 for hb in itertools.chain((first_hb,), batches):
                     db = runner.put_batch(hb, with_hll=host_hll is None)
@@ -204,6 +302,15 @@ class TPUStatsBackend:
                     if host_hll is not None:
                         host_hll.update(hb.hll, hb.nrows)
                     hostagg.update(hb)
+                    cursor += 1
+                    if resume is not None and resume.due(cursor):
+                        resume.save(state, sampler, hostagg, host_hll,
+                                    cursor)
+        if resume is not None:
+            # pass A complete: keep the final state on disk so a crash
+            # during merge/pass-B resumes with the whole stream skipped
+            # instead of rescanning; cleared only after assembly
+            resume.save(state, sampler, hostagg, host_hll, cursor)
         with phase_timer("merge"):
             res_a = runner.finalize_a(state)
             # cross-host: device sketches already merged by the mesh
@@ -294,10 +401,13 @@ class TPUStatsBackend:
             for hb in ingest.batches(config.hll_precision):
                 recounter.update(hb)
 
-        return _assemble(plan, config, ingest.sample(config.sample_rows),
-                         hostagg, momf, rho_all, quants, sample_vals,
-                         sample_kept, hll_est, hists, mad, recounter, probes,
-                         rho_spear=rho_spear)
+        stats = _assemble(plan, config, ingest.sample(config.sample_rows),
+                          hostagg, momf, rho_all, quants, sample_vals,
+                          sample_kept, hll_est, hists, mad, recounter,
+                          probes, rho_spear=rho_spear)
+        if resume is not None:
+            resume.clear()           # profile assembled: artifact is stale
+        return stats
 
 
 # ---------------------------------------------------------------------------

@@ -37,6 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also dump the stats dict as JSON")
     p.add_argument("--trace", metavar="DIR",
                    help="capture a jax.profiler trace into DIR")
+    p.add_argument("--checkpoint", metavar="PATH",
+                   help="persist the scan every N batches and resume "
+                        "from PATH after a crash")
+    p.add_argument("--checkpoint-every", type=int, default=64,
+                   metavar="N", help="batches between checkpoints")
     return parser
 
 
@@ -53,7 +58,8 @@ def cmd_profile(args: argparse.Namespace) -> int:
         backend=args.backend, bins=args.bins, corr_reject=args.corr_reject,
         batch_rows=args.batch_rows, quantile_sketch_size=args.sketch_size,
         hll_precision=args.hll_precision, exact_passes=not args.single_pass,
-        spearman=args.spearman)
+        spearman=args.spearman, checkpoint_path=args.checkpoint,
+        checkpoint_every_batches=args.checkpoint_every)
 
     t0 = time.perf_counter()
     with trace_to(args.trace):

@@ -48,6 +48,12 @@ class ProfilerConfig:
                                     # False => single-pass streaming mode with
                                     # sample-derived histograms.
     mesh_devices: Optional[int] = None  # None => all available devices
+    checkpoint_path: Optional[str] = None   # batch-profile resumability:
+                                            # persist the pass-A scan here
+                                            # every checkpoint_every_batches
+                                            # and resume from it on restart
+                                            # (single-process; SURVEY §5)
+    checkpoint_every_batches: int = 64
     seed: int = 0                   # PRNG seed for the sample sketch
     use_pallas: Optional[bool] = None   # None = auto (on for real TPU):
                                         # dense pallas histogram kernel vs

@@ -265,12 +265,14 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
 
 def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                       hll_precision: int, depth: int = 2,
-                      hashes: bool = True):
+                      hashes: bool = True, skip_batches: int = 0):
     """Yield prepared HostBatches with a background thread running
     ``depth`` batches ahead, so Arrow decode + hashing + buffer layout
     overlap the device scan instead of serializing with it.  Exceptions
     from the reader (including the fragment-retry path) re-raise in the
-    consumer."""
+    consumer.  ``skip_batches`` drops the stream's first N raw batches
+    without preparing them (checkpoint resume — the batch order of a
+    rescannable source is deterministic)."""
     import queue
     import threading
 
@@ -294,7 +296,9 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
 
     def worker():
         try:
-            for rb in ingest.raw_batches():
+            for k, rb in enumerate(ingest.raw_batches()):
+                if k < skip_batches:
+                    continue
                 if not _put(prepare_batch(rb, plan, pad, hll_precision,
                                           hashes=hashes)):
                     return
@@ -358,6 +362,30 @@ class ArrowIngest:
                         else self._dataset.schema)
         self.plan = ColumnPlan.from_schema(arrow_schema)
         self.rescannable = True
+
+    def fingerprint(self) -> str:
+        """Stable identity of the source's content layout — column
+        names/types plus per-fragment paths and sizes (row count for
+        in-memory tables).  Guards checkpoint resume against silently
+        mixing a saved scan prefix with a different dataset."""
+        import hashlib
+        h = hashlib.sha256()
+        schema = (self._table.schema if self._table is not None
+                  else self._dataset.schema)
+        for field in schema:
+            h.update(f"{field.name}:{field.type}".encode())
+        if self._table is not None:
+            h.update(f"rows={self._table.num_rows}".encode())
+        else:
+            import os
+            for frag in self._dataset.get_fragments():
+                path = getattr(frag, "path", "")
+                try:
+                    size = os.path.getsize(path) if path else 0
+                except OSError:
+                    size = 0
+                h.update(f"{path}:{size}".encode())
+        return h.hexdigest()
 
     def raw_batches(self) -> Iterator[pa.RecordBatch]:
         pidx, pcount = self.process_shard
