@@ -137,15 +137,19 @@ def test_spearman_grid_kernel_close_to_exact():
 
 
 def test_wide_tables_fall_back_to_xla():
-    """Past the kernels' VMEM width limits the runner must pick the XLA
-    formulations rather than fail at compile time."""
+    """Past the kernels' VMEM width limits the runner must pick the
+    tiled kernel, then the XLA formulations, rather than fail at
+    compile time."""
     import jax
     from tpuprof.config import ProfilerConfig
     from tpuprof.runtime.mesh import MeshRunner
 
     config = ProfilerConfig(batch_rows=64, use_fused=True, use_pallas=True)
-    runner = MeshRunner(config, n_num=fused.MAX_FUSED_COLS + 1, n_hash=0,
-                        devices=jax.devices()[:1])
+    wide = MeshRunner(config, n_num=fused.MAX_FUSED_COLS + 1, n_hash=0,
+                      devices=jax.devices()[:1])
+    assert wide.use_fused and not wide.spear_grid   # tiled kernel tier
+    runner = MeshRunner(config, n_num=fused.MAX_FUSED_COLS_WIDE + 1,
+                        n_hash=0, devices=jax.devices()[:1])
     assert not runner.use_fused
     from tpuprof.kernels.pallas_hist import MAX_HIST_COLS
     runner2 = MeshRunner(config, n_num=MAX_HIST_COLS + 1, n_hash=0,
@@ -153,4 +157,42 @@ def test_wide_tables_fall_back_to_xla():
     assert not runner2.use_pallas
     narrow = MeshRunner(config, n_num=16, n_hash=0,
                         devices=jax.devices()[:1])
-    assert narrow.use_fused and narrow.use_pallas
+    assert narrow.use_fused and narrow.use_pallas and narrow.spear_grid
+
+
+@pytest.mark.parametrize("rows,cols", [(300, 70), (700, 300)])
+def test_wide_tiled_kernel_matches_xla(rows, cols):
+    """The column-tiled kernel must agree with the XLA twin exactly like
+    the narrow kernel does (interpret mode; tiles exercise the i/j/r
+    grid even at small shapes via the 256-column padding)."""
+    x, rv = _mk_batch(rows, cols, seed=3)
+    xt = jnp.asarray(np.ascontiguousarray(x.T))
+    rvj = jnp.asarray(rv)
+    shift = np.full(cols, 50.0, dtype=np.float32)
+    mom0, co0 = _init(cols, shift)
+
+    sums, counts, P, S1, S2, N = fused._fused_tiles_wide(
+        xt, rvj, jnp.asarray(shift), interpret=True)
+    mom_p = {
+        "shift": mom0["shift"],
+        "n": mom0["n"] + counts[:, 0],
+        "s1": sums[:, 0], "s2": sums[:, 1], "s3": sums[:, 2],
+        "s4": sums[:, 3],
+        "minv": sums[:, 4], "maxv": sums[:, 5],
+        "fmin": sums[:, 6], "fmax": sums[:, 7],
+        "n_zeros": counts[:, 1], "n_inf": counts[:, 2],
+        "n_missing": counts[:, 3],
+    }
+    co_p = fused._fold_corr(co0, P, S1, S2, N)
+    mom_x, co_x = fused.update_xla(mom0, co0, xt, rvj)
+
+    fp = moments.finalize(jax.device_get(mom_p))
+    fx = moments.finalize(jax.device_get(mom_x))
+    for k in ("n", "n_zeros", "n_inf", "n_missing", "min", "max"):
+        np.testing.assert_array_equal(fp[k], fx[k], err_msg=k)
+    for k in ("mean", "variance", "skewness", "kurtosis"):
+        np.testing.assert_allclose(fp[k], fx[k], rtol=5e-4, atol=1e-5,
+                                   equal_nan=True, err_msg=k)
+    np.testing.assert_allclose(
+        corr.finalize(jax.device_get(co_p)),
+        corr.finalize(jax.device_get(co_x)), atol=5e-4, equal_nan=True)

@@ -170,6 +170,7 @@ class _CollectCheckpoint:
         self.plan = plan
         self.runner = runner
         self.source_fp = source_fp
+        self.last_saved = -1            # cursor of the newest artifact
 
     def exists(self) -> bool:
         import os
@@ -194,6 +195,7 @@ class _CollectCheckpoint:
         ckpt.save(self.path, state,
                   {"sampler": sampler, "hostagg": hostagg,
                    "host_hll": host_hll}, cursor, meta=self._meta())
+        self.last_saved = cursor
         log_event("collect_checkpoint", cursor=cursor, path=self.path)
 
     def load(self):
@@ -212,6 +214,7 @@ class _CollectCheckpoint:
                     "sketch shapes would diverge from the saved prefix")
         state = ckpt.materialize(payload, self.runner.init_pass_a())
         blob = payload["host_blob"]
+        self.last_saved = payload["cursor"]
         log_event("collect_resume", cursor=payload["cursor"],
                   path=self.path)
         return (state, blob["sampler"], blob["hostagg"],
@@ -306,7 +309,7 @@ class TPUStatsBackend:
                     if resume is not None and resume.due(cursor):
                         resume.save(state, sampler, hostagg, host_hll,
                                     cursor)
-        if resume is not None:
+        if resume is not None and resume.last_saved != cursor:
             # pass A complete: keep the final state on disk so a crash
             # during merge/pass-B resumes with the whole stream skipped
             # instead of rescanning; cleared only after assembly
@@ -350,7 +353,7 @@ class TPUStatsBackend:
             spear_state = None
             if config.spearman:
                 spear_state = runner.init_spearman()
-                if runner.use_fused:
+                if runner.spear_grid:
                     # pallas tier: dense-compare ranks on a G-point grid
                     spear_grid = runner.put_replicated(
                         sampler.cdf_grid(config.spearman_grid),
@@ -372,7 +375,7 @@ class TPUStatsBackend:
                     db = runner.put_batch(hb, with_hll=False)
                     state_b = runner.step_b(state_b, db, lo_d, hi_d, mean_d)
                     if spear_state is not None:
-                        if runner.use_fused:
+                        if runner.spear_grid:
                             spear_state = runner.step_spearman_grid(
                                 spear_state, db, spear_grid)
                         else:

@@ -364,10 +364,12 @@ class ArrowIngest:
         self.rescannable = True
 
     def fingerprint(self) -> str:
-        """Stable identity of the source's content layout — column
-        names/types plus per-fragment paths and sizes (row count for
-        in-memory tables).  Guards checkpoint resume against silently
-        mixing a saved scan prefix with a different dataset."""
+        """Stable identity of the source's content — column names/types,
+        plus per-fragment path/size/mtime for file-backed datasets and a
+        content hash of the leading rows for in-memory tables (row count
+        alone would accept same-shape different data).  Guards checkpoint
+        resume against silently mixing a saved scan prefix with a
+        different dataset."""
         import hashlib
         h = hashlib.sha256()
         schema = (self._table.schema if self._table is not None
@@ -376,15 +378,23 @@ class ArrowIngest:
             h.update(f"{field.name}:{field.type}".encode())
         if self._table is not None:
             h.update(f"rows={self._table.num_rows}".encode())
+            head = self._table.slice(0, 4096)
+            for batch in head.to_batches():
+                for col in batch.columns:
+                    for buf in col.buffers():
+                        if buf is not None:
+                            h.update(memoryview(buf))
         else:
             import os
             for frag in self._dataset.get_fragments():
                 path = getattr(frag, "path", "")
                 try:
-                    size = os.path.getsize(path) if path else 0
+                    stat = os.stat(path) if path else None
                 except OSError:
-                    size = 0
-                h.update(f"{path}:{size}".encode())
+                    stat = None
+                size = stat.st_size if stat else 0
+                mtime = int(stat.st_mtime_ns) if stat else 0
+                h.update(f"{path}:{size}:{mtime}".encode())
         return h.hexdigest()
 
     def raw_batches(self) -> Iterator[pa.RecordBatch]:

@@ -53,12 +53,15 @@ C_ALIGN = 8            # sublane-axis (column) padding multiple — the f32
                        # min sublane tile; 128 alignment is only required
                        # on the LANE axis, so typical column counts
                        # (e.g. 200) need no padding copy at all
-# The kernel holds the two (C, 2C) Gram blocks VMEM-resident plus ~6
-# (2C, R) temporaries per block, so the row tile shrinks as columns grow
-# and the whole formulation stops fitting VMEM past ~512 columns —
-# MeshRunner falls back to the XLA path beyond MAX_FUSED_COLS (empirical
-# compile probe on v5e; PERF.md).
+# The narrow kernel holds the two (C, 2C) Gram blocks VMEM-resident plus
+# ~6 (2C, R) temporaries per block, so the row tile shrinks as columns
+# grow and the whole formulation stops fitting VMEM past ~512 columns
+# (empirical compile probe on v5e; PERF.md).  Wider tables switch to the
+# column-tiled kernel (below) up to MAX_FUSED_COLS_WIDE; MeshRunner
+# falls back to the XLA path beyond that.
 MAX_FUSED_COLS = 512
+MAX_FUSED_COLS_WIDE = 2048     # compile-verified on hardware; beyond
+                               # this the XLA path takes over
 R_TILE = 1024          # lane-axis (row) tile at narrow widths
 
 
@@ -80,32 +83,8 @@ def _kernel(xt_ref, rv_ref, shift_ref, sums_ref, counts_ref,
     rv = rv_ref[...] > 0                  # (1, R) bool
     shift = shift_ref[...]                # (C, 1)
 
-    isnan = jnp.isnan(x)
-    notnull = rv & ~isnan                 # non-null (±inf included)
-    finite = notnull & ~jnp.isinf(x)
-    m = finite.astype(jnp.float32)
-    d = jnp.where(finite, x - shift, 0.0)
-    d2 = d * d
-
-    s1 = jnp.sum(d, axis=1, keepdims=True)
-    s2 = jnp.sum(d2, axis=1, keepdims=True)
-    s3 = jnp.sum(d2 * d, axis=1, keepdims=True)
-    s4 = jnp.sum(d2 * d2, axis=1, keepdims=True)
-    minv = jnp.min(jnp.where(notnull, x, jnp.inf), axis=1, keepdims=True)
-    maxv = jnp.max(jnp.where(notnull, x, -jnp.inf), axis=1, keepdims=True)
-    fmin = jnp.min(jnp.where(finite, x, jnp.inf), axis=1, keepdims=True)
-    fmax = jnp.max(jnp.where(finite, x, -jnp.inf), axis=1, keepdims=True)
-    sums = jnp.concatenate([s1, s2, s3, s4, minv, maxv, fmin, fmax], axis=1)
-
-    i32 = jnp.int32
-    n = jnp.sum(finite.astype(i32), axis=1, keepdims=True)
-    nz = jnp.sum((notnull & (x == 0.0)).astype(i32), axis=1, keepdims=True)
-    ninf = jnp.sum((notnull & jnp.isinf(x)).astype(i32), axis=1,
-                   keepdims=True)
-    nmiss = jnp.sum((rv & isnan).astype(i32), axis=1, keepdims=True)
-    counts = jnp.concatenate(
-        [n, nz, ninf, nmiss, jnp.zeros_like(n), jnp.zeros_like(n),
-         jnp.zeros_like(n), jnp.zeros_like(n)], axis=1)
+    masks = _masks(x, rv, shift)
+    m, d, d2 = masks[3], masks[4], masks[5]
 
     # MXU: contract the lane (row) axis of both operands
     dm = jnp.concatenate([d, m], axis=0)            # (2C, R)
@@ -119,29 +98,69 @@ def _kernel(xt_ref, rv_ref, shift_ref, sums_ref, counts_ref,
 
     @pl.when(i == 0)
     def _init():
-        # identity elements: 0 for the additive lanes, ±inf for min/max
-        # (lanes 4/6 min, 5/7 max); built via iota — pallas kernels cannot
-        # capture host constants
-        lane = jax.lax.broadcasted_iota(jnp.int32, sums_ref.shape, 1)
-        ident = jnp.where((lane == 4) | (lane == 6), jnp.inf,
-                          jnp.where((lane == 5) | (lane == 7),
-                                    -jnp.inf, 0.0)).astype(jnp.float32)
-        sums_ref[...] = ident
+        sums_ref[...] = _stats_identity(sums_ref.shape)
         counts_ref[...] = jnp.zeros_like(counts_ref)
         gram1_ref[...] = jnp.zeros_like(gram1_ref)
         gram2_ref[...] = jnp.zeros_like(gram2_ref)
 
-    # combine per lane role (slice-assign would lower to an unsupported
-    # scatter): lanes 0-3 add, 4/6 min, 5/7 max
-    acc = sums_ref[...]
-    lane2 = jax.lax.broadcasted_iota(jnp.int32, acc.shape, 1)
-    sums_ref[...] = jnp.where(
-        lane2 < 4, acc + sums,
-        jnp.where((lane2 == 4) | (lane2 == 6),
-                  jnp.minimum(acc, sums), jnp.maximum(acc, sums)))
-    counts_ref[...] += counts
+    _accumulate_stats(sums_ref, counts_ref, x, rv, masks)
     gram1_ref[...] += g1
     gram2_ref[...] += g2
+
+
+def _masks(x, rv, shift):
+    """(isnan, notnull, finite, m, d, d2) for one (C, R) tile — the one
+    validity/centering convention shared by every pass-A kernel tier."""
+    isnan = jnp.isnan(x)
+    notnull = rv & ~isnan                 # non-null (±inf included)
+    finite = notnull & ~jnp.isinf(x)
+    m = finite.astype(jnp.float32)
+    d = jnp.where(finite, x - shift, 0.0)
+    return isnan, notnull, finite, m, d, d * d
+
+
+def _stats_identity(shape):
+    """Identity elements for the (C, 8) sums block: 0 for the additive
+    lanes, ±inf for min/max (lanes 4/6 min, 5/7 max) — built via iota
+    because pallas kernels cannot capture host constants."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    return jnp.where((lane == 4) | (lane == 6), jnp.inf,
+                     jnp.where((lane == 5) | (lane == 7),
+                               -jnp.inf, 0.0)).astype(jnp.float32)
+
+
+def _accumulate_stats(sums_ref, counts_ref, x, rv, masks) -> None:
+    """Fold one tile's per-column sums/min-max/counts into the (C, 8)
+    accumulator blocks (lane roles: 0-3 add, 4/6 min, 5/7 max — a
+    lane-mask select because slice-assign would lower to an unsupported
+    scatter)."""
+    isnan, notnull, finite, m, d, d2 = masks
+    s1 = jnp.sum(d, axis=1, keepdims=True)
+    s2 = jnp.sum(d2, axis=1, keepdims=True)
+    s3 = jnp.sum(d2 * d, axis=1, keepdims=True)
+    s4 = jnp.sum(d2 * d2, axis=1, keepdims=True)
+    minv = jnp.min(jnp.where(notnull, x, jnp.inf), axis=1, keepdims=True)
+    maxv = jnp.max(jnp.where(notnull, x, -jnp.inf), axis=1, keepdims=True)
+    fmin = jnp.min(jnp.where(finite, x, jnp.inf), axis=1, keepdims=True)
+    fmax = jnp.max(jnp.where(finite, x, -jnp.inf), axis=1, keepdims=True)
+    sums = jnp.concatenate([s1, s2, s3, s4, minv, maxv, fmin, fmax],
+                           axis=1)
+    acc = sums_ref[...]
+    lane = jax.lax.broadcasted_iota(jnp.int32, acc.shape, 1)
+    sums_ref[...] = jnp.where(
+        lane < 4, acc + sums,
+        jnp.where((lane == 4) | (lane == 6),
+                  jnp.minimum(acc, sums), jnp.maximum(acc, sums)))
+
+    i32 = jnp.int32
+    n = jnp.sum(finite.astype(i32), axis=1, keepdims=True)
+    nz = jnp.sum((notnull & (x == 0.0)).astype(i32), axis=1, keepdims=True)
+    ninf = jnp.sum((notnull & jnp.isinf(x)).astype(i32), axis=1,
+                   keepdims=True)
+    nmiss = jnp.sum((rv & isnan).astype(i32), axis=1, keepdims=True)
+    z = jnp.zeros_like(n)
+    counts_ref[...] += jnp.concatenate(
+        [n, nz, ninf, nmiss, z, z, z, z], axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -183,6 +202,114 @@ def _fused_tiles(xt: Array, row_valid: Array, shift: Array,
     return (sums[:cols], counts[:cols]) + _slice_grams(g1, g2, cols, C)
 
 
+# ---------------------------------------------------------------------------
+# Column-tiled pass A for wide tables
+# (MAX_FUSED_COLS < cols <= MAX_FUSED_COLS_WIDE)
+# ---------------------------------------------------------------------------
+#
+# The pairwise Gram is quadratic in columns, so past the narrow kernel's
+# VMEM limit the blocks must tile: grid (i, j, r) with rows fastest; each
+# (i, j) pair accumulates its (C_T, C_T) P/S1/S2/N output blocks across
+# row tiles on the MXU, and the per-column VPU stats ride the j == 0
+# visits so every value still feeds them exactly once.  Each row tile is
+# read 2·n_ct times (once per partner tile) — at these widths the MXU
+# work is the bound, so the extra reads are covered.
+
+C_TILE_W = 256
+R_TILE_W = 512
+
+
+def _kernel_wide(xi_ref, xj_ref, rv_ref, shift_i_ref, shift_j_ref,
+                 sums_ref, counts_ref, p_ref, s1_ref, s2_ref, n_ref):
+    j = pl.program_id(1)
+    r = pl.program_id(2)
+    rv = rv_ref[...] > 0                      # (1, R)
+
+    xi = xi_ref[...]                          # (C_T, R)
+    masks_i = _masks(xi, rv, shift_i_ref[...])
+    m_i, d_i, d2_i = masks_i[3], masks_i[4], masks_i[5]
+
+    xj = xj_ref[...]
+    _, _, _, m_j, d_j, _ = _masks(xj, rv, shift_j_ref[...])
+
+    dn = (((1,), (1,)), ((), ()))
+    p_blk = jax.lax.dot_general(d_i, d_j, dn, precision=_HI,
+                                preferred_element_type=jnp.float32)
+    s1_blk = jax.lax.dot_general(d_i, m_j, dn, precision=_HI,
+                                 preferred_element_type=jnp.float32)
+    s2_blk = jax.lax.dot_general(d2_i, m_j, dn, precision=_HI,
+                                 preferred_element_type=jnp.float32)
+    n_blk = jax.lax.dot_general(m_i, m_j, dn, precision=_HI,
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(r == 0)
+    def _init_grams():
+        p_ref[...] = jnp.zeros_like(p_ref)
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+        n_ref[...] = jnp.zeros_like(n_ref)
+
+    p_ref[...] += p_blk
+    s1_ref[...] += s1_blk
+    s2_ref[...] += s2_blk
+    n_ref[...] += n_blk
+
+    # per-column stats: once per value — only on the j == 0 sweep
+    @pl.when((j == 0) & (r == 0))
+    def _init_stats():
+        sums_ref[...] = _stats_identity(sums_ref.shape)
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+
+    @pl.when(j == 0)
+    def _stats():
+        _accumulate_stats(sums_ref, counts_ref, xi, rv, masks_i)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fused_tiles_wide(xt: Array, row_valid: Array, shift: Array,
+                      interpret: bool = False):
+    cols, rows = xt.shape
+    cpad = -cols % C_TILE_W
+    rpad = -rows % R_TILE_W
+    xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
+    rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
+    shift_p = jnp.pad(shift.astype(jnp.float32), (0, cpad))[:, None]
+    C = cols + cpad
+    n_ct = C // C_TILE_W
+    n_rt = (rows + rpad) // R_TILE_W
+    outs = pl.pallas_call(
+        _kernel_wide,
+        grid=(n_ct, n_ct, n_rt),
+        in_specs=[
+            pl.BlockSpec((C_TILE_W, R_TILE_W), lambda i, j, r: (i, r)),
+            pl.BlockSpec((C_TILE_W, R_TILE_W), lambda i, j, r: (j, r)),
+            pl.BlockSpec((1, R_TILE_W), lambda i, j, r: (0, r)),
+            pl.BlockSpec((C_TILE_W, 1), lambda i, j, r: (i, 0)),
+            pl.BlockSpec((C_TILE_W, 1), lambda i, j, r: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((C_TILE_W, 8), lambda i, j, r: (i, 0)),
+            pl.BlockSpec((C_TILE_W, 8), lambda i, j, r: (i, 0)),
+            pl.BlockSpec((C_TILE_W, C_TILE_W), lambda i, j, r: (i, j)),
+            pl.BlockSpec((C_TILE_W, C_TILE_W), lambda i, j, r: (i, j)),
+            pl.BlockSpec((C_TILE_W, C_TILE_W), lambda i, j, r: (i, j)),
+            pl.BlockSpec((C_TILE_W, C_TILE_W), lambda i, j, r: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((C, 8), jnp.float32),
+            jax.ShapeDtypeStruct((C, 8), jnp.int32),
+            jax.ShapeDtypeStruct((C, C), jnp.float32),
+            jax.ShapeDtypeStruct((C, C), jnp.float32),
+            jax.ShapeDtypeStruct((C, C), jnp.float32),
+            jax.ShapeDtypeStruct((C, C), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xt_p, xt_p, rv_p, shift_p, shift_p)
+    sums, counts, P, S1, S2, N = outs
+    return (sums[:cols], counts[:cols], P[:cols, :cols],
+            S1[:cols, :cols], S2[:cols, :cols], N[:cols, :cols])
+
+
 def _slice_grams(g1, g2, cols: int, C: int):
     """(P, S1, S2, N) from the two stacked Gram outputs — the one block
     convention shared by the Pearson and Spearman kernels."""
@@ -208,9 +335,12 @@ def update(mom: Dict[str, Array], co: Dict[str, Array], xt: Array,
            row_valid: Array, interpret: bool = False
            ) -> Tuple[Dict[str, Array], Dict[str, Array]]:
     """Fold one batch into the moments.py + corr.py states with a single
-    pallas pass.  Requires the states' shifts to be pre-set (init with an
-    explicit shift); ``xt`` is (cols, rows) as the mesh ships batches."""
-    sums, counts, P, S1, S2, N = _fused_tiles(
+    pallas pass (column-tiled past MAX_FUSED_COLS).  Requires the
+    states' shifts to be pre-set (init with an explicit shift); ``xt``
+    is (cols, rows) as the mesh ships batches."""
+    tiles = _fused_tiles if xt.shape[0] <= MAX_FUSED_COLS \
+        else _fused_tiles_wide
+    sums, counts, P, S1, S2, N = tiles(
         xt, row_valid, mom["shift"], interpret=interpret)
     mom_out = {
         "shift": mom["shift"],

@@ -100,13 +100,17 @@ class MeshRunner:
             self.use_pallas = devs[0].platform == "tpu" and hist_fits
         else:
             self.use_pallas = config.use_pallas and hist_fits
-        # fused single-read pallas pass A (kernels/fused.py) on real TPU;
-        # the per-kernel XLA formulation on CPU meshes and past the
-        # kernel's VMEM width limit
-        fused_fits = n_num <= fused.MAX_FUSED_COLS
+        # fused pallas pass A (kernels/fused.py; single-read kernel up to
+        # MAX_FUSED_COLS, column-tiled beyond) on real TPU; the
+        # per-kernel XLA formulation on CPU meshes and past the tiled
+        # kernel's width limit
+        fused_fits = n_num <= fused.MAX_FUSED_COLS_WIDE
         self.use_fused = (devs[0].platform == "tpu" and fused_fits
                           if config.use_fused is None
                           else bool(config.use_fused) and fused_fits)
+        # the Spearman grid kernel only has the narrow (untiled)
+        # formulation; wider tables use the exact searchsorted tier
+        self.spear_grid = self.use_fused and n_num <= fused.MAX_FUSED_COLS
         self._sh_rows = NamedSharding(self.mesh, P("data"))
         self._sh_cols_rows = NamedSharding(self.mesh, P(None, "data"))
         self._sh_rep = NamedSharding(self.mesh, P())
