@@ -147,7 +147,7 @@ def test_wide_tables_fall_back_to_xla():
     config = ProfilerConfig(batch_rows=64, use_fused=True, use_pallas=True)
     wide = MeshRunner(config, n_num=fused.MAX_FUSED_COLS + 1, n_hash=0,
                       devices=jax.devices()[:1])
-    assert wide.use_fused and not wide.spear_grid   # tiled kernel tier
+    assert wide.use_fused and wide.spear_grid       # tiled kernel tier
     runner = MeshRunner(config, n_num=fused.MAX_FUSED_COLS_WIDE + 1,
                         n_hash=0, devices=jax.devices()[:1])
     assert not runner.use_fused
@@ -196,3 +196,37 @@ def test_wide_tiled_kernel_matches_xla(rows, cols):
     np.testing.assert_allclose(
         corr.finalize(jax.device_get(co_p)),
         corr.finalize(jax.device_get(co_x)), atol=5e-4, equal_nan=True)
+
+
+@pytest.mark.parametrize("cols", [5, 300])   # 300 > C_TILE_W: multi-tile
+def test_spearman_wide_tier_matches_narrow(cols):
+    """The rank-transform + tiled-Gram path (the runtime's two public
+    entrypoints) must agree with the narrow single-pass spearman kernel
+    on the same grid and data."""
+    rng = np.random.default_rng(1)
+    n = 600
+    base = rng.normal(0, 1, n)
+    x = np.stack([base + rng.normal(0, 0.5, n) * ((c % 7) + 1)
+                  for c in range(cols)], axis=1).astype(np.float32)
+    x[rng.random((n, cols)) < 0.05] = np.nan
+    rv = np.ones(n, dtype=bool)
+    from tpuprof.ingest.sample import RowSampler
+    sampler = RowSampler(k=4096, n_num=cols)
+    sampler.update(x, n)
+    grid = jnp.asarray(sampler.cdf_grid(128))
+    xt = jnp.asarray(np.ascontiguousarray(x.T))
+    rvj = jnp.asarray(rv)
+
+    def fresh_co():
+        return dict(corr.init(cols),
+                    shift=jnp.full((cols,), 0.5, jnp.float32),
+                    set=jnp.ones((), jnp.int32))
+
+    narrow = fused.spearman_update(fresh_co(), xt, rvj, grid,
+                                   interpret=True)
+    ranks = fused.rank_transform(xt, rvj, grid, interpret=True)
+    wide = fused.spearman_update_wide(fresh_co(), ranks, rvj,
+                                      interpret=True)
+    np.testing.assert_allclose(
+        corr.finalize(jax.device_get(narrow)),
+        corr.finalize(jax.device_get(wide)), atol=1e-5, equal_nan=True)

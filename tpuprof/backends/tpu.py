@@ -354,10 +354,15 @@ class TPUStatsBackend:
             if config.spearman:
                 spear_state = runner.init_spearman()
                 if runner.spear_grid:
-                    # pallas tier: dense-compare ranks on a G-point grid
+                    # pallas tier: dense-compare ranks on a G-point grid.
+                    # The wide tier's rank kernel has a VMEM budget
+                    # calibrated for G <= 256, so its grid is clamped.
+                    from tpuprof.kernels import fused as kfused
+                    g = config.spearman_grid
+                    if plan.n_num > kfused.MAX_FUSED_COLS:
+                        g = min(g, kfused.MAX_WIDE_SPEAR_GRID)
                     spear_grid = runner.put_replicated(
-                        sampler.cdf_grid(config.spearman_grid),
-                        dtype=np.float32)
+                        sampler.cdf_grid(g), dtype=np.float32)
                 else:
                     # exact tier: rank transform through the pass-A sample
                     # CDF (+inf pads unkept slots past every real value)

@@ -220,7 +220,8 @@ R_TILE_W = 512
 
 
 def _kernel_wide(xi_ref, xj_ref, rv_ref, shift_i_ref, shift_j_ref,
-                 sums_ref, counts_ref, p_ref, s1_ref, s2_ref, n_ref):
+                 sums_ref, counts_ref, p_ref, s1_ref, s2_ref, n_ref, *,
+                 skip_stats: bool = False):
     j = pl.program_id(1)
     r = pl.program_id(2)
     rv = rv_ref[...] > 0                      # (1, R)
@@ -255,19 +256,23 @@ def _kernel_wide(xi_ref, xj_ref, rv_ref, shift_i_ref, shift_j_ref,
     n_ref[...] += n_blk
 
     # per-column stats: once per value — only on the j == 0 sweep
+    # (skip_stats callers only want the Gram, e.g. the Spearman rank
+    # pass; the blocks are still initialized so the discarded outputs
+    # are defined)
     @pl.when((j == 0) & (r == 0))
     def _init_stats():
         sums_ref[...] = _stats_identity(sums_ref.shape)
         counts_ref[...] = jnp.zeros_like(counts_ref)
 
-    @pl.when(j == 0)
-    def _stats():
-        _accumulate_stats(sums_ref, counts_ref, xi, rv, masks_i)
+    if not skip_stats:
+        @pl.when(j == 0)
+        def _stats():
+            _accumulate_stats(sums_ref, counts_ref, xi, rv, masks_i)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "skip_stats"))
 def _fused_tiles_wide(xt: Array, row_valid: Array, shift: Array,
-                      interpret: bool = False):
+                      interpret: bool = False, skip_stats: bool = False):
     cols, rows = xt.shape
     cpad = -cols % C_TILE_W
     rpad = -rows % R_TILE_W
@@ -278,7 +283,7 @@ def _fused_tiles_wide(xt: Array, row_valid: Array, shift: Array,
     n_ct = C // C_TILE_W
     n_rt = (rows + rpad) // R_TILE_W
     outs = pl.pallas_call(
-        _kernel_wide,
+        functools.partial(_kernel_wide, skip_stats=skip_stats),
         grid=(n_ct, n_ct, n_rt),
         in_specs=[
             pl.BlockSpec((C_TILE_W, R_TILE_W), lambda i, j, r: (i, r)),
@@ -392,13 +397,7 @@ def _spear_kernel(xt_ref, rv_ref, grid_ref, gram1_ref, gram2_ref, *,
     rv = rv_ref[...] > 0                  # (1, R)
     finite = rv & jnp.isfinite(x)
 
-    lt = jnp.zeros_like(x)
-    le = jnp.zeros_like(x)
-    for j in range(n_grid):
-        g = grid_ref[:, j:j + 1]          # (C, 1) broadcasts over lanes
-        lt += (g < x).astype(jnp.float32)
-        le += (g <= x).astype(jnp.float32)
-    rank = (lt + le) * (0.5 / n_grid)
+    rank = _grid_ranks(x, grid_ref, n_grid)
 
     m = finite.astype(jnp.float32)
     d = jnp.where(finite, rank - 0.5, 0.0)
@@ -455,10 +454,99 @@ def _spear_tiles(xt: Array, row_valid: Array, grid: Array,
     return _slice_grams(g1, g2, cols, C)
 
 
+def _rank_kernel(xt_ref, rv_ref, grid_ref, out_ref, *, n_grid: int):
+    """Materialize grid ranks for one row tile: rank in [0,1] where the
+    value is finite, NaN elsewhere (the wide tier's stage 1 — the
+    VMEM-resident single-pass formulation does not fit past
+    MAX_FUSED_COLS, so ranks round-trip HBM and stage 2 reuses the
+    column-tiled Gram kernel)."""
+    x = xt_ref[...]
+    rv = rv_ref[...] > 0
+    finite = rv & jnp.isfinite(x)
+    rank = _grid_ranks(x, grid_ref, n_grid)
+    out_ref[...] = jnp.where(finite, rank, jnp.nan)
+
+
+def _grid_ranks(x, grid_ref, n_grid: int):
+    """(#grid < x + #grid <= x) / 2G — the unrolled compare loop.  The
+    compiler's scoped-VMEM demand for this loop scales with the x tile
+    area TIMES the grid size (each (C, 1) point slice occupies a full
+    128-lane-padded tile), so callers must keep the tile small enough:
+    compile-probed on v5e, (256, 128) tiles hold at G=256 where
+    (256, 512) overflow (tests/hardware probe; see _rank_tiles)."""
+    lt = jnp.zeros_like(x)
+    le = jnp.zeros_like(x)
+    for j in range(n_grid):
+        g = grid_ref[:, j:j + 1]
+        lt += (g < x).astype(jnp.float32)
+        le += (g <= x).astype(jnp.float32)
+    return (lt + le) * (0.5 / n_grid)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _rank_tiles(xt: Array, row_valid: Array, grid: Array,
+                interpret: bool = False) -> Array:
+    cols, rows = xt.shape
+    n_grid = grid.shape[1]
+    cpad = -cols % C_TILE_W           # column-tiled like the wide Gram
+    C = cols + cpad
+    r_tile = 128                      # see _grid_ranks: scoped VMEM for
+    rpad = -rows % r_tile             # the compare loop scales with
+                                      # tile-area x G; 128 lanes hold
+    xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
+    rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
+    grid_p = jnp.pad(grid.astype(jnp.float32), ((0, cpad), (0, 0)),
+                     constant_values=jnp.inf)
+    n_ct = C // C_TILE_W
+    n_rt = (rows + rpad) // r_tile
+    ranks = pl.pallas_call(
+        functools.partial(_rank_kernel, n_grid=n_grid),
+        grid=(n_ct, n_rt),
+        in_specs=[
+            pl.BlockSpec((C_TILE_W, r_tile), lambda c, i: (c, i)),
+            pl.BlockSpec((1, r_tile), lambda c, i: (0, i)),
+            pl.BlockSpec((C_TILE_W, n_grid), lambda c, i: (c, 0)),
+        ],
+        out_specs=pl.BlockSpec((C_TILE_W, r_tile), lambda c, i: (c, i)),
+        out_shape=jax.ShapeDtypeStruct((C, rows + rpad), jnp.float32),
+        interpret=interpret,
+    )(xt_p, rv_p, grid_p)
+    return ranks[:cols, :rows]
+
+
 def spearman_update(co: Dict[str, Array], xt: Array, row_valid: Array,
                     grid: Array, interpret: bool = False
                     ) -> Dict[str, Array]:
     """Fold one batch of grid ranks into a corr.py state (whose shift
-    must be the constant 0.5 — ranks are in [0,1])."""
+    must be the constant 0.5 — ranks are in [0,1]) — the narrow
+    single-pass kernel.  Wider tables run rank_transform and
+    spearman_update_wide as TWO programs (mesh runtime dispatches them
+    separately: back-to-back pallas calls in one XLA module trip the
+    compiler's scoped-VMEM accounting)."""
     P, S1, S2, N = _spear_tiles(xt, row_valid, grid, interpret=interpret)
     return _fold_corr(co, P, S1, S2, N)
+
+
+def rank_transform(xt: Array, row_valid: Array, grid: Array,
+                   interpret: bool = False) -> Array:
+    """Stage 1 of the wide Spearman tier: (cols, rows) grid ranks in
+    [0,1], NaN where the value is non-finite."""
+    return _rank_tiles(xt, row_valid, grid, interpret=interpret)
+
+
+def spearman_update_wide(co: Dict[str, Array], ranks_t: Array,
+                         row_valid: Array, interpret: bool = False
+                         ) -> Dict[str, Array]:
+    """Stage 2 of the wide Spearman tier: the column-tiled Gram over the
+    rank matrix (the kernel's per-column stats sweep is skipped)."""
+    half = jnp.full((ranks_t.shape[0],), 0.5, dtype=jnp.float32)
+    _, _, P, S1, S2, N = _fused_tiles_wide(ranks_t, row_valid, half,
+                                           interpret=interpret,
+                                           skip_stats=True)
+    return _fold_corr(co, P, S1, S2, N)
+
+
+# the wide rank kernel's tile budget is calibrated for G <= 256 (see
+# _grid_ranks/_rank_tiles); the backend clamps the grid it builds for
+# the wide tier to this
+MAX_WIDE_SPEAR_GRID = 256

@@ -108,9 +108,9 @@ class MeshRunner:
         self.use_fused = (devs[0].platform == "tpu" and fused_fits
                           if config.use_fused is None
                           else bool(config.use_fused) and fused_fits)
-        # the Spearman grid kernel only has the narrow (untiled)
-        # formulation; wider tables use the exact searchsorted tier
-        self.spear_grid = self.use_fused and n_num <= fused.MAX_FUSED_COLS
+        # the Spearman grid tier follows the fused pass (narrow
+        # single-pass kernel, or rank-transform + tiled Gram when wide)
+        self.spear_grid = self.use_fused
         self._sh_rows = NamedSharding(self.mesh, P("data"))
         self._sh_cols_rows = NamedSharding(self.mesh, P(None, "data"))
         self._sh_rep = NamedSharding(self.mesh, P())
@@ -291,10 +291,19 @@ class MeshRunner:
             return _restack(corr.update(s, r, row_valid))
 
         def local_step_spear_grid(state, xt, row_valid, grid):
-            """Spearman pass, pallas tier: dense compare against a G-point
-            CDF grid (kernels/fused.spearman_update; rank resolution 1/G)."""
+            """Spearman pass, pallas tier (narrow): dense compare against a
+            G-point CDF grid in one program (kernels/fused.spearman_update;
+            rank resolution 1/G)."""
             s = _unstack(state)
             return _restack(fused.spearman_update(s, xt, row_valid, grid))
+
+        def local_rank_grid(xt, row_valid, grid):
+            return fused.rank_transform(xt, row_valid, grid)
+
+        def local_step_spear_wide(state, ranks_t, row_valid):
+            s = _unstack(state)
+            return _restack(
+                fused.spearman_update_wide(s, ranks_t, row_valid))
 
         def local_merge_spear(state):
             return _restack(merge_corr_local(_unstack(state), _common_shift))
@@ -368,6 +377,17 @@ class MeshRunner:
             in_specs=(state_spec, cols_rows_spec, rows_spec, rep),
             out_specs=state_spec, check_vma=False),
             donate_argnums=(0,))
+        # wide tier: rank transform and rank Gram are SEPARATE dispatches
+        # (two pallas calls in one module trip scoped-VMEM accounting)
+        self._rank_grid = jax.jit(shard_map(
+            local_rank_grid, mesh=mesh,
+            in_specs=(cols_rows_spec, rows_spec, rep),
+            out_specs=cols_rows_spec, check_vma=False))
+        self._step_spear_wide = jax.jit(shard_map(
+            local_step_spear_wide, mesh=mesh,
+            in_specs=(state_spec, cols_rows_spec, rows_spec),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
         self._merge_spear = jax.jit(shard_map(
             local_merge_spear, mesh=mesh, in_specs=(state_spec,),
             out_specs=state_spec, check_vma=False))
@@ -414,11 +434,15 @@ class MeshRunner:
 
     def step_spearman_grid(self, state: Pytree, hb, grid) -> Pytree:
         """Pallas-tier Spearman step: ``grid`` is the (n_num, G) host CDF
-        grid (RowSampler.cdf_grid)."""
+        grid (RowSampler.cdf_grid).  Narrow widths run one program; wide
+        widths dispatch rank transform and rank Gram separately."""
         db = self._as_device(hb)
-        return self._step_spear_grid(
-            state, db.xt, db.row_valid,
-            self.put_replicated(grid, dtype=jnp.float32))
+        grid_d = self.put_replicated(grid, dtype=jnp.float32)
+        if self.n_num <= fused.MAX_FUSED_COLS:
+            return self._step_spear_grid(state, db.xt, db.row_valid,
+                                         grid_d)
+        ranks = self._rank_grid(db.xt, db.row_valid, grid_d)
+        return self._step_spear_wide(state, ranks, db.row_valid)
 
     def finalize_spearman(self, state: Pytree):
         return jax.device_get(
