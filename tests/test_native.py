@@ -100,3 +100,20 @@ def test_hll_host_numpy_fallback(monkeypatch):
     host = khll.HostRegisters(cols, p)
     host.update(packed, rows)
     np.testing.assert_array_equal(host.regs, dev)
+
+
+@requires_native
+def test_hll_update_threaded_branch_matches_device():
+    """Shapes large enough to engage the parallel fold (n_cols >= 8,
+    cells >= 2^18), with an uneven last chunk."""
+    import jax.numpy as jnp
+    from tpuprof.kernels import hll as khll
+    rng = np.random.default_rng(11)
+    rows, cols, p = 16384, 27, 8
+    h64 = rng.integers(0, 1 << 64, (rows, cols), dtype=np.uint64)
+    valid = rng.random((rows, cols)) < 0.95
+    packed = khll.pack(h64, valid, p)
+    dev = np.asarray(khll.update(khll.init(cols, p), jnp.asarray(packed)))
+    host = khll.HostRegisters(cols, p)
+    host.update(np.asfortranarray(packed), rows)
+    np.testing.assert_array_equal(host.regs, dev)

@@ -19,6 +19,8 @@
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -139,17 +141,49 @@ void tpuprof_hash_bytes(const uint8_t* data, const int64_t* offsets,
 void tpuprof_hll_update(const uint16_t* packed, size_t n_rows,
                         size_t n_cols, ptrdiff_t row_stride,
                         ptrdiff_t col_stride, int32_t* regs, size_t m) {
-  for (size_t c = 0; c < n_cols; ++c) {
-    int32_t* r = regs + c * m;
-    const uint16_t* p = packed + static_cast<ptrdiff_t>(c) * col_stride;
-    for (size_t i = 0; i < n_rows; ++i) {
-      const uint16_t v = p[static_cast<ptrdiff_t>(i) * row_stride];
-      if (!v) continue;
-      const uint32_t idx = v >> 5;
-      const int32_t rho = v & 31;
-      if (idx < m && rho > r[idx]) r[idx] = rho;
+  auto fold_range = [=](size_t c0, size_t c1) {
+    for (size_t c = c0; c < c1; ++c) {
+      int32_t* r = regs + c * m;
+      const uint16_t* p = packed + static_cast<ptrdiff_t>(c) * col_stride;
+      for (size_t i = 0; i < n_rows; ++i) {
+        const uint16_t v = p[static_cast<ptrdiff_t>(i) * row_stride];
+        if (!v) continue;
+        const uint32_t idx = v >> 5;
+        const int32_t rho = v & 31;
+        if (idx < m && rho > r[idx]) r[idx] = rho;
+      }
     }
+  };
+  // columns own disjoint register rows, so the fold is embarrassingly
+  // parallel; thread only when the work amortizes spawn cost
+  const size_t hw = std::thread::hardware_concurrency();
+  const size_t want = n_cols / 4;       // >= 4 columns per worker
+  size_t n_threads = hw < want ? hw : want;
+  if (n_threads < 2 || n_rows * n_cols < (1u << 18)) {
+    fold_range(0, n_cols);
+    return;
   }
+  std::vector<std::thread> workers;
+  const size_t chunk = (n_cols + n_threads - 1) / n_threads;
+  size_t started_cols = 0;
+  try {
+    for (size_t t = 0; t < n_threads; ++t) {
+      const size_t c0 = t * chunk;
+      const size_t c1 = (c0 + chunk < n_cols) ? c0 + chunk : n_cols;
+      if (c0 >= c1) break;
+      workers.emplace_back(fold_range, c0, c1);
+      started_cols = c1;
+    }
+  } catch (...) {
+    // spawn failure (EAGAIN under thread limits, or a toolchain without
+    // working gthreads): finish what was not handed out serially —
+    // letting the exception cross the extern "C"/ctypes boundary would
+    // terminate the host process
+    for (auto& w : workers) w.join();
+    fold_range(started_cols, n_cols);
+    return;
+  }
+  for (auto& w : workers) w.join();
 }
 
 }  // extern "C"
