@@ -202,3 +202,17 @@ def test_memorysize_accumulated_from_arrow_buffers(fixture_df):
     assert table["memorysize"] >= sum(
         var["memorysize"] for var in stats["variables"].values()
         if np.isfinite(var["memorysize"]))
+
+
+def test_cat_only_table_exact_recount():
+    """No numeric columns: pass B is skipped but the exact top-k recount
+    must still run (the reference's groupBy().count() parity)."""
+    rng = np.random.default_rng(5)
+    df = pd.DataFrame({"s": rng.choice(list("abcde"), 3000),
+                       "t": rng.choice(["x", "y"], 3000)})
+    stats = TPUStatsBackend().collect(df, _cfg())
+    vc = stats["freq"]["s"]
+    expect = df["s"].value_counts()
+    for val in expect.index:
+        assert vc[val] == expect[val]
+    assert stats["variables"]["s"]["type"] == schema.CAT
