@@ -1,0 +1,32 @@
+"""tpuprof — a TPU-native data-profiling framework.
+
+A from-scratch reimplementation of the capabilities of
+``yimian/spark-df-profiling`` (a PySpark port of pandas-profiling 1.x),
+re-architected TPU-first:
+
+* The reference issues O(columns) blocking Spark SQL jobs — one or more
+  full cluster scans per column (``agg``, ``approxQuantile``,
+  ``countDistinct``, ``groupBy().count()``) plus O(columns²) for the
+  correlation matrix.  See SURVEY.md §3.1.
+* tpuprof streams Arrow record batches **once**, updating *all* column
+  statistics for *all* columns per batch inside a single fused XLA
+  program (moments, min/max, zeros/inf, quantile sketch, HyperLogLog,
+  histogram, pairwise-Pearson Gram matrices), then merges per-device
+  sketch states with one tree-reduce over the TPU mesh (SURVEY.md §3.5).
+
+Public parity surface (reference: spark_df_profiling/__init__.py [U],
+SURVEY.md §1):
+
+    ProfileReport(df, bins=10, corr_reject=0.9, **kwargs)
+    report.to_file(path)
+    report.html
+    report.get_rejected_variables(threshold)
+    report._repr_html_()   # notebook auto-display
+"""
+
+from tpuprof.api import ProfileReport, describe
+from tpuprof.config import ProfilerConfig
+
+__version__ = "0.1.0"
+
+__all__ = ["ProfileReport", "describe", "ProfilerConfig", "__version__"]

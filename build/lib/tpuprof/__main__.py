@@ -1,0 +1,7 @@
+"""``python -m tpuprof`` — same surface as the ``tpuprof`` console script."""
+
+import sys
+
+from tpuprof.cli import main
+
+sys.exit(main())

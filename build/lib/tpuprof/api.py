@@ -1,0 +1,106 @@
+"""ProfileReport — the user-facing facade (parity surface).
+
+Reference: spark_df_profiling/__init__.py [U] (SURVEY.md §1, §3):
+
+    ProfileReport(df, bins=10, corr_reject=0.9, **kwargs)
+    report.to_file(outputfile)       # standalone HTML page
+    report.html                      # rendered fragment/page
+    report.get_rejected_variables(threshold)
+    report._repr_html_()             # Jupyter auto-display
+
+As in the reference, statistics are computed eagerly at construction
+(SURVEY §3.3: notebook display returns the cached string, no
+recomputation).  Rendering is deferred to first ``.html`` access — an
+observable no-op since the stats dict is already frozen.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Dict, List, Optional
+
+from tpuprof.backends.base import get_backend
+from tpuprof.config import ProfilerConfig
+from tpuprof.schema import rejected_variables, validate_stats
+
+
+def describe(source: Any, config: Optional[ProfilerConfig] = None,
+             **kwargs) -> Dict[str, Any]:
+    """Reference: base.describe(df, bins, corr_reject) — returns the stats
+    dict (SURVEY §1 L2→L3 seam) without rendering."""
+    if config is not None and kwargs:
+        raise ValueError(
+            f"pass either an explicit ProfilerConfig or kwargs, not both "
+            f"(got config and {sorted(kwargs)})")
+    config = config or ProfilerConfig.from_kwargs(**kwargs)
+    backend = get_backend(config.backend)
+    stats = backend.collect(source, config)
+    problems = validate_stats(stats)
+    if problems:
+        raise AssertionError(
+            f"backend {backend.name!r} violated the stats contract: {problems}")
+    return stats
+
+
+class ProfileReport:
+    """Profile a tabular source and render an HTML report.
+
+    ``source`` may be a pandas DataFrame, a pyarrow Table, or a path to a
+    Parquet file/directory (the TPU backend streams the latter two without
+    materializing them in host memory).
+    """
+
+    def __init__(self, source: Any, config: Optional[ProfilerConfig] = None,
+                 **kwargs):
+        if config is not None and kwargs:
+            raise ValueError(
+                f"pass either an explicit ProfilerConfig or kwargs, not both "
+                f"(got config and {sorted(kwargs)})")
+        self.config = config or ProfilerConfig.from_kwargs(**kwargs)
+        self.description = describe(source, self.config)
+        self._html: Optional[str] = None
+
+    # -- reference API ------------------------------------------------------
+
+    @property
+    def html(self) -> str:
+        if self._html is None:
+            from tpuprof.report.render import to_html
+            self._html = to_html(self.description, self.config,
+                                 perf=self._perf_line())
+        return self._html
+
+    def _perf_line(self) -> str:
+        """Report-footer observability (SURVEY §5): per-phase wall-clock +
+        throughput for the scan that produced this report."""
+        from tpuprof.utils.trace import get_phase_report
+        phases = get_phase_report()
+        scan = sum(v for k, v in phases.items() if k.startswith("scan"))
+        if not scan:
+            return ""
+        n = self.description["table"]["n"]
+        parts = [f"{k} {v:.2f}s" for k, v in sorted(phases.items())]
+        return f"{n / scan:,.0f} rows/s · " + " · ".join(parts)
+
+    def to_file(self, outputfile: str) -> None:
+        """Reference: ProfileReport.to_file — wraps the fragment with the
+        standalone page shell and writes it; purely host-local, no compute
+        (SURVEY §3.2)."""
+        from tpuprof.report.render import to_standalone_html
+        page = to_standalone_html(self.description, self.config)
+        with io.open(outputfile, "w", encoding="utf-8") as fh:
+            fh.write(page)
+
+    def get_rejected_variables(self, threshold: Optional[float] = None
+                               ) -> List[str]:
+        """Columns rejected for high correlation (SURVEY §3.4) — reads the
+        cached dict, no recomputation."""
+        return rejected_variables(self.description, threshold)
+
+    def _repr_html_(self) -> str:
+        return self.html
+
+    def __repr__(self) -> str:
+        table = self.description["table"]
+        return (f"<tpuprof.ProfileReport n={table['n']} "
+                f"nvar={table['nvar']}>")

@@ -1,0 +1,11 @@
+"""Stats backends — the pluggable seam named by the north star.
+
+The reference obtains every statistic by calling PySpark DataFrame methods
+from the driver (SURVEY.md §1, L2↔L1 seam).  tpuprof replaces that seam
+with a ``StatsBackend`` protocol: the CPU oracle pins exact semantics, the
+TPU backend computes the same dict in fused XLA passes.
+"""
+
+from tpuprof.backends.base import StatsBackend, get_backend
+
+__all__ = ["StatsBackend", "get_backend"]

@@ -1,0 +1,59 @@
+"""StatsBackend protocol + registry.
+
+Reference seam: every statistic in spark_df_profiling/base.py is a PySpark
+DataFrame call issued from the driver (SURVEY.md §1).  Here the seam is a
+single method — ``collect(source, config) -> stats dict`` — so engines are
+interchangeable and the renderer never knows which one ran.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Protocol, runtime_checkable
+
+from tpuprof.config import ProfilerConfig
+
+
+@runtime_checkable
+class StatsBackend(Protocol):
+    """An engine that turns a tabular source into the stats dict
+    (tpuprof.schema contract)."""
+
+    name: str
+
+    def collect(self, source: Any, config: ProfilerConfig) -> Dict[str, Any]:
+        """Profile ``source`` and return the stats dict.
+
+        ``source`` may be a pandas DataFrame, a pyarrow Table/Dataset, or a
+        path to a Parquet file/directory; each backend documents what it
+        accepts.  The returned dict must satisfy
+        ``tpuprof.schema.validate_stats``.
+        """
+        ...
+
+
+def get_backend(name: str) -> StatsBackend:
+    """Resolve a backend by name.  'auto' prefers the TPU engine when an
+    accelerator is visible, else the CPU oracle."""
+    if name == "cpu":
+        from tpuprof.backends.cpu import CPUStatsBackend
+        return CPUStatsBackend()
+    if name == "tpu":
+        from tpuprof.backends.tpu import TPUStatsBackend
+        return TPUStatsBackend()
+    if name == "auto":
+        try:
+            import jax
+            platform = jax.devices()[0].platform
+        except Exception:  # jax missing or no devices — oracle still works
+            platform = "cpu"
+        if platform in ("tpu", "axon", "gpu"):
+            try:
+                from tpuprof.backends.tpu import TPUStatsBackend
+                return TPUStatsBackend()
+            except ImportError:
+                pass  # fall through to the oracle
+        # On CPU hosts the JAX engine still runs (and is what tests use),
+        # but the numpy oracle is faster for small frames.
+        from tpuprof.backends.cpu import CPUStatsBackend
+        return CPUStatsBackend()
+    raise ValueError(f"unknown backend {name!r} (expected cpu|tpu|auto)")

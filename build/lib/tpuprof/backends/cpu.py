@@ -1,0 +1,253 @@
+"""CPU oracle backend — exact numpy/pandas reference semantics.
+
+This engine is the ground truth for every statistic (SURVEY.md §4.1): the
+TPU backend must match it to float tolerance (exact stats) or within
+published sketch bounds (quantiles/HLL/top-k).  It mirrors the behavior of
+the reference's describe()/describe_*_1d() dispatch
+(spark_df_profiling/base.py [U], SURVEY.md §2.1) on a pandas DataFrame.
+
+Statistical conventions (chosen so the fused TPU kernel can reproduce them
+exactly from merged central moments):
+
+* ``count``       = non-null values;  ``n_missing`` = nulls.
+* moments (mean/std/variance/skewness/kurtosis/sum/mad/cv) are over
+  *finite* values; ±inf is tallied in ``n_infinite`` (Spark's avg() would
+  propagate inf — deliberately diverging so moments stay informative).
+* ``min``/``max``/``range`` are over non-null values including ±inf
+  (matches Spark min/max).
+* ``skewness`` is population skewness g1 = m3 / m2^1.5 and ``kurtosis`` is
+  population *excess* kurtosis m4 / m2² − 3 — the same estimators Spark
+  SQL's skewness()/kurtosis() aggregates use.
+* quantiles use numpy linear interpolation (the oracle is exact where the
+  reference's approxQuantile was itself approximate).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+import pandas as pd
+
+from tpuprof import schema
+from tpuprof.config import ProfilerConfig
+
+
+def _central_moments(x: np.ndarray):
+    """(n, mean, m2, m3, m4) population central moments of a 1-D array."""
+    n = x.size
+    if n == 0:
+        return 0, np.nan, np.nan, np.nan, np.nan
+    mean = float(np.mean(x))
+    d = x - mean
+    m2 = float(np.mean(d * d))
+    m3 = float(np.mean(d ** 3))
+    m4 = float(np.mean(d ** 4))
+    return n, mean, m2, m3, m4
+
+
+def describe_numeric_1d(series: pd.Series, config: ProfilerConfig,
+                        common: Dict[str, Any],
+                        vc: pd.Series) -> Dict[str, Any]:
+    """Reference: describe_numeric_1d — one Spark agg + approxQuantile +
+    histogram per column (SURVEY §3.1 hot loop); here plain numpy."""
+    values = series.dropna().to_numpy(dtype=np.float64, na_value=np.nan)
+    finite = values[np.isfinite(values)]
+    n_inf = int(np.isinf(values).sum())
+    stats = dict(common)
+
+    n, mean, m2, m3, m4 = _central_moments(finite)
+    variance = m2 * n / (n - 1) if n > 1 else np.nan   # sample variance,
+    std = float(np.sqrt(variance)) if n > 1 else np.nan  # ddof=1 (Spark stddev)
+    stats.update({
+        "mean": mean if n else np.nan,
+        "std": std,
+        "variance": variance,
+        "sum": float(np.sum(finite)) if n else np.nan,
+        "mad": float(np.mean(np.abs(finite - mean))) if n else np.nan,
+        "cv": std / mean if n > 1 and mean != 0 else np.nan,
+        "skewness": m3 / m2 ** 1.5 if n and m2 > 0 else np.nan,
+        "kurtosis": m4 / (m2 * m2) - 3.0 if n and m2 > 0 else np.nan,
+        "n_zeros": int((values == 0).sum()),
+        "n_infinite": n_inf,
+    })
+    stats["p_zeros"] = stats["n_zeros"] / common["count"] if common["count"] else 0.0
+    stats["p_infinite"] = n_inf / common["count"] if common["count"] else 0.0
+
+    vmin = float(np.min(values)) if values.size else np.nan
+    vmax = float(np.max(values)) if values.size else np.nan
+    stats.update({"min": vmin, "max": vmax, "range": vmax - vmin})
+
+    if finite.size:
+        probes = list(config.quantile_probes)
+        qs = np.quantile(finite, probes)
+        for p, q in zip(probes, qs):
+            stats[schema.QUANTILE_FIELDS[p]] = float(q)
+        stats["iqr"] = stats["p75"] - stats["p25"]
+        counts, edges = np.histogram(finite, bins=config.bins)
+        stats["histogram"] = (counts.astype(np.int64), edges)
+        stats["mini_histogram"] = stats["histogram"]
+    else:
+        for field in schema.QUANTILE_FIELDS.values():
+            stats[field] = np.nan
+        stats["iqr"] = np.nan
+        stats["histogram"] = stats["mini_histogram"] = None
+
+    stats["mode"] = vc.index[0] if len(vc) else np.nan
+    return stats
+
+
+def describe_date_1d(series: pd.Series, common: Dict[str, Any]) -> Dict[str, Any]:
+    """Reference: describe_date_1d — min/max (+range) only (SURVEY §2.1)."""
+    stats = dict(common)
+    values = series.dropna()
+    if len(values):
+        vmin, vmax = values.min(), values.max()
+        stats.update({"min": vmin, "max": vmax, "range": vmax - vmin})
+    else:
+        stats.update({"min": pd.NaT, "max": pd.NaT, "range": pd.NaT})
+    return stats
+
+
+def describe_categorical_1d(series: pd.Series, common: Dict[str, Any],
+                            vc: pd.Series) -> Dict[str, Any]:
+    """Reference: describe_categorical_1d — groupBy(col).count() descending,
+    the 'top frequencies' table (SURVEY §2.1)."""
+    stats = dict(common)
+    stats["mode"] = vc.index[0] if len(vc) else np.nan
+    stats["top"] = vc.index[0] if len(vc) else np.nan
+    stats["freq"] = int(vc.iloc[0]) if len(vc) else 0
+    return stats
+
+
+def describe_bool_1d(series: pd.Series, common: Dict[str, Any],
+                     vc: pd.Series) -> Dict[str, Any]:
+    stats = describe_categorical_1d(series, common, vc)
+    values = series.dropna()
+    stats["mean"] = float(values.astype("float64").mean()) if len(values) else np.nan
+    return stats
+
+
+def describe_constant_1d(series: pd.Series, common: Dict[str, Any]) -> Dict[str, Any]:
+    stats = dict(common)
+    values = series.dropna()
+    stats["mode"] = values.iloc[0] if len(values) else np.nan
+    return stats
+
+
+def describe_unique_1d(series: pd.Series, common: Dict[str, Any]) -> Dict[str, Any]:
+    stats = dict(common)
+    stats["first_rows"] = series.dropna().head(5).tolist()
+    return stats
+
+
+def _common_fields(series: pd.Series, n: int) -> Dict[str, Any]:
+    count = int(series.count())
+    distinct = int(series.nunique(dropna=True))
+    return {
+        "count": count,
+        "n_missing": n - count,
+        "p_missing": (n - count) / n if n else 0.0,
+        "distinct_count": distinct,
+        "p_unique": distinct / count if count else 0.0,
+        "is_unique": count > 0 and distinct == count,
+        "memorysize": float(series.memory_usage(index=False, deep=True)),
+    }
+
+
+def pearson_rejection(df: pd.DataFrame, numeric_cols: List[str],
+                      config: ProfilerConfig):
+    """Pairwise Pearson over numeric columns + reference rejection rule:
+    scanning columns in order, a column whose |ρ| vs an *earlier kept*
+    column exceeds corr_reject is flagged CORR (SURVEY §2.1)."""
+    if len(numeric_cols) < 2:
+        return pd.DataFrame(), {}
+    corr = df[numeric_cols].corr(method="pearson")
+    return corr, schema.reject_by_correlation(corr, numeric_cols, config)
+
+
+class CPUStatsBackend:
+    """Exact oracle over a pandas DataFrame (SURVEY §3.5 CPUStatsBackend)."""
+
+    name = "cpu"
+
+    def collect(self, source: Any, config: ProfilerConfig) -> Dict[str, Any]:
+        df = _as_pandas(source)
+        n = len(df)
+
+        base_kinds: Dict[str, str] = {}
+        commons: Dict[str, Dict[str, Any]] = {}
+        kinds: Dict[str, str] = {}
+        for col in df.columns:
+            series = df[col]
+            commons[col] = _common_fields(series, n)
+            base_kinds[col] = schema.classify_dtype(series)
+            kinds[col] = schema.classify(
+                base_kinds[col], commons[col]["distinct_count"],
+                commons[col]["count"])
+
+        numeric_cols = [c for c in df.columns if kinds[c] == schema.NUM]
+        corr_matrix, rejected = pearson_rejection(df, numeric_cols, config)
+        for col, (other, rho) in rejected.items():
+            kinds[col] = schema.CORR
+
+        variables: Dict[str, Dict[str, Any]] = {}
+        freq: Dict[str, pd.Series] = {}
+        for col in df.columns:
+            series, kind, common = df[col], kinds[col], commons[col]
+            if kind in (schema.NUM, schema.CAT, schema.BOOL):
+                vc = series.dropna().value_counts()
+            if kind == schema.NUM:
+                stats = describe_numeric_1d(series, config, common, vc)
+            elif kind == schema.CAT:
+                stats = describe_categorical_1d(series, common, vc)
+                # reference shows the top-N frequencies table; the dict
+                # carries what the renderer needs, not the full distribution
+                freq[col] = vc.head(config.top_freq)
+            elif kind == schema.BOOL:
+                stats = describe_bool_1d(series, common, vc)
+                freq[col] = vc.head(config.top_freq)
+            elif kind == schema.DATE:
+                stats = describe_date_1d(series, common)
+            elif kind == schema.CONST:
+                stats = describe_constant_1d(series, common)
+            elif kind == schema.CORR:
+                other, rho = rejected[col]
+                stats = dict(common)
+                stats.update({"correlation_var": other, "correlation": rho})
+            else:  # UNIQUE
+                stats = describe_unique_1d(series, common)
+            stats["type"] = kind
+            variables[col] = stats
+
+        table = schema.make_table_stats(
+            n, variables, memorysize=float(df.memory_usage(deep=True).sum()))
+        messages = schema.derive_messages(variables, config)
+        correlations = {"pearson": corr_matrix}
+        if config.spearman and len(numeric_cols) >= 2:
+            correlations["spearman"] = df[numeric_cols].corr(method="spearman")
+        return {
+            "table": table,
+            "variables": variables,
+            "freq": freq,
+            "correlations": correlations,
+            "messages": messages,
+            "sample": df.head(config.sample_rows),
+        }
+
+
+def _as_pandas(source: Any) -> pd.DataFrame:
+    if isinstance(source, pd.DataFrame):
+        return source
+    try:
+        import pyarrow as pa
+        import pyarrow.dataset as ds
+        if isinstance(source, pa.Table):
+            return source.to_pandas()
+        if isinstance(source, (str,)):
+            return ds.dataset(source).to_table().to_pandas()
+        if isinstance(source, ds.Dataset):
+            return source.to_table().to_pandas()
+    except ImportError:
+        pass
+    raise TypeError(f"CPUStatsBackend cannot profile {type(source)!r}")

@@ -1,0 +1,632 @@
+"""TPUStatsBackend — the fused-scan engine (the north star's seam).
+
+Where the reference issues O(columns) blocking Spark jobs per profile —
+``agg``/``approxQuantile``/``countDistinct``/``groupBy().count()`` per
+column plus ``df.corr`` per pair (SURVEY.md §3.1 hot loop) — this backend
+streams Arrow record batches ONCE through a single jit-compiled sharded
+step updating every statistic for every column (SURVEY §3.5), then runs
+one collective merge.  With ``exact_passes`` (the default for rescannable
+sources) a second scan computes exact histograms (needing pass-A min/max),
+exact MAD (needing pass-A means) and exact top-k recounts — still O(2)
+scans total versus the reference's O(columns).
+
+Division of labor (SURVEY §7.2 "Strings on TPU"):
+* device — moments, min/max, zeros/inf/missing, pairwise Pearson Gram,
+  quantile sample sketch, HLL registers, histograms, MAD;
+* host  — string dictionary decode + hashing (Arrow/pandas vectorized),
+  Misra-Gries frequent values, date min/max (int64 ns exactness),
+  first-rows capture, final assembly of the stats dict.
+
+Accuracy contract vs the CPU oracle (tests/test_tpu_backend.py):
+exact — count, missing, zeros, inf, min/max, histograms, top-k counts
+(with exact_passes), bool stats, date min/max; float32-tolerance — mean,
+std, variance, skewness, kurtosis, sum, MAD, Pearson; sketch-bounded —
+quantiles (~1/sqrt(K) rank error; exact when n <= K), distinct counts
+(~1.04/sqrt(2^p), exact-in-practice small range via linear counting).
+Numeric values are profiled in float32 (TPU-native width): integers
+above 2^24 lose ULPs in moments — distinct counts are unaffected (hashes
+are computed on the original 64-bit values host-side).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from tpuprof import schema
+from tpuprof.config import ProfilerConfig
+from tpuprof.ingest.arrow import (ArrowIngest, ColumnPlan, HostBatch,
+                                  prefetch_prepared, prepare_batch)
+from tpuprof.ingest.sample import RowSampler
+from tpuprof.kernels import corr as kcorr
+from tpuprof.kernels import hll as khll
+from tpuprof.kernels import moments as kmoments
+from tpuprof.kernels import histogram as khistogram
+from tpuprof.kernels.topk import MisraGries
+from tpuprof.runtime.mesh import MeshRunner
+from tpuprof.utils.trace import log_event, phase_timer
+
+
+def estimate_shift(hb: HostBatch) -> np.ndarray:
+    """Per-column centering values from a prefix of the first batch (the
+    fused kernel's shift input — see kernels/fused.py).  Exactness does
+    not matter, only scale; all-missing columns center at 0."""
+    prefix = hb.x[: min(hb.nrows, 4096)]
+    if prefix.shape[0] == 0:
+        return np.zeros(prefix.shape[1], dtype=np.float32)
+    finite = np.isfinite(prefix)
+    cnt = finite.sum(axis=0)
+    sums = np.where(finite, prefix, 0.0).sum(axis=0)
+    return (sums / np.maximum(cnt, 1)).astype(np.float32)
+
+
+class HostAgg:
+    """Host-side accumulators folded during pass A."""
+
+    def __init__(self, plan: ColumnPlan, config: ProfilerConfig):
+        self.config = config
+        self.n_rows = 0
+        self.col_nbytes: Dict[str, int] = {}        # summed buffer bytes
+        self.col_dict_nbytes: Dict[str, int] = {}   # shared dicts: max
+        self.mg: Dict[str, MisraGries] = {
+            s.name: MisraGries(config.topk_capacity)
+            for s in plan.by_role("cat")}
+        self.cat_null: Dict[str, int] = {s.name: 0 for s in plan.by_role("cat")}
+        self.date_min: Dict[str, int] = {}
+        self.date_max: Dict[str, int] = {}
+        self.date_null: Dict[str, int] = {s.name: 0 for s in plan.by_role("date")}
+        self.first_values: Dict[str, list] = {}
+
+    def update(self, hb: HostBatch) -> None:
+        first = self.n_rows == 0
+        self.n_rows += hb.nrows
+        for name, nb in (hb.col_nbytes or {}).items():
+            self.col_nbytes[name] = self.col_nbytes.get(name, 0) + nb
+        for name, nb in (hb.col_dict_nbytes or {}).items():
+            self.col_dict_nbytes[name] = max(
+                self.col_dict_nbytes.get(name, 0), nb)
+        for name, (codes, dvals) in hb.cat_codes.items():
+            codes = codes[: hb.nrows]
+            valid = codes >= 0
+            self.cat_null[name] += int((~valid).sum())
+            if valid.any() and len(dvals):
+                cnt = np.bincount(codes[valid], minlength=len(dvals))
+                nz = np.nonzero(cnt)[0]
+                self.mg[name].update_batch(dvals[nz], cnt[nz])
+            if first:
+                self.first_values[name] = [
+                    dvals[c] if c >= 0 else None for c in codes[:5]]
+        for name, (ints, valid) in hb.date_ints.items():
+            ints, valid = ints[: hb.nrows], valid[: hb.nrows]
+            self.date_null[name] += int((~valid).sum())
+            if valid.any():
+                lo, hi = int(ints[valid].min()), int(ints[valid].max())
+                self.date_min[name] = min(self.date_min.get(name, lo), lo)
+                self.date_max[name] = max(self.date_max.get(name, hi), hi)
+
+    def memorysize(self, name: str) -> float:
+        """Arrow buffer bytes for one column (NaN if never observed)."""
+        if name not in self.col_nbytes:
+            return float("nan")
+        return float(self.col_nbytes[name]
+                     + self.col_dict_nbytes.get(name, 0))
+
+
+class Recounter:
+    """Pass-B exact recount of the Misra-Gries candidates — restores the
+    reference's exact ``groupBy().count()`` semantics for the reported
+    top-k rows (SURVEY §7.2 "Top-k exactness")."""
+
+    def __init__(self, hostagg: HostAgg):
+        self.indexes: Dict[str, pd.Index] = {}
+        self.counts: Dict[str, np.ndarray] = {}
+        for name, mg in hostagg.mg.items():
+            cands = pd.Index(list(mg.candidates()))
+            self.indexes[name] = cands
+            self.counts[name] = np.zeros(len(cands), dtype=np.int64)
+
+    def update(self, hb: HostBatch) -> None:
+        for name, (codes, dvals) in hb.cat_codes.items():
+            codes = codes[: hb.nrows]
+            valid = codes >= 0
+            if not valid.any() or not len(dvals):
+                continue
+            cnt = np.bincount(codes[valid], minlength=len(dvals))
+            cand_idx = self.indexes[name].get_indexer(dvals)
+            hit = cand_idx >= 0
+            np.add.at(self.counts[name], cand_idx[hit], cnt[hit])
+
+    def value_counts(self, name: str) -> pd.Series:
+        return pd.Series(self.counts[name], index=self.indexes[name]
+                         ).sort_values(ascending=False)
+
+
+class _CollectCheckpoint:
+    """Batch-granular resumability for the pass-A scan (SURVEY §5):
+    persist (device state, host sketches, batch cursor) every N batches;
+    resume = load + skip the already-folded prefix of the deterministic
+    batch stream.  Single-process only in v1 — each host would otherwise
+    need its own artifact and a coordinated cursor.  Known cost: the
+    skipped prefix is still read+Arrow-decoded on resume (the skip is
+    per-batch, not per-fragment); the folds and transfers it saves are
+    the dominant share of scan time."""
+
+    _META_KEYS = ("n_num", "n_hash", "batch_rows", "hll_precision",
+                  "native_hash", "source_fp", "quantile_sketch_size",
+                  "topk_capacity", "seed")
+
+    def __init__(self, config: ProfilerConfig, plan, runner, pshard,
+                 source_fp: str):
+        if pshard[1] != 1:
+            raise ValueError(
+                "checkpoint_path is single-process only; multi-host "
+                "profiles restart from the beginning on failure")
+        self.path = config.checkpoint_path
+        self.every = max(int(config.checkpoint_every_batches), 1)
+        self.config = config
+        self.plan = plan
+        self.runner = runner
+        self.source_fp = source_fp
+        self.last_saved = -1            # cursor of the newest artifact
+
+    def exists(self) -> bool:
+        import os
+        return os.path.exists(self.path)
+
+    def due(self, cursor: int) -> bool:
+        return cursor % self.every == 0
+
+    def _meta(self) -> Dict[str, Any]:
+        from tpuprof import native
+        return {"n_num": self.plan.n_num, "n_hash": self.plan.n_hash,
+                "batch_rows": self.config.batch_rows,
+                "hll_precision": self.config.hll_precision,
+                "native_hash": native.available(),
+                "source_fp": self.source_fp,
+                "quantile_sketch_size": self.config.quantile_sketch_size,
+                "topk_capacity": self.config.topk_capacity,
+                "seed": self.config.seed}
+
+    def save(self, state, sampler, hostagg, host_hll, cursor) -> None:
+        from tpuprof.runtime import checkpoint as ckpt
+        ckpt.save(self.path, state,
+                  {"sampler": sampler, "hostagg": hostagg,
+                   "host_hll": host_hll}, cursor, meta=self._meta())
+        self.last_saved = cursor
+        log_event("collect_checkpoint", cursor=cursor, path=self.path)
+
+    def load(self):
+        """(state, sampler, hostagg, host_hll, cursor) from the artifact,
+        after refusing any config/source divergence from the saved
+        prefix."""
+        from tpuprof.runtime import checkpoint as ckpt
+        payload = ckpt.load_payload(self.path)
+        meta = payload["meta"]
+        mine = self._meta()
+        for key in self._META_KEYS:
+            if meta.get(key) != mine[key]:
+                raise ValueError(
+                    f"checkpoint {key}={meta.get(key)!r} does not match "
+                    f"this run's {mine[key]!r} — the batch stream or "
+                    "sketch shapes would diverge from the saved prefix")
+        state = ckpt.materialize(payload, self.runner.init_pass_a())
+        blob = payload["host_blob"]
+        self.last_saved = payload["cursor"]
+        log_event("collect_resume", cursor=payload["cursor"],
+                  path=self.path)
+        return (state, blob["sampler"], blob["hostagg"],
+                blob["host_hll"], payload["cursor"])
+
+    def clear(self) -> None:
+        import os
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+
+class TPUStatsBackend:
+    """Profile Arrow-readable sources with the fused sharded scan."""
+
+    name = "tpu"
+
+    def __init__(self, devices=None):
+        self._devices = devices
+
+    def collect(self, source: Any, config: ProfilerConfig) -> Dict[str, Any]:
+        import jax
+
+        from tpuprof.runtime.distributed import (merge_host_aggs,
+                                                 merge_recount_arrays,
+                                                 merge_samplers,
+                                                 merge_shift_estimates)
+        pshard = (jax.process_index(), jax.process_count())
+        ingest = ArrowIngest(source, config.batch_rows, process_shard=pshard)
+        plan = ingest.plan
+        if not plan.specs:
+            return _empty_stats(config)
+        runner = MeshRunner(config, plan.n_num, plan.n_hash,
+                            devices=self._devices)
+        # host batches are padded to the runner's device-divisible row
+        # count (chunks are <= batch_rows <= runner.rows by construction)
+        pad = runner.rows
+
+        hostagg = HostAgg(plan, config)
+        sampler = RowSampler(config.quantile_sketch_size, plan.n_num,
+                             seed=config.seed, process_index=pshard[0])
+        # HLL registers fold on host when the native extension is usable
+        # on EVERY process (register merges must mix like with like);
+        # otherwise the packed plane ships to the device scatter path
+        from tpuprof import native
+        from tpuprof.runtime.distributed import allgather_objects
+        use_host_hll = plan.n_hash > 0 and all(
+            allgather_objects(native.available()))
+        host_hll = khll.HostRegisters(plan.n_hash, config.hll_precision) \
+            if use_host_hll else None
+        # ---- batch-granular resumability (SURVEY §5 checkpoint/resume):
+        # the pass-A scan persists (device state, host sketches, batch
+        # cursor) every N batches; a crashed profile resumes by skipping
+        # the already-folded prefix of the (deterministic) batch stream.
+        resume = _CollectCheckpoint(config, plan, runner, pshard,
+                                    ingest.fingerprint()) \
+            if config.checkpoint_path else None
+        skip = 0
+        if resume is not None and resume.exists():
+            state, sampler, hostagg, host_hll, skip = resume.load()
+        else:
+            state = None
+        cursor = skip
+
+        with phase_timer("scan_a"):
+            # centering shift from the first batch's prefix — any value
+            # near the data scale conditions the f32 sums equally well.
+            # The estimate is agreed ACROSS hosts (deadlock-safe even for
+            # a host with an empty fragment stripe) so every device in
+            # the global mesh carries the same shift and the collective
+            # merge's rebase is exactly the identity.
+            batches = prefetch_prepared(ingest, plan, pad,
+                                        config.hll_precision,
+                                        skip_batches=skip)
+            first_hb = next(batches, None)
+            if state is None:
+                shift = merge_shift_estimates(
+                    estimate_shift(first_hb)
+                    if first_hb is not None else None)
+                state = runner.init_pass_a(shift)
+            if first_hb is not None:
+                for hb in itertools.chain((first_hb,), batches):
+                    db = runner.put_batch(hb, with_hll=host_hll is None)
+                    state = runner.step_a(state, db)  # transfer is async —
+                    # the host-side folds below overlap the device step
+                    sampler.update(hb.x, hb.nrows)
+                    if host_hll is not None:
+                        host_hll.update(hb.hll, hb.nrows)
+                    hostagg.update(hb)
+                    cursor += 1
+                    if resume is not None and resume.due(cursor):
+                        resume.save(state, sampler, hostagg, host_hll,
+                                    cursor)
+        if resume is not None and resume.last_saved != cursor:
+            # pass A complete: keep the final state on disk so a crash
+            # during merge/pass-B resumes with the whole stream skipped
+            # instead of rescanning; cleared only after assembly
+            resume.save(state, sampler, hostagg, host_hll, cursor)
+        with phase_timer("merge"):
+            res_a = runner.finalize_a(state)
+            # cross-host: device sketches already merged by the mesh
+            # collectives; host-side aggregates ride one DCN gather
+            hostagg = merge_host_aggs(hostagg)
+            sampler = merge_samplers(sampler)
+        log_event("pass_a", rows=hostagg.n_rows, devices=runner.n_dev,
+                  n_num=plan.n_num, n_hash=plan.n_hash)
+
+        momf = kmoments.finalize(res_a["mom"])
+        rho_all = kcorr.finalize(res_a["corr"])
+        probes = list(config.quantile_probes)
+        quants = sampler.quantiles(probes)
+        sample_vals, sample_kept = sampler.columns()
+        if host_hll is not None:
+            from tpuprof.runtime.distributed import merge_hll_registers
+            hll_est = khll.finalize(merge_hll_registers(host_hll).regs)
+        else:
+            hll_est = khll.finalize(res_a["hll"])
+
+        # ---- pass B: exact histograms + MAD + top-k recount --------------
+        hists: Optional[List] = None
+        mad: Optional[np.ndarray] = None
+        recounter: Optional[Recounter] = None
+        rho_spear: Optional[np.ndarray] = None
+        if config.exact_passes and ingest.rescannable and plan.n_num > 0 \
+                and hostagg.n_rows > 0:
+            recounter = Recounter(hostagg)
+            state_b = runner.init_pass_b()
+            lo, hi, mean = momf["fmin"], momf["fmax"], momf["mean"]
+            lo = np.where(np.isfinite(lo), lo, 0.0)
+            hi = np.where(np.isfinite(hi), hi, 0.0)
+            mean_c = np.where(np.isfinite(mean), mean, 0.0)
+            lo_d = runner.put_replicated(lo, dtype=np.float32)
+            hi_d = runner.put_replicated(hi, dtype=np.float32)
+            mean_d = runner.put_replicated(mean_c, dtype=np.float32)
+            spear_state = None
+            if config.spearman:
+                spear_state = runner.init_spearman()
+                if runner.spear_grid:
+                    # pallas tier: dense-compare ranks on a G-point grid.
+                    # The wide tier's rank kernel has a VMEM budget
+                    # calibrated for G <= 256, so its grid is clamped.
+                    from tpuprof.kernels import fused as kfused
+                    g = config.spearman_grid
+                    if plan.n_num > kfused.MAX_FUSED_COLS:
+                        g = min(g, kfused.MAX_WIDE_SPEAR_GRID)
+                    spear_grid = runner.put_replicated(
+                        sampler.cdf_grid(g), dtype=np.float32)
+                else:
+                    # exact tier: rank transform through the pass-A sample
+                    # CDF (+inf pads unkept slots past every real value)
+                    srt, kept_n = sampler.sorted_padded()
+                    kept_counts = runner.put_replicated(kept_n,
+                                                        dtype=np.int32)
+                    sorted_sample = runner.put_replicated(srt,
+                                                          dtype=np.float32)
+            with phase_timer("scan_b"):
+                # hashes=False: pass B never reads the HLL plane, so the
+                # host hash loop is skipped on the second scan
+                for hb in prefetch_prepared(ingest, plan, pad,
+                                            config.hll_precision,
+                                            hashes=False):
+                    db = runner.put_batch(hb, with_hll=False)
+                    state_b = runner.step_b(state_b, db, lo_d, hi_d, mean_d)
+                    if spear_state is not None:
+                        if runner.spear_grid:
+                            spear_state = runner.step_spearman_grid(
+                                spear_state, db, spear_grid)
+                        else:
+                            spear_state = runner.step_spearman(
+                                spear_state, db, sorted_sample, kept_counts)
+                    recounter.update(hb)
+                res_b = runner.finalize_b(state_b)
+                recounter.counts = merge_recount_arrays(recounter.counts)
+            if spear_state is not None:
+                rho_spear = kcorr.finalize(
+                    runner.finalize_spearman(spear_state))
+            hists, mad = khistogram.finalize(
+                res_b, momf["fmin"], momf["fmax"], momf["n"], config.bins)
+        elif config.spearman and hostagg.n_rows > 0 and plan.n_num > 1:
+            # requested but the rank pass cannot run (single-pass mode or
+            # a non-rescannable source) — say so instead of silently
+            # omitting the matrix
+            from tpuprof.utils.trace import logger
+            logger.warning(
+                "spearman=True requires a rescannable source and "
+                "exact_passes=True; the spearman matrix was skipped")
+        if recounter is None and config.exact_passes \
+                and ingest.rescannable and hostagg.n_rows > 0:
+            # no numeric columns — only the top-k recount matters
+            recounter = Recounter(hostagg)
+            for hb in ingest.batches(config.hll_precision):
+                recounter.update(hb)
+
+        stats = _assemble(plan, config, ingest.sample(config.sample_rows),
+                          hostagg, momf, rho_all, quants, sample_vals,
+                          sample_kept, hll_est, hists, mad, recounter,
+                          probes, rho_spear=rho_spear)
+        if resume is not None:
+            resume.clear()           # profile assembled: artifact is stale
+        return stats
+
+
+# ---------------------------------------------------------------------------
+# Assembly: merged device/host results -> the stats dict contract
+# ---------------------------------------------------------------------------
+
+def _sample_mode(values: np.ndarray, kept: np.ndarray) -> float:
+    """Mode estimated from the uniform sample (exact when the sample holds
+    the whole column)."""
+    v = values[kept]
+    if not v.size:
+        return np.nan
+    uniq, cnt = np.unique(v, return_counts=True)
+    return float(uniq[np.argmax(cnt)])
+
+
+def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
+              sample_vals, sample_kept, hll_est, hists, mad, recounter,
+              probes, rho_spear=None) -> Dict[str, Any]:
+    n = hostagg.n_rows
+    variables: Dict[str, Dict[str, Any]] = {}
+    freq: Dict[str, pd.Series] = {}
+
+    # ---- first sweep: per-column counts/distincts + provisional kinds ----
+    kinds: Dict[str, str] = {}
+    commons: Dict[str, Dict[str, Any]] = {}
+    for spec in plan.specs:
+        if spec.role == "num":
+            lane = spec.num_lane
+            n_missing = int(momf["n_missing"][lane])
+            count = n - n_missing
+            if count > 0 and momf["min"][lane] == momf["max"][lane]:
+                distinct = 1
+            elif spec.base_kind == schema.BOOL:
+                distinct = 2 if count else 0
+            else:
+                distinct = int(round(hll_est[spec.hash_lane]))
+                distinct = max(min(distinct, count), 1 if count else 0)
+        elif spec.role == "date":
+            n_missing = hostagg.date_null[spec.name]
+            count = n - n_missing
+            distinct = int(round(hll_est[spec.hash_lane]))
+            distinct = max(min(distinct, count), 1 if count else 0)
+        else:
+            n_missing = hostagg.cat_null[spec.name]
+            count = n - n_missing
+            mg = hostagg.mg[spec.name]
+            exact_distinct = mg.distinct_count()
+            distinct = exact_distinct if exact_distinct is not None \
+                else max(min(int(round(hll_est[spec.hash_lane])), count),
+                         1 if count else 0)
+        commons[spec.name] = {
+            "count": count,
+            "n_missing": n_missing,
+            "p_missing": n_missing / n if n else 0.0,
+            "distinct_count": distinct,
+            "p_unique": distinct / count if count else 0.0,
+            "is_unique": count > 0 and distinct == count,
+            # Arrow buffer bytes (the streamed-source analogue of the
+            # reference's series.memory_usage)
+            "memorysize": hostagg.memorysize(spec.name),
+        }
+        kinds[spec.name] = schema.classify(spec.base_kind, distinct, count)
+
+    # ---- correlation rejection over refined-NUM columns ------------------
+    num_specs = [s for s in plan.specs
+                 if s.role == "num" and kinds[s.name] == schema.NUM]
+    num_names = [s.name for s in num_specs]
+    lanes = [s.num_lane for s in num_specs]
+    corr_df = pd.DataFrame(rho_all[np.ix_(lanes, lanes)],
+                           index=num_names, columns=num_names) \
+        if len(lanes) >= 2 else pd.DataFrame()
+    rejected = schema.reject_by_correlation(corr_df, num_names, config) \
+        if len(lanes) >= 2 else {}
+    for name in rejected:
+        kinds[name] = schema.CORR
+
+    # ---- per-column stats -------------------------------------------------
+    for spec in plan.specs:
+        name, kind, common = spec.name, kinds[spec.name], commons[spec.name]
+        stats = dict(common)
+        if kind in (schema.NUM, schema.BOOL):
+            lane = spec.num_lane
+            stats.update(_numeric_stats(lane, spec, momf, quants,
+                                        sample_vals, sample_kept, hists,
+                                        mad, probes, config))
+            if kind == schema.BOOL:
+                n_true = int(round(momf["sum"][lane])) if common["count"] else 0
+                vc = pd.Series({True: n_true,
+                                False: common["count"] - n_true}
+                               ).sort_values(ascending=False)
+                freq[name] = vc
+                stats["mean"] = momf["mean"][lane]
+                stats["mode"] = bool(vc.index[0]) if common["count"] else np.nan
+                stats["top"] = stats["mode"]
+                stats["freq"] = int(vc.iloc[0]) if common["count"] else 0
+        elif kind == schema.CAT:
+            vc = (recounter.value_counts(name) if recounter is not None
+                  else pd.Series({v: c for v, c in
+                                  hostagg.mg[name].top(config.topk_capacity)}))
+            vc = vc.sort_values(ascending=False)
+            stats["mode"] = vc.index[0] if len(vc) else np.nan
+            stats["top"] = stats["mode"]
+            stats["freq"] = int(vc.iloc[0]) if len(vc) else 0
+            freq[name] = vc.head(config.top_freq)
+        elif kind == schema.DATE:
+            lo = hostagg.date_min.get(name)
+            hi = hostagg.date_max.get(name)
+            stats["min"] = pd.Timestamp(lo) if lo is not None else pd.NaT
+            stats["max"] = pd.Timestamp(hi) if hi is not None else pd.NaT
+            stats["range"] = (stats["max"] - stats["min"]) \
+                if lo is not None else pd.NaT
+        elif kind == schema.CONST:
+            stats["mode"] = _const_mode(spec, momf, hostagg)
+        elif kind == schema.UNIQUE:
+            stats["first_rows"] = [
+                v for v in hostagg.first_values.get(name, []) if v is not None
+            ][:5]
+        elif kind == schema.CORR:
+            other, rho = rejected[name]
+            stats.update({"correlation_var": other, "correlation": rho})
+        stats["type"] = kind
+        variables[name] = stats
+
+    table = schema.make_table_stats(
+        n, variables,
+        memorysize=float(sum(hostagg.memorysize(c)
+                             for c in hostagg.col_nbytes))
+        if hostagg.col_nbytes else np.nan)
+    messages = schema.derive_messages(variables, config)
+    correlations = {"pearson": corr_df}
+    if rho_spear is not None and len(lanes) >= 2:
+        correlations["spearman"] = pd.DataFrame(
+            rho_spear[np.ix_(lanes, lanes)], index=num_names,
+            columns=num_names)
+    return {
+        "table": table,
+        "variables": variables,
+        "freq": freq,
+        "correlations": correlations,
+        "messages": messages,
+        "sample": sample_df,
+    }
+
+
+def _numeric_stats(lane, spec, momf, quants, sample_vals, sample_kept,
+                   hists, mad, probes, config) -> Dict[str, Any]:
+    out = {
+        "mean": float(momf["mean"][lane]),
+        "std": float(momf["std"][lane]),
+        "variance": float(momf["variance"][lane]),
+        "cv": float(momf["cv"][lane]),
+        "skewness": float(momf["skewness"][lane]),
+        "kurtosis": float(momf["kurtosis"][lane]),
+        "sum": float(momf["sum"][lane]),
+        "min": float(momf["min"][lane]),
+        "max": float(momf["max"][lane]),
+        "n_zeros": int(momf["n_zeros"][lane]),
+        "n_infinite": int(momf["n_inf"][lane]),
+    }
+    out["range"] = out["max"] - out["min"]
+    n_valid = int(momf["n"][lane]) + int(momf["n_inf"][lane])
+    out["p_zeros"] = out["n_zeros"] / n_valid if n_valid else 0.0
+    out["p_infinite"] = out["n_infinite"] / n_valid if n_valid else 0.0
+    for idx, p in enumerate(probes):
+        out[schema.QUANTILE_FIELDS[p]] = float(quants[idx, lane])
+    out["iqr"] = out["p75"] - out["p25"]
+    if mad is not None:
+        out["mad"] = float(mad[lane])
+    else:  # single-pass mode: MAD from the uniform sample
+        v = sample_vals[lane][sample_kept[lane]]
+        out["mad"] = float(np.abs(v - v.mean()).mean()) if v.size else np.nan
+    if hists is not None:
+        out["histogram"] = hists[lane]
+    else:  # single-pass mode: sample-scaled histogram
+        v = sample_vals[lane][sample_kept[lane]]
+        if v.size and np.isfinite(momf["fmin"][lane]) \
+                and momf["fmax"][lane] > momf["fmin"][lane]:
+            counts, edges = np.histogram(
+                v, bins=config.bins,
+                range=(momf["fmin"][lane], momf["fmax"][lane]))
+            scale = momf["n"][lane] / max(v.size, 1)
+            out["histogram"] = ((counts * scale).astype(np.int64), edges)
+        else:
+            out["histogram"] = None
+    out["mini_histogram"] = out["histogram"]
+    out["mode"] = _sample_mode(sample_vals[lane], sample_kept[lane])
+    return out
+
+
+def _const_mode(spec, momf, hostagg):
+    if spec.role == "num":
+        v = momf["min"][spec.num_lane]
+        if not np.isfinite(v):        # empty column: min is the +inf identity
+            return np.nan
+        if spec.base_kind == schema.BOOL:
+            return bool(v)
+        return float(v)
+    if spec.role == "date":
+        lo = hostagg.date_min.get(spec.name)
+        return pd.Timestamp(lo) if lo is not None else pd.NaT
+    top = hostagg.mg[spec.name].top(1)
+    return top[0][0] if top else np.nan
+
+
+def _empty_stats(config) -> Dict[str, Any]:
+    return {
+        "table": schema.make_table_stats(0, {}),
+        "variables": {},
+        "freq": {},
+        "correlations": {"pearson": pd.DataFrame()},
+        "messages": [],
+        "sample": pd.DataFrame(),
+    }

@@ -1,0 +1,97 @@
+"""Command-line interface: ``tpuprof profile data.parquet -o report.html``
+(SURVEY.md §7.1 stage 7; the reference has no CLI — notebook-only — so
+this is a capability the TPU framework adds for batch/cluster use)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpuprof",
+        description="TPU-native data profiling: one fused scan, full HTML "
+                    "report.")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("profile", help="profile a table and write the report")
+    p.add_argument("source", help="Parquet file/directory path")
+    p.add_argument("-o", "--output", default="report.html",
+                   help="output HTML path (default: report.html)")
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "cpu", "tpu"])
+    p.add_argument("--bins", type=int, default=10)
+    p.add_argument("--corr-reject", type=float, default=0.9)
+    p.add_argument("--batch-rows", type=int, default=1 << 16)
+    p.add_argument("--sketch-size", type=int, default=4096,
+                   help="quantile sample-sketch size K")
+    p.add_argument("--hll-precision", type=int, default=11)
+    p.add_argument("--single-pass", action="store_true",
+                   help="one scan only (sketch-derived histograms/top-k)")
+    p.add_argument("--spearman", action="store_true",
+                   help="also compute Spearman rank correlations")
+    p.add_argument("--stats-json", metavar="PATH",
+                   help="also dump the stats dict as JSON")
+    p.add_argument("--trace", metavar="DIR",
+                   help="capture a jax.profiler trace into DIR")
+    p.add_argument("--checkpoint", metavar="PATH",
+                   help="persist the scan every N batches and resume "
+                        "from PATH after a crash")
+    p.add_argument("--checkpoint-every", type=int, default=64,
+                   metavar="N", help="batches between checkpoints")
+    return parser
+
+
+def cmd_profile(args: argparse.Namespace) -> int:
+    from tpuprof import ProfileReport, ProfilerConfig
+    from tpuprof.utils.trace import phase_timer, trace_to
+
+    if args.spearman and args.single_pass:
+        print("tpuprof: error: --spearman needs the second scan "
+              "(incompatible with --single-pass)", file=sys.stderr)
+        return 2
+
+    config = ProfilerConfig(
+        backend=args.backend, bins=args.bins, corr_reject=args.corr_reject,
+        batch_rows=args.batch_rows, quantile_sketch_size=args.sketch_size,
+        hll_precision=args.hll_precision, exact_passes=not args.single_pass,
+        spearman=args.spearman, checkpoint_path=args.checkpoint,
+        checkpoint_every_batches=args.checkpoint_every)
+
+    t0 = time.perf_counter()
+    with trace_to(args.trace):
+        with phase_timer("profile"):
+            report = ProfileReport(args.source, config=config)
+        with phase_timer("render"):
+            report.to_file(args.output)
+    elapsed = time.perf_counter() - t0
+
+    table = report.description["table"]
+    rate = table["n"] / elapsed if elapsed > 0 else float("nan")
+    print(f"tpuprof: {table['n']:,} rows x {table['nvar']} cols -> "
+          f"{args.output} in {elapsed:.2f}s ({rate:,.0f} rows/s)",
+          file=sys.stderr)
+    if args.stats_json:
+        from tpuprof.report.formatters import fmt_value
+        payload = {
+            name: {k: fmt_value(v) for k, v in var.items()
+                   if k not in ("histogram", "mini_histogram")}
+            for name, var in report.description["variables"].items()}
+        with open(args.stats_json, "w") as fh:
+            json.dump({"table": {k: fmt_value(v) for k, v in table.items()},
+                       "variables": payload}, fh, indent=2)
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "profile":
+        return cmd_profile(args)
+    raise AssertionError(args.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

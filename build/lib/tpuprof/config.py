@@ -1,0 +1,100 @@
+"""Profiler configuration.
+
+The reference exposes constructor kwargs only (``bins=10``,
+``corr_reject=0.9``, sample size — SURVEY.md §5 "Config / flag system").
+tpuprof keeps that facade and routes everything through one dataclass so
+the TPU runtime knobs (batch size, sketch sizes, mesh shape, backend
+selection) have a single home with sane defaults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class ProfilerConfig:
+    # ---- parity knobs (reference constructor kwargs) ----------------------
+    bins: int = 10                  # histogram bin count
+    corr_reject: float = 0.9        # |Pearson| above this vs an earlier
+                                    # column rejects the later column (CORR)
+    sample_rows: int = 5            # head rows shown in the report
+    top_freq: int = 10              # value-count rows shown per CAT column
+    correlation_overrides: Optional[Sequence[str]] = None  # never reject these
+
+    # ---- warning thresholds (reference: messages derivation, SURVEY §2.1) -
+    high_cardinality_threshold: int = 50     # CAT distinct count above => warn
+    missing_threshold: float = 0.19          # p_missing above => warn
+    zeros_threshold: float = 0.5             # p_zeros above => warn
+    skewness_threshold: float = 20.0         # |skew| above => warn
+
+    # ---- backend selection ------------------------------------------------
+    backend: str = "auto"           # "auto" | "cpu" | "tpu"
+
+    # ---- TPU runtime knobs ------------------------------------------------
+    batch_rows: int = 1 << 16       # rows per Arrow batch fed to the device
+    quantile_sketch_size: int = 4096  # K: uniform row-sample size shared by
+                                      # all numeric columns (ingest/sample.py);
+                                      # a column keeps ~K*(1-p_missing) finite
+                                      # values, rank error ~ 1/sqrt(kept)
+    hll_precision: int = 11         # p: 2^p registers per column; rel. error
+                                    # ~= 1.04 / sqrt(2^p) (~2.3% at p=11)
+    topk_capacity: int = 4096       # Misra-Gries candidate capacity per CAT
+                                    # column; count error <= n / capacity
+    exact_passes: bool = True       # second scan: exact histograms + exact
+                                    # recount of top-k candidates (parity with
+                                    # Spark's exact groupBy().count()).
+                                    # False => single-pass streaming mode with
+                                    # sample-derived histograms.
+    mesh_devices: Optional[int] = None  # None => all available devices
+    checkpoint_path: Optional[str] = None   # batch-profile resumability:
+                                            # persist the pass-A scan here
+                                            # every checkpoint_every_batches
+                                            # and resume from it on restart
+                                            # (single-process; SURVEY §5)
+    checkpoint_every_batches: int = 64
+    seed: int = 0                   # PRNG seed for the sample sketch
+    use_pallas: Optional[bool] = None   # None = auto (on for real TPU):
+                                        # dense pallas histogram kernel vs
+                                        # XLA scatter-add
+    use_fused: Optional[bool] = None    # None = auto (on for real TPU):
+                                        # single-read fused pallas pass A
+                                        # (kernels/fused.py) vs the
+                                        # per-kernel XLA formulation
+
+    # ---- quantiles reported (reference: approxQuantile probes) ------------
+    quantile_probes: Sequence[float] = (0.05, 0.25, 0.5, 0.75, 0.95)
+
+    # ---- optional parity: Spearman rank correlation -----------------------
+    # (upstream pandas-profiling 1.x computed it; whether the Spark fork
+    # kept it is unverified — SURVEY §2.1 treats it as optional parity.
+    # Rejection stays Pearson-based either way.)
+    spearman: bool = False
+    spearman_grid: int = 256        # G: CDF-grid resolution of the pallas
+                                    # Spearman tier (rank error ~1/G on top
+                                    # of the sample CDF error; the CPU-mesh
+                                    # tier keeps exact average-tie ranks)
+
+    def __post_init__(self) -> None:
+        if self.bins < 1:
+            raise ValueError("bins must be >= 1")
+        if not 0.0 < self.corr_reject <= 1.0:
+            raise ValueError("corr_reject must be in (0, 1]")
+        if not 2 <= self.spearman_grid <= 4096:
+            # upper bound keeps the fully-unrolled compare loop and the
+            # (cols, G) VMEM grid block inside sane compile/memory limits
+            raise ValueError("spearman_grid must be in [2, 4096]")
+        from tpuprof.kernels.hll import MAX_PRECISION
+        if self.hll_precision < 4 or self.hll_precision > MAX_PRECISION:
+            # upper bound set by the uint16 packed-observation format
+            # (11 idx bits + 5 rho bits), not by HLL itself
+            raise ValueError(
+                f"hll_precision must be in [4, {MAX_PRECISION}]")
+
+    @classmethod
+    def from_kwargs(cls, **kwargs) -> "ProfilerConfig":
+        """Build a config from ProfileReport(**kwargs), ignoring unknowns the
+        way the reference tolerates stray kwargs."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in kwargs.items() if k in fields})

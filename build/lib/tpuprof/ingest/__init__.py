@@ -1,0 +1,13 @@
+"""Host-side ingestion: Arrow sources → device-ready batches.
+
+The reference's ingestion is Spark's (Parquet readers + JVM row
+representation, external to the repo — SURVEY.md §1 L0).  tpuprof reads
+Arrow record batches directly (pyarrow Dataset streaming, zero
+materialization of the full table) and performs the host-only prep TPUs
+cannot do: string dictionary decode, 64-bit hashing, timestamp min/max
+(SURVEY §7.2 "Strings on TPU").
+"""
+
+from tpuprof.ingest.arrow import ArrowIngest, ColumnPlan, HostBatch
+
+__all__ = ["ArrowIngest", "ColumnPlan", "HostBatch"]

@@ -1,0 +1,457 @@
+"""Arrow → device-batch preparation (the host hot loop, SURVEY.md §3.5).
+
+Per record batch this module produces fixed-shape numpy arrays the fused
+device step consumes directly:
+
+* ``x``       (G, n_num)  float32 — numeric/boolean lanes, NaN = missing
+* ``row_valid`` (G,)      bool    — masks the padding rows
+* ``hll``     (G, n_hash) uint16 — packed HLL observations
+                                     ``(register_idx << 5) | rho`` for
+                                     EVERY column, 0 = null/padding
+                                     (kernels/hll.pack — 2 bytes/cell of
+                                     host→device traffic instead of 9)
+
+plus the host-only side-channel work: Misra-Gries frequency updates for
+categorical columns (on dictionary codes, vectorized), date min/max on
+int64 nanoseconds (float would quantize to 256 ns — exactness matters),
+null tallies, and the report's sample rows.
+
+Hashing: ``pandas.util.hash_array`` (vectorized SipHash-like, C speed).
+String columns are dictionary-encoded once per batch, only the
+dictionary is hashed, and codes gather the hashes — O(distinct) hashing
+instead of O(rows) (SURVEY §7.2's vectorize-before-C++ guidance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.dataset as pads
+
+from tpuprof import schema
+
+
+@dataclasses.dataclass
+class ColumnSpec:
+    name: str
+    role: str                 # "num" | "date" | "cat"
+    base_kind: str            # schema.{NUM,BOOL,DATE,CAT} before refinement
+    num_lane: int = -1        # lane in the x matrix ("num" role only)
+    hash_lane: int = -1       # lane in the hash matrices (every column)
+    arrow_type: Optional[pa.DataType] = None
+
+
+@dataclasses.dataclass
+class ColumnPlan:
+    specs: List[ColumnSpec]
+
+    @property
+    def n_num(self) -> int:
+        return sum(1 for s in self.specs if s.role == "num")
+
+    @property
+    def n_hash(self) -> int:
+        return len(self.specs)
+
+    def by_role(self, role: str) -> List[ColumnSpec]:
+        return [s for s in self.specs if s.role == role]
+
+    @classmethod
+    def from_schema(cls, arrow_schema: pa.Schema) -> "ColumnPlan":
+        specs: List[ColumnSpec] = []
+        num_lane = 0
+        for i, field in enumerate(arrow_schema):
+            t = field.type
+            if isinstance(t, pa.DictionaryType):
+                t_inner = t.value_type
+            else:
+                t_inner = t
+            if pa.types.is_boolean(t_inner):
+                spec = ColumnSpec(field.name, "num", schema.BOOL,
+                                  num_lane=num_lane, arrow_type=t)
+                num_lane += 1
+            elif (pa.types.is_integer(t_inner) or pa.types.is_floating(t_inner)
+                  or pa.types.is_decimal(t_inner)):
+                spec = ColumnSpec(field.name, "num", schema.NUM,
+                                  num_lane=num_lane, arrow_type=t)
+                num_lane += 1
+            elif (pa.types.is_timestamp(t_inner) or pa.types.is_date(t_inner)
+                  or pa.types.is_time(t_inner)):
+                spec = ColumnSpec(field.name, "date", schema.DATE,
+                                  arrow_type=t)
+            else:
+                spec = ColumnSpec(field.name, "cat", schema.CAT, arrow_type=t)
+            spec.hash_lane = i
+            specs.append(spec)
+        return cls(specs)
+
+
+@dataclasses.dataclass
+class HostBatch:
+    """One device-ready batch plus host-side raw views."""
+
+    nrows: int
+    x: np.ndarray             # (G, n_num) float32, NaN missing/padding
+    row_valid: np.ndarray     # (G,) bool
+    hll: np.ndarray           # (G, n_hash) uint16 packed observations
+    # host-side views for MG / recount / dates: name -> payload
+    cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]]   # (codes, dict_vals)
+    date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]]   # (int64 ns, valid)
+    # precision the hll column was packed with — MeshRunner refuses a
+    # batch whose packing disagrees with its register width (a mismatched
+    # idx would silently scatter into NEIGHBORING columns' registers)
+    hll_precision: int = 11
+    # Arrow buffer bytes per column — feeds the report's "size in
+    # memory" parity fields (reference: df.memory_usage).  Dictionary
+    # buffers are tracked separately because batches SHARE them: their
+    # sizes merge by max, not sum (a per-batch sum counts the one
+    # dictionary once per batch — measured ~6x overstatement)
+    col_nbytes: Optional[Dict[str, int]] = None
+    col_dict_nbytes: Optional[Dict[str, int]] = None
+
+
+def _hash64(keys: np.ndarray) -> np.ndarray:
+    """64-bit hashes of canonical uint64 keys.  Native C++ path when
+    available (see tpuprof/native), pandas ``hash_array`` otherwise; the
+    choice is process-stable so hashes agree across batches/fragments.
+
+    Callers are responsible for producing the same key for the same
+    value in every batch (e.g. a float32 column always hashes its f32
+    bit pattern, never a widened f64 one)."""
+    from tpuprof import native
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    h = native.hash_u64_array(keys)
+    if h is not None:
+        return h
+    return pd.util.hash_array(keys).astype(np.uint64)
+
+
+def _num_keys(values: np.ndarray) -> np.ndarray:
+    """Canonical uint64 hash keys for a numeric column's values: the bit
+    pattern, widened, with -0.0 folded into +0.0."""
+    if values.dtype == np.float32:
+        bits = np.where(values == 0.0, np.float32(0.0), values
+                        ).view(np.uint32)
+        return bits.astype(np.uint64)
+    if values.dtype == np.float64:
+        return np.where(values == 0.0, 0.0, values).view(np.uint64)
+    return values.astype(np.int64, copy=False).view(np.uint64)
+
+
+def _hash64_dictionary(dictionary, dvals: np.ndarray) -> np.ndarray:
+    """Hash a batch's string dictionary: native buffer path when possible,
+    else pandas over the materialized object values."""
+    from tpuprof import native
+    h = native.hash_string_dictionary(dictionary)
+    if h is not None:
+        return h
+    return pd.util.hash_array(dvals).astype(np.uint64)
+
+
+def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
+                  pad_rows: int, hll_precision: int = 11,
+                  hashes: bool = True) -> HostBatch:
+    """Decode one Arrow record batch into a fixed-shape HostBatch.
+
+    ``hashes=False`` skips hashing + HLL packing (the host hot loop) and
+    leaves the packed plane zeros — pass B only needs values and
+    categorical codes."""
+    from tpuprof.kernels import hll as khll
+    n = batch.num_rows
+    g = pad_rows
+    n_num, n_hash = plan.n_num, plan.n_hash
+    # Fortran order: the loop below fills one COLUMN at a time, and with
+    # row-major targets those 5 writes/column are stride-n_cols cache
+    # misses (measured 20x slower at 200 cols).  JAX re-lays-out on
+    # transfer either way.
+    x = np.full((g, n_num), np.nan, dtype=np.float32, order="F")
+    # hashes=False leaves no consumer for the plane — skip its
+    # allocation+memset entirely (zero-width, so downstream slicing and
+    # transposes stay shape-consistent)
+    hll_packed = np.zeros((g, n_hash if hashes else 0), dtype=np.uint16,
+                          order="F")
+    row_valid = np.zeros((g,), dtype=bool)
+    row_valid[:n] = True
+    cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+
+    col_nbytes: Dict[str, int] = {}
+    col_dict_nbytes: Dict[str, int] = {}
+
+    def decode_column(i: int, spec: ColumnSpec) -> None:
+        arr = batch.column(i)
+        # distinct keys per column: thread-safe dict writes
+        if isinstance(arr, pa.DictionaryArray):
+            col_nbytes[spec.name] = arr.indices.nbytes
+            col_dict_nbytes[spec.name] = arr.dictionary.nbytes
+        else:
+            col_nbytes[spec.name] = arr.nbytes
+        if spec.role == "num":
+            t = arr.type
+            if pa.types.is_floating(t) and t.bit_width == 32:
+                vals = arr.to_numpy(zero_copy_only=False)   # f32, NaN=null
+                x[:n, spec.num_lane] = vals
+                valid = ~np.isnan(vals)
+            elif pa.types.is_floating(t) or pa.types.is_decimal(t):
+                vals = arr.cast(pa.float64(), safe=False).to_numpy(
+                    zero_copy_only=False)
+                x[:n, spec.num_lane] = vals.astype(np.float32)
+                valid = ~np.isnan(vals)
+            else:                       # ints / bools: stay in int64 so
+                valid = (arr.is_valid().to_numpy(zero_copy_only=False)
+                         if arr.null_count else np.ones(n, dtype=bool))
+                vals = arr.cast(pa.int64(), safe=False).fill_null(0) \
+                    .to_numpy(zero_copy_only=False)         # ids > 2^53
+                xf = vals.astype(np.float32)                # hash exactly
+                if arr.null_count:
+                    xf = np.where(valid, xf, np.nan)
+                x[:n, spec.num_lane] = xf
+            if hashes:
+                h64 = _hash64(_num_keys(vals))
+                hll_packed[:n, spec.hash_lane] = khll.pack(
+                    h64, valid, hll_precision)
+        elif spec.role == "date":
+            valid = arr.is_valid().to_numpy(zero_copy_only=False)
+            ints = arr.cast(pa.timestamp("ns"), safe=False) \
+                      .cast(pa.int64(), safe=False) \
+                      .fill_null(0).to_numpy(zero_copy_only=False)
+            if hashes:
+                h64 = _hash64(_num_keys(ints))
+                hll_packed[:n, spec.hash_lane] = khll.pack(
+                    h64, valid, hll_precision)
+            date_ints[spec.name] = (ints, valid)
+        else:  # cat
+            if not isinstance(arr.type, pa.DictionaryType):
+                arr = pc.dictionary_encode(arr)
+            combined = arr.combine_chunks() if isinstance(
+                arr, pa.ChunkedArray) else arr
+            valid = combined.is_valid().to_numpy(zero_copy_only=False)
+            codes = combined.indices.fill_null(0).to_numpy(
+                zero_copy_only=False).astype(np.int64)
+            dvals = np.asarray(combined.dictionary.to_pandas(), dtype=object)
+            if hashes:
+                if dvals.size:
+                    dh = _hash64_dictionary(combined.dictionary, dvals)
+                    h64 = dh[codes]
+                else:
+                    h64 = np.zeros(n, dtype=np.uint64)
+                hll_packed[:n, spec.hash_lane] = khll.pack(
+                    h64, valid, hll_precision)
+            cat_codes[spec.name] = (np.where(valid, codes, -1), dvals)
+
+    # Column decode is embarrassingly parallel (disjoint output columns)
+    # and numpy/arrow/ctypes all release the GIL, so on multi-core hosts
+    # a thread pool overlaps the work; single-core stays serial.
+    workers = min(_decode_threads(), len(plan.specs))
+    if workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(lambda iv: decode_column(*iv),
+                          enumerate(plan.specs)))
+    else:
+        for i, spec in enumerate(plan.specs):
+            decode_column(i, spec)
+
+    return HostBatch(nrows=n, x=x, row_valid=row_valid, hll=hll_packed,
+                     cat_codes=cat_codes, date_ints=date_ints,
+                     hll_precision=hll_precision, col_nbytes=col_nbytes,
+                     col_dict_nbytes=col_dict_nbytes)
+
+
+def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
+                      hll_precision: int, depth: int = 2,
+                      hashes: bool = True, skip_batches: int = 0):
+    """Yield prepared HostBatches with a background thread running
+    ``depth`` batches ahead, so Arrow decode + hashing + buffer layout
+    overlap the device scan instead of serializing with it.  Exceptions
+    from the reader (including the fragment-retry path) re-raise in the
+    consumer.  ``skip_batches`` drops the stream's first N raw batches
+    without preparing them (checkpoint resume — the batch order of a
+    rescannable source is deterministic)."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    sentinel = object()
+    failure = []
+    cancelled = threading.Event()
+
+    def _put(item) -> bool:
+        # bounded put that notices consumer abandonment: if the consumer
+        # stops draining (exception mid-scan, generator GC'd), the
+        # worker must not block on the full queue forever — that would
+        # leak the thread, depth+1 prepared batches, and the reader
+        while not cancelled.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for k, rb in enumerate(ingest.raw_batches()):
+                if k < skip_batches:
+                    continue
+                if not _put(prepare_batch(rb, plan, pad, hll_precision,
+                                          hashes=hashes)):
+                    return
+        except BaseException as exc:          # re-raised consumer-side
+            failure.append(exc)
+        finally:
+            _put(sentinel)
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        if failure:
+            raise failure[0]
+    finally:
+        cancelled.set()
+
+
+def _decode_threads() -> int:
+    import os
+    env = os.environ.get("TPUPROF_DECODE_THREADS")
+    if env:
+        return max(int(env), 1)
+    return min(os.cpu_count() or 1, 8)
+
+
+class ArrowIngest:
+    """Normalize a source into repeatable streams of HostBatches.
+
+    Accepted sources: pandas DataFrame, pyarrow Table, pyarrow Dataset,
+    or a path to a Parquet file/directory (streamed fragment-by-fragment,
+    never materialized — SURVEY §7.2 '1B×200 memory')."""
+
+    def __init__(self, source: Any, batch_rows: int, max_retries: int = 2,
+                 process_shard: Tuple[int, int] = (0, 1)):
+        self.batch_rows = int(batch_rows)
+        self.max_retries = int(max_retries)
+        # (process_index, process_count): multi-host runs stripe dataset
+        # fragments across hosts (runtime/distributed.py); (0, 1) reads all
+        self.process_shard = process_shard
+        self._table: Optional[pa.Table] = None
+        self._dataset: Optional[pads.Dataset] = None
+        if isinstance(source, pd.DataFrame):
+            self._table = pa.Table.from_pandas(source, preserve_index=False)
+        elif isinstance(source, pa.Table):
+            self._table = source
+        elif isinstance(source, pa.RecordBatch):
+            self._table = pa.Table.from_batches([source])
+        elif isinstance(source, pads.Dataset):
+            self._dataset = source
+        elif isinstance(source, str):
+            self._dataset = pads.dataset(source)
+        else:
+            raise TypeError(
+                f"cannot ingest {type(source)!r}; expected DataFrame, "
+                f"pyarrow Table/RecordBatch/Dataset, or a Parquet path")
+        arrow_schema = (self._table.schema if self._table is not None
+                        else self._dataset.schema)
+        self.plan = ColumnPlan.from_schema(arrow_schema)
+        self.rescannable = True
+
+    def fingerprint(self) -> str:
+        """Stable identity of the source's content — column names/types,
+        plus per-fragment path/size/mtime for file-backed datasets and a
+        content hash of the leading rows for in-memory tables (row count
+        alone would accept same-shape different data).  Guards checkpoint
+        resume against silently mixing a saved scan prefix with a
+        different dataset."""
+        import hashlib
+        h = hashlib.sha256()
+        schema = (self._table.schema if self._table is not None
+                  else self._dataset.schema)
+        for field in schema:
+            h.update(f"{field.name}:{field.type}".encode())
+        if self._table is not None:
+            h.update(f"rows={self._table.num_rows}".encode())
+            head = self._table.slice(0, 4096)
+            for batch in head.to_batches():
+                for col in batch.columns:
+                    for buf in col.buffers():
+                        if buf is not None:
+                            h.update(memoryview(buf))
+        else:
+            import os
+            for frag in self._dataset.get_fragments():
+                path = getattr(frag, "path", "")
+                try:
+                    stat = os.stat(path) if path else None
+                except OSError:
+                    stat = None
+                size = stat.st_size if stat else 0
+                mtime = int(stat.st_mtime_ns) if stat else 0
+                h.update(f"{path}:{size}:{mtime}".encode())
+        return h.hexdigest()
+
+    def raw_batches(self) -> Iterator[pa.RecordBatch]:
+        pidx, pcount = self.process_shard
+        if self._table is not None:
+            if pcount != 1:
+                raise ValueError(
+                    "multi-host profiling requires a file-backed dataset "
+                    "(each host streams its own fragments); got an "
+                    "in-memory table")
+            yield from self._table.to_batches(max_chunksize=self.batch_rows)
+            return
+        # Happy path: the dataset Scanner (multithreaded cross-fragment
+        # readahead).  Only after the first IO error do we drop to
+        # fragment-granular iteration with retry, skipping batches already
+        # delivered (SURVEY §5 'failure detection' — the Spark-task-retry
+        # analogue; batch boundaries are deterministic for a fixed
+        # batch_size so the skip is duplicate-free).  Multi-host runs skip
+        # the whole-dataset scanner and stream this host's fragment stripe.
+        delivered = 0
+        if pcount == 1:
+            try:
+                for rb in self._dataset.to_batches(
+                        batch_size=self.batch_rows):
+                    yield rb
+                    delivered += 1
+                return
+            except OSError:
+                pass  # fall through to the resilient path
+        seen = 0
+        for fragment in self._my_fragments():
+            frag_start = seen
+            for attempt in range(self.max_retries + 1):
+                try:
+                    seen = frag_start
+                    for rb in fragment.to_batches(batch_size=self.batch_rows):
+                        seen += 1
+                        if seen <= delivered:
+                            continue        # already yielded pre-failure
+                        yield rb
+                        delivered = seen
+                    break
+                except OSError:
+                    if attempt == self.max_retries:
+                        raise
+
+    def _my_fragments(self):
+        from tpuprof.runtime.distributed import assign_fragments
+        pidx, pcount = self.process_shard
+        return assign_fragments(self._dataset.get_fragments(), pidx, pcount)
+
+    def batches(self, hll_precision: int = 11) -> Iterator[HostBatch]:
+        for rb in self.raw_batches():
+            yield prepare_batch(rb, self.plan, self.batch_rows,
+                                hll_precision)
+
+    def sample(self, n_rows: int) -> pd.DataFrame:
+        if self._table is not None:
+            return self._table.slice(0, n_rows).to_pandas()
+        return self._dataset.head(n_rows).to_pandas()

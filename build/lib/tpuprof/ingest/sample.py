@@ -1,0 +1,134 @@
+"""Host-side mergeable uniform row sample (bottom-k priority sampling).
+
+This is the quantile/mode/sample-MAD sketch of the profile.  It used to
+live on device (kernels/quantiles.py — still available and tested), but
+the selection is driven ONLY by i.i.d. uniform priorities, never by the
+data, so it can run wherever the rows already are.  During ingestion the
+rows are in host RAM on their way to the device; sampling them there
+costs one vectorized RNG draw + a rare row gather per batch and removes
+the single most expensive op (a (cols, K+rows) top_k) from the device
+scan entirely.
+
+Semantics and bounds are the device sketch's (see kernels/quantiles.py):
+keeping the global top-K priorities over any partition of the stream is
+a uniform random sample without replacement, so
+
+    merge(sample(A), sample(B)) = top-K(concat)  ≡  sample(A ∪ B)
+
+exactly in distribution, and sample quantiles have rank error
+O(1/sqrt(K)).  Priorities are per ROW: the kept rows carry ALL numeric
+columns' values (NaN/±inf included); per column the finite subset of a
+uniform row sample is a uniform sample of that column's finite values.
+A column that is mostly missing therefore keeps ~K·(1-p_missing)
+values — its rank error grows accordingly (documented tier; columns
+with n ≤ K are still exact because every row is kept).
+
+Multi-host: each process samples its own fragment stripe with an
+independent RNG stream (seed ⊕ process ⊕ step); the final merge is one
+DCN object gather (runtime/distributed.merge_samplers).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class RowSampler:
+    """Mergeable bottom-k priority row sample, host-resident."""
+
+    def __init__(self, k: int, n_num: int, seed: int = 0,
+                 process_index: int = 0):
+        self.k = int(k)
+        self.n_num = int(n_num)
+        self.seed = int(seed)
+        self.process_index = int(process_index)
+        self.values = np.empty((0, n_num), dtype=np.float32)
+        self.prio = np.empty((0,), dtype=np.float64)
+        self.step = 0                        # batches folded (RNG position)
+
+    # -- ingestion ---------------------------------------------------------
+
+    def update(self, x: np.ndarray, nrows: int) -> None:
+        """Fold one host batch.  ``x``: (>=nrows, n_num) float32 (NaN for
+        missing); rows past ``nrows`` are padding and never sampled."""
+        rng = np.random.default_rng(
+            (self.seed, self.process_index, self.step))
+        self.step += 1
+        prio = rng.random(nrows)
+        if self.prio.size >= self.k:
+            # only candidates that beat the current kth priority can enter
+            tau = self.prio.min()
+            cand = prio > tau
+            if not cand.any():
+                return
+            rows = np.ascontiguousarray(x[:nrows][cand])
+            prio = prio[cand]
+        else:
+            rows = np.ascontiguousarray(x[:nrows])
+        self.values = np.concatenate([self.values, rows], axis=0)
+        self.prio = np.concatenate([self.prio, prio])
+        if self.prio.size > self.k:
+            self._compact()
+
+    def _compact(self) -> None:
+        idx = np.argpartition(self.prio, -self.k)[-self.k:]
+        self.values = np.ascontiguousarray(self.values[idx])
+        self.prio = self.prio[idx]
+
+    # -- merge (the commutative-monoid law; tests/test_sample.py) ----------
+
+    def merge(self, other: "RowSampler") -> "RowSampler":
+        if other.n_num != self.n_num:
+            raise ValueError("cannot merge samplers over different schemas")
+        self.values = np.concatenate([self.values, other.values], axis=0)
+        self.prio = np.concatenate([self.prio, other.prio])
+        if self.prio.size > self.k:
+            self._compact()
+        return self
+
+    # -- finalize ----------------------------------------------------------
+
+    def columns(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-column view shaped like the device sketch produced:
+        (values (n_num, k) float64, kept (n_num, k) bool) with kept
+        marking finite sampled values."""
+        out = np.full((self.n_num, self.k), np.nan, dtype=np.float64)
+        size = min(self.values.shape[0], self.k)
+        if size:
+            out[:, :size] = self.values[:size].T
+        return out, np.isfinite(out)
+
+    def quantiles(self, probes: Sequence[float]) -> np.ndarray:
+        """(n_probes, n_num) float64 linear-interpolated quantiles of each
+        column's finite sample; NaN where a column kept nothing."""
+        vals, kept = self.columns()
+        out = np.full((len(probes), self.n_num), np.nan)
+        for c in range(self.n_num):
+            v = vals[c, kept[c]]
+            if v.size:
+                out[:, c] = np.quantile(v, list(probes))
+        return out
+
+    def cdf_grid(self, n_grid: int) -> np.ndarray:
+        """(n_num, n_grid) float32 per-column sample quantiles at probes
+        (j+0.5)/n_grid — the rank grid for the pallas Spearman kernel
+        (kernels/fused.spearman_update).  Columns with no finite sample
+        are all +inf (their ranks collapse to 0 and the correlation
+        finalizes to NaN via the zero-variance guard)."""
+        vals, kept = self.columns()
+        probes = (np.arange(n_grid) + 0.5) / n_grid
+        out = np.full((self.n_num, n_grid), np.inf, dtype=np.float32)
+        for c in range(self.n_num):
+            v = vals[c, kept[c]]
+            if v.size:
+                out[c] = np.quantile(v, probes).astype(np.float32)
+        return out
+
+    def sorted_padded(self) -> Tuple[np.ndarray, np.ndarray]:
+        """For the Spearman rank-CDF pass: per-column ascending finite
+        sample padded with +inf to k, plus kept counts."""
+        vals, kept = self.columns()
+        padded = np.where(kept, vals, np.inf).astype(np.float32)
+        return np.sort(padded, axis=1), kept.sum(axis=1).astype(np.int64)

@@ -1,0 +1,19 @@
+"""Fused TPU statistics kernels.
+
+Each kernel module defines a fixed-shape state pytree and four operations:
+
+    init(...)            -> state            (the monoid identity)
+    update(state, batch) -> state            (fold one device-local batch in)
+    merge(a, b)          -> state            (commutative-monoid combine)
+    finalize(state)      -> host-side stats
+
+The merge law ``merge(s(A), s(B)) == s(A ∪ B)`` (within documented sketch
+bounds) is what makes the cross-device tree-reduce correct — the TPU
+analogue of Spark's partial-aggregate + shuffle-merge tree (SURVEY.md
+§2.3).  It is property-tested directly in tests/test_merge_laws.py.
+
+All updates are branchless, statically shaped, and written to live inside
+a single ``jit``-compiled step so XLA fuses the mask/center/reduce work of
+every kernel over one pass of the batch through HBM (SURVEY §3.5: "one
+XLA program, all columns at once").
+"""

@@ -1,0 +1,552 @@
+"""Fused pass-A pallas kernel: moments + pairwise-Pearson Gram in ONE read.
+
+Why this kernel exists: on TPU the profile scan is memory-bound, and the
+measured cost model of the target device makes every *separate* XLA
+reduction re-read the batch from HBM (each pass over a 64k x 200 f32
+batch ~ 12ms at the observed ~5 GB/s effective bandwidth, while the MXU
+sustains ~46 TFLOP/s).  The XLA formulation of pass A
+(kernels/moments.py + kernels/corr.py) issues ~12 reduction passes plus
+4 matmuls per batch; this kernel computes the SAME state update with a
+single streaming read of the batch:
+
+* VPU, per block: validity masks, centered values d and d², per-column
+  sums s1..s4, min/max over non-null values, finite min/max, and the
+  n/zeros/inf/missing counts — all accumulated in registers/VMEM;
+* MXU, per block: the pairwise-complete Gram blocks
+  ``[P|S1] = dᵀ·[d|m]`` and ``[S2;N] = [d²;m]ᵀ·m`` (corr.py semantics)
+  at HIGHEST precision, accumulated into VMEM-resident output blocks.
+
+Layout: the batch arrives exactly as the mesh ships it — ``xt`` is
+(cols, rows) so the kernel's lane axis is the row axis and NO transpose
+is materialized (an XLA transpose is a full extra HBM pass).  The grid
+iterates row tiles; output blocks have constant index maps so Mosaic
+keeps them VMEM-resident and writes them back once.
+
+Unlike the adaptive-shift XLA path, the fused kernel takes the centering
+``shift`` as an input: the backend estimates it host-side from a prefix
+of the first batch (any value near the data scale conditions the f32
+sums equally well), which also makes every device/batch share one shift
+so the collective merge's rebase becomes the identity.
+
+The XLA twin (``update_xla``) keeps CPU meshes and tests running; both
+paths produce the moments.py / corr.py state dicts, so merge laws,
+checkpointing and finalize are unchanged.  Equivalence is tested in
+interpreter mode and against the CPU oracle (tests/test_fused.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuprof.kernels import corr as kcorr
+from tpuprof.kernels import moments as kmoments
+
+Array = jnp.ndarray
+
+C_ALIGN = 8            # sublane-axis (column) padding multiple — the f32
+                       # min sublane tile; 128 alignment is only required
+                       # on the LANE axis, so typical column counts
+                       # (e.g. 200) need no padding copy at all
+# The narrow kernel holds the two (C, 2C) Gram blocks VMEM-resident plus
+# ~6 (2C, R) temporaries per block, so the row tile shrinks as columns
+# grow and the whole formulation stops fitting VMEM past ~512 columns
+# (empirical compile probe on v5e; PERF.md).  Wider tables switch to the
+# column-tiled kernel (below) up to MAX_FUSED_COLS_WIDE; MeshRunner
+# falls back to the XLA path beyond that.
+MAX_FUSED_COLS = 512
+MAX_FUSED_COLS_WIDE = 2048     # compile-verified on hardware; beyond
+                               # this the XLA path takes over
+R_TILE = 1024          # lane-axis (row) tile at narrow widths
+
+
+def _pick_r_tile(C: int) -> int:
+    if C <= 256:
+        return 1024
+    if C <= 384:
+        return 512
+    return 256
+
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _kernel(xt_ref, rv_ref, shift_ref, sums_ref, counts_ref,
+            gram1_ref, gram2_ref):
+    i = pl.program_id(0)
+    x = xt_ref[...]                       # (C, R) — columns are sublanes
+    rv = rv_ref[...] > 0                  # (1, R) bool
+    shift = shift_ref[...]                # (C, 1)
+
+    masks = _masks(x, rv, shift)
+    m, d, d2 = masks[3], masks[4], masks[5]
+
+    # MXU: contract the lane (row) axis of both operands
+    dm = jnp.concatenate([d, m], axis=0)            # (2C, R)
+    g1 = jax.lax.dot_general(d, dm, (((1,), (1,)), ((), ())),
+                             precision=_HI,
+                             preferred_element_type=jnp.float32)  # (C, 2C)
+    d2m = jnp.concatenate([d2, m], axis=0)          # (2C, R)
+    g2 = jax.lax.dot_general(d2m, m, (((1,), (1,)), ((), ())),
+                             precision=_HI,
+                             preferred_element_type=jnp.float32)  # (2C, C)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[...] = _stats_identity(sums_ref.shape)
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+        gram1_ref[...] = jnp.zeros_like(gram1_ref)
+        gram2_ref[...] = jnp.zeros_like(gram2_ref)
+
+    _accumulate_stats(sums_ref, counts_ref, x, rv, masks)
+    gram1_ref[...] += g1
+    gram2_ref[...] += g2
+
+
+def _masks(x, rv, shift):
+    """(isnan, notnull, finite, m, d, d2) for one (C, R) tile — the one
+    validity/centering convention shared by every pass-A kernel tier."""
+    isnan = jnp.isnan(x)
+    notnull = rv & ~isnan                 # non-null (±inf included)
+    finite = notnull & ~jnp.isinf(x)
+    m = finite.astype(jnp.float32)
+    d = jnp.where(finite, x - shift, 0.0)
+    return isnan, notnull, finite, m, d, d * d
+
+
+def _stats_identity(shape):
+    """Identity elements for the (C, 8) sums block: 0 for the additive
+    lanes, ±inf for min/max (lanes 4/6 min, 5/7 max) — built via iota
+    because pallas kernels cannot capture host constants."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    return jnp.where((lane == 4) | (lane == 6), jnp.inf,
+                     jnp.where((lane == 5) | (lane == 7),
+                               -jnp.inf, 0.0)).astype(jnp.float32)
+
+
+def _accumulate_stats(sums_ref, counts_ref, x, rv, masks) -> None:
+    """Fold one tile's per-column sums/min-max/counts into the (C, 8)
+    accumulator blocks (lane roles: 0-3 add, 4/6 min, 5/7 max — a
+    lane-mask select because slice-assign would lower to an unsupported
+    scatter)."""
+    isnan, notnull, finite, m, d, d2 = masks
+    s1 = jnp.sum(d, axis=1, keepdims=True)
+    s2 = jnp.sum(d2, axis=1, keepdims=True)
+    s3 = jnp.sum(d2 * d, axis=1, keepdims=True)
+    s4 = jnp.sum(d2 * d2, axis=1, keepdims=True)
+    minv = jnp.min(jnp.where(notnull, x, jnp.inf), axis=1, keepdims=True)
+    maxv = jnp.max(jnp.where(notnull, x, -jnp.inf), axis=1, keepdims=True)
+    fmin = jnp.min(jnp.where(finite, x, jnp.inf), axis=1, keepdims=True)
+    fmax = jnp.max(jnp.where(finite, x, -jnp.inf), axis=1, keepdims=True)
+    sums = jnp.concatenate([s1, s2, s3, s4, minv, maxv, fmin, fmax],
+                           axis=1)
+    acc = sums_ref[...]
+    lane = jax.lax.broadcasted_iota(jnp.int32, acc.shape, 1)
+    sums_ref[...] = jnp.where(
+        lane < 4, acc + sums,
+        jnp.where((lane == 4) | (lane == 6),
+                  jnp.minimum(acc, sums), jnp.maximum(acc, sums)))
+
+    i32 = jnp.int32
+    n = jnp.sum(finite.astype(i32), axis=1, keepdims=True)
+    nz = jnp.sum((notnull & (x == 0.0)).astype(i32), axis=1, keepdims=True)
+    ninf = jnp.sum((notnull & jnp.isinf(x)).astype(i32), axis=1,
+                   keepdims=True)
+    nmiss = jnp.sum((rv & isnan).astype(i32), axis=1, keepdims=True)
+    z = jnp.zeros_like(n)
+    counts_ref[...] += jnp.concatenate(
+        [n, nz, ninf, nmiss, z, z, z, z], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fused_tiles(xt: Array, row_valid: Array, shift: Array,
+                 interpret: bool = False):
+    cols, rows = xt.shape
+    cpad = -cols % C_ALIGN
+    C = cols + cpad
+    r_tile = _pick_r_tile(C)
+    rpad = -rows % r_tile
+    # row padding is marked invalid via rv; column padding rows are NaN
+    xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
+    rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
+    shift_p = jnp.pad(shift.astype(jnp.float32), (0, cpad))[:, None]
+    n_rt = (rows + rpad) // r_tile
+    out = pl.pallas_call(
+        _kernel,
+        grid=(n_rt,),
+        in_specs=[
+            pl.BlockSpec((C, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((1, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((C, 8), lambda i: (0, 0)),
+            pl.BlockSpec((C, 8), lambda i: (0, 0)),
+            pl.BlockSpec((C, 2 * C), lambda i: (0, 0)),
+            pl.BlockSpec((2 * C, C), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((C, 8), jnp.float32),
+            jax.ShapeDtypeStruct((C, 8), jnp.int32),
+            jax.ShapeDtypeStruct((C, 2 * C), jnp.float32),
+            jax.ShapeDtypeStruct((2 * C, C), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xt_p, rv_p, shift_p)
+    sums, counts, g1, g2 = out
+    return (sums[:cols], counts[:cols]) + _slice_grams(g1, g2, cols, C)
+
+
+# ---------------------------------------------------------------------------
+# Column-tiled pass A for wide tables
+# (MAX_FUSED_COLS < cols <= MAX_FUSED_COLS_WIDE)
+# ---------------------------------------------------------------------------
+#
+# The pairwise Gram is quadratic in columns, so past the narrow kernel's
+# VMEM limit the blocks must tile: grid (i, j, r) with rows fastest; each
+# (i, j) pair accumulates its (C_T, C_T) P/S1/S2/N output blocks across
+# row tiles on the MXU, and the per-column VPU stats ride the j == 0
+# visits so every value still feeds them exactly once.  Each row tile is
+# read 2·n_ct times (once per partner tile) — at these widths the MXU
+# work is the bound, so the extra reads are covered.
+
+C_TILE_W = 256
+R_TILE_W = 512
+
+
+def _kernel_wide(xi_ref, xj_ref, rv_ref, shift_i_ref, shift_j_ref,
+                 sums_ref, counts_ref, p_ref, s1_ref, s2_ref, n_ref, *,
+                 skip_stats: bool = False):
+    j = pl.program_id(1)
+    r = pl.program_id(2)
+    rv = rv_ref[...] > 0                      # (1, R)
+
+    xi = xi_ref[...]                          # (C_T, R)
+    masks_i = _masks(xi, rv, shift_i_ref[...])
+    m_i, d_i, d2_i = masks_i[3], masks_i[4], masks_i[5]
+
+    xj = xj_ref[...]
+    _, _, _, m_j, d_j, _ = _masks(xj, rv, shift_j_ref[...])
+
+    dn = (((1,), (1,)), ((), ()))
+    p_blk = jax.lax.dot_general(d_i, d_j, dn, precision=_HI,
+                                preferred_element_type=jnp.float32)
+    s1_blk = jax.lax.dot_general(d_i, m_j, dn, precision=_HI,
+                                 preferred_element_type=jnp.float32)
+    s2_blk = jax.lax.dot_general(d2_i, m_j, dn, precision=_HI,
+                                 preferred_element_type=jnp.float32)
+    n_blk = jax.lax.dot_general(m_i, m_j, dn, precision=_HI,
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(r == 0)
+    def _init_grams():
+        p_ref[...] = jnp.zeros_like(p_ref)
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+        n_ref[...] = jnp.zeros_like(n_ref)
+
+    p_ref[...] += p_blk
+    s1_ref[...] += s1_blk
+    s2_ref[...] += s2_blk
+    n_ref[...] += n_blk
+
+    # per-column stats: once per value — only on the j == 0 sweep
+    # (skip_stats callers only want the Gram, e.g. the Spearman rank
+    # pass; the blocks are still initialized so the discarded outputs
+    # are defined)
+    @pl.when((j == 0) & (r == 0))
+    def _init_stats():
+        sums_ref[...] = _stats_identity(sums_ref.shape)
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+
+    if not skip_stats:
+        @pl.when(j == 0)
+        def _stats():
+            _accumulate_stats(sums_ref, counts_ref, xi, rv, masks_i)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "skip_stats"))
+def _fused_tiles_wide(xt: Array, row_valid: Array, shift: Array,
+                      interpret: bool = False, skip_stats: bool = False):
+    cols, rows = xt.shape
+    cpad = -cols % C_TILE_W
+    rpad = -rows % R_TILE_W
+    xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
+    rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
+    shift_p = jnp.pad(shift.astype(jnp.float32), (0, cpad))[:, None]
+    C = cols + cpad
+    n_ct = C // C_TILE_W
+    n_rt = (rows + rpad) // R_TILE_W
+    outs = pl.pallas_call(
+        functools.partial(_kernel_wide, skip_stats=skip_stats),
+        grid=(n_ct, n_ct, n_rt),
+        in_specs=[
+            pl.BlockSpec((C_TILE_W, R_TILE_W), lambda i, j, r: (i, r)),
+            pl.BlockSpec((C_TILE_W, R_TILE_W), lambda i, j, r: (j, r)),
+            pl.BlockSpec((1, R_TILE_W), lambda i, j, r: (0, r)),
+            pl.BlockSpec((C_TILE_W, 1), lambda i, j, r: (i, 0)),
+            pl.BlockSpec((C_TILE_W, 1), lambda i, j, r: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((C_TILE_W, 8), lambda i, j, r: (i, 0)),
+            pl.BlockSpec((C_TILE_W, 8), lambda i, j, r: (i, 0)),
+            pl.BlockSpec((C_TILE_W, C_TILE_W), lambda i, j, r: (i, j)),
+            pl.BlockSpec((C_TILE_W, C_TILE_W), lambda i, j, r: (i, j)),
+            pl.BlockSpec((C_TILE_W, C_TILE_W), lambda i, j, r: (i, j)),
+            pl.BlockSpec((C_TILE_W, C_TILE_W), lambda i, j, r: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((C, 8), jnp.float32),
+            jax.ShapeDtypeStruct((C, 8), jnp.int32),
+            jax.ShapeDtypeStruct((C, C), jnp.float32),
+            jax.ShapeDtypeStruct((C, C), jnp.float32),
+            jax.ShapeDtypeStruct((C, C), jnp.float32),
+            jax.ShapeDtypeStruct((C, C), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xt_p, xt_p, rv_p, shift_p, shift_p)
+    sums, counts, P, S1, S2, N = outs
+    return (sums[:cols], counts[:cols], P[:cols, :cols],
+            S1[:cols, :cols], S2[:cols, :cols], N[:cols, :cols])
+
+
+def _slice_grams(g1, g2, cols: int, C: int):
+    """(P, S1, S2, N) from the two stacked Gram outputs — the one block
+    convention shared by the Pearson and Spearman kernels."""
+    return (g1[:cols, :cols], g1[:cols, C:C + cols],
+            g2[:cols, :cols], g2[C:C + cols, :cols])
+
+
+def _fold_corr(co: Dict[str, Array], P: Array, S1: Array, S2: Array,
+               N: Array) -> Dict[str, Array]:
+    """Add one batch's Gram blocks into a corr.py state (shift must be
+    pre-set; counts round exactly — batch rows < 2²⁴ in f32)."""
+    return {
+        "shift": co["shift"],
+        "set": jnp.ones((), dtype=jnp.int32),
+        "N": co["N"] + jnp.round(N).astype(jnp.int32),
+        "S1": co["S1"] + S1,
+        "S2": co["S2"] + S2,
+        "P": co["P"] + P,
+    }
+
+
+def update(mom: Dict[str, Array], co: Dict[str, Array], xt: Array,
+           row_valid: Array, interpret: bool = False
+           ) -> Tuple[Dict[str, Array], Dict[str, Array]]:
+    """Fold one batch into the moments.py + corr.py states with a single
+    pallas pass (column-tiled past MAX_FUSED_COLS).  Requires the
+    states' shifts to be pre-set (init with an explicit shift); ``xt``
+    is (cols, rows) as the mesh ships batches."""
+    tiles = _fused_tiles if xt.shape[0] <= MAX_FUSED_COLS \
+        else _fused_tiles_wide
+    sums, counts, P, S1, S2, N = tiles(
+        xt, row_valid, mom["shift"], interpret=interpret)
+    mom_out = {
+        "shift": mom["shift"],
+        "n": mom["n"] + counts[:, 0],
+        "s1": mom["s1"] + sums[:, 0],
+        "s2": mom["s2"] + sums[:, 1],
+        "s3": mom["s3"] + sums[:, 2],
+        "s4": mom["s4"] + sums[:, 3],
+        "minv": jnp.minimum(mom["minv"], sums[:, 4]),
+        "maxv": jnp.maximum(mom["maxv"], sums[:, 5]),
+        "fmin": jnp.minimum(mom["fmin"], sums[:, 6]),
+        "fmax": jnp.maximum(mom["fmax"], sums[:, 7]),
+        "n_zeros": mom["n_zeros"] + counts[:, 1],
+        "n_inf": mom["n_inf"] + counts[:, 2],
+        "n_missing": mom["n_missing"] + counts[:, 3],
+    }
+    return mom_out, _fold_corr(co, P, S1, S2, N)
+
+
+def update_xla(mom: Dict[str, Array], co: Dict[str, Array], xt: Array,
+               row_valid: Array) -> Tuple[Dict[str, Array], Dict[str, Array]]:
+    """The XLA twin (CPU meshes, fallback): the pre-existing per-kernel
+    formulation, same state contract."""
+    x = xt.T
+    return (kmoments.update(mom, x, row_valid),
+            kcorr.update(co, x, row_valid))
+
+
+# ---------------------------------------------------------------------------
+# Spearman grid-rank kernel
+# ---------------------------------------------------------------------------
+#
+# The exact searchsorted rank transform (runtime/mesh.local_step_spear)
+# measured ~4 s/batch on the target device — XLA lowers the per-column
+# binary search to serialized gathers.  The pallas formulation ranks each
+# value against a per-column G-point CDF grid (sample quantiles at
+# probes (j+0.5)/G, host-derived from the pass-A row sample) with dense
+# VPU compares — rank = (#grid<v + #grid<=v) / 2G — and feeds the ranks
+# straight into the same pairwise-complete Gram the Pearson path uses,
+# all in one read of the batch.  Rank resolution is 1/G on top of the
+# sample's O(1/sqrt(K)) CDF error (documented approximation tier; the
+# CPU-mesh path keeps exact average-tie ranks).  Ranks live in [0,1], so
+# a constant shift of 0.5 conditions the f32 Gram perfectly.
+
+def _spear_kernel(xt_ref, rv_ref, grid_ref, gram1_ref, gram2_ref, *,
+                  n_grid: int):
+    i = pl.program_id(0)
+    x = xt_ref[...]                       # (C, R)
+    rv = rv_ref[...] > 0                  # (1, R)
+    finite = rv & jnp.isfinite(x)
+
+    rank = _grid_ranks(x, grid_ref, n_grid)
+
+    m = finite.astype(jnp.float32)
+    d = jnp.where(finite, rank - 0.5, 0.0)
+    dm = jnp.concatenate([d, m], axis=0)
+    g1 = jax.lax.dot_general(d, dm, (((1,), (1,)), ((), ())),
+                             precision=_HI,
+                             preferred_element_type=jnp.float32)
+    d2m = jnp.concatenate([d * d, m], axis=0)
+    g2 = jax.lax.dot_general(d2m, m, (((1,), (1,)), ((), ())),
+                             precision=_HI,
+                             preferred_element_type=jnp.float32)
+
+    @pl.when(i == 0)
+    def _init():
+        gram1_ref[...] = jnp.zeros_like(gram1_ref)
+        gram2_ref[...] = jnp.zeros_like(gram2_ref)
+
+    gram1_ref[...] += g1
+    gram2_ref[...] += g2
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _spear_tiles(xt: Array, row_valid: Array, grid: Array,
+                 interpret: bool = False):
+    cols, rows = xt.shape
+    n_grid = grid.shape[1]
+    cpad = -cols % C_ALIGN
+    C = cols + cpad
+    r_tile = _pick_r_tile(C)
+    rpad = -rows % r_tile
+    xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
+    rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
+    grid_p = jnp.pad(grid.astype(jnp.float32), ((0, cpad), (0, 0)),
+                     constant_values=jnp.inf)
+    n_rt = (rows + rpad) // r_tile
+    g1, g2 = pl.pallas_call(
+        functools.partial(_spear_kernel, n_grid=n_grid),
+        grid=(n_rt,),
+        in_specs=[
+            pl.BlockSpec((C, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((1, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((C, n_grid), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((C, 2 * C), lambda i: (0, 0)),
+            pl.BlockSpec((2 * C, C), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((C, 2 * C), jnp.float32),
+            jax.ShapeDtypeStruct((2 * C, C), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xt_p, rv_p, grid_p)
+    return _slice_grams(g1, g2, cols, C)
+
+
+def _rank_kernel(xt_ref, rv_ref, grid_ref, out_ref, *, n_grid: int):
+    """Materialize grid ranks for one row tile: rank in [0,1] where the
+    value is finite, NaN elsewhere (the wide tier's stage 1 — the
+    VMEM-resident single-pass formulation does not fit past
+    MAX_FUSED_COLS, so ranks round-trip HBM and stage 2 reuses the
+    column-tiled Gram kernel)."""
+    x = xt_ref[...]
+    rv = rv_ref[...] > 0
+    finite = rv & jnp.isfinite(x)
+    rank = _grid_ranks(x, grid_ref, n_grid)
+    out_ref[...] = jnp.where(finite, rank, jnp.nan)
+
+
+def _grid_ranks(x, grid_ref, n_grid: int):
+    """(#grid < x + #grid <= x) / 2G — the unrolled compare loop.  The
+    compiler's scoped-VMEM demand for this loop scales with the x tile
+    area TIMES the grid size (each (C, 1) point slice occupies a full
+    128-lane-padded tile), so callers must keep the tile small enough:
+    compile-probed on v5e, (256, 128) tiles hold at G=256 where
+    (256, 512) overflow (tests/hardware probe; see _rank_tiles)."""
+    lt = jnp.zeros_like(x)
+    le = jnp.zeros_like(x)
+    for j in range(n_grid):
+        g = grid_ref[:, j:j + 1]
+        lt += (g < x).astype(jnp.float32)
+        le += (g <= x).astype(jnp.float32)
+    return (lt + le) * (0.5 / n_grid)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _rank_tiles(xt: Array, row_valid: Array, grid: Array,
+                interpret: bool = False) -> Array:
+    cols, rows = xt.shape
+    n_grid = grid.shape[1]
+    cpad = -cols % C_TILE_W           # column-tiled like the wide Gram
+    C = cols + cpad
+    r_tile = 128                      # see _grid_ranks: scoped VMEM for
+    rpad = -rows % r_tile             # the compare loop scales with
+                                      # tile-area x G; 128 lanes hold
+    xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
+    rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
+    grid_p = jnp.pad(grid.astype(jnp.float32), ((0, cpad), (0, 0)),
+                     constant_values=jnp.inf)
+    n_ct = C // C_TILE_W
+    n_rt = (rows + rpad) // r_tile
+    ranks = pl.pallas_call(
+        functools.partial(_rank_kernel, n_grid=n_grid),
+        grid=(n_ct, n_rt),
+        in_specs=[
+            pl.BlockSpec((C_TILE_W, r_tile), lambda c, i: (c, i)),
+            pl.BlockSpec((1, r_tile), lambda c, i: (0, i)),
+            pl.BlockSpec((C_TILE_W, n_grid), lambda c, i: (c, 0)),
+        ],
+        out_specs=pl.BlockSpec((C_TILE_W, r_tile), lambda c, i: (c, i)),
+        out_shape=jax.ShapeDtypeStruct((C, rows + rpad), jnp.float32),
+        interpret=interpret,
+    )(xt_p, rv_p, grid_p)
+    return ranks[:cols, :rows]
+
+
+def spearman_update(co: Dict[str, Array], xt: Array, row_valid: Array,
+                    grid: Array, interpret: bool = False
+                    ) -> Dict[str, Array]:
+    """Fold one batch of grid ranks into a corr.py state (whose shift
+    must be the constant 0.5 — ranks are in [0,1]) — the narrow
+    single-pass kernel.  Wider tables run rank_transform and
+    spearman_update_wide as TWO programs (mesh runtime dispatches them
+    separately: back-to-back pallas calls in one XLA module trip the
+    compiler's scoped-VMEM accounting)."""
+    P, S1, S2, N = _spear_tiles(xt, row_valid, grid, interpret=interpret)
+    return _fold_corr(co, P, S1, S2, N)
+
+
+def rank_transform(xt: Array, row_valid: Array, grid: Array,
+                   interpret: bool = False) -> Array:
+    """Stage 1 of the wide Spearman tier: (cols, rows) grid ranks in
+    [0,1], NaN where the value is non-finite."""
+    return _rank_tiles(xt, row_valid, grid, interpret=interpret)
+
+
+def spearman_update_wide(co: Dict[str, Array], ranks_t: Array,
+                         row_valid: Array, interpret: bool = False
+                         ) -> Dict[str, Array]:
+    """Stage 2 of the wide Spearman tier: the column-tiled Gram over the
+    rank matrix (the kernel's per-column stats sweep is skipped)."""
+    half = jnp.full((ranks_t.shape[0],), 0.5, dtype=jnp.float32)
+    _, _, P, S1, S2, N = _fused_tiles_wide(ranks_t, row_valid, half,
+                                           interpret=interpret,
+                                           skip_stats=True)
+    return _fold_corr(co, P, S1, S2, N)
+
+
+# the wide rank kernel's tile budget is calibrated for G <= 256 (see
+# _grid_ranks/_rank_tiles); the backend clamps the grid it builds for
+# the wide tier to this
+MAX_WIDE_SPEAR_GRID = 256

@@ -1,0 +1,74 @@
+"""Fixed-bin histograms for all numeric columns in one scatter-add.
+
+Replaces the reference's per-column RDD ``histogram()`` jobs (SURVEY.md
+§2.2) with a single flattened segment scatter-add over (cols × bins)
+counters.  Runs in pass B, once the exact finite min/max per column are
+known from pass A — reproducing np.histogram semantics exactly (right
+edge of the last bin inclusive via the clip).
+
+Also accumulates Σ|x − mean| per column (the oracle's MAD needs the pass-A
+mean), folding the second statistic into the same read of the batch.
+
+Counts are int32: exact to 2.1B rows per bin — beyond the 1B-row target.
+Merge is elementwise addition.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+HistState = Dict[str, Array]
+
+
+def init(n_cols: int, bins: int) -> HistState:
+    return {
+        "counts": jnp.zeros((n_cols, bins), dtype=jnp.int32),
+        "abs_dev": jnp.zeros((n_cols,), dtype=jnp.float32),
+    }
+
+
+def update(state: HistState, x: Array, row_valid: Array,
+           lo: Array, hi: Array, mean: Array) -> HistState:
+    """``lo``/``hi``: (cols,) finite min/max from pass A; ``mean``: (cols,)
+    pass-A means for the MAD accumulation."""
+    n_cols, bins = state["counts"].shape
+    finite = row_valid[:, None] & jnp.isfinite(x)
+    width = jnp.maximum(hi - lo, 1e-30)[None, :]
+    idx = jnp.floor((x - lo[None, :]) / width * bins)
+    idx = jnp.clip(idx, 0, bins - 1).astype(jnp.int32)
+    col_ids = jnp.arange(n_cols, dtype=jnp.int32)[None, :]
+    flat_ids = jnp.where(finite, col_ids * bins + idx, n_cols * bins)
+    flat = jnp.zeros((n_cols * bins + 1,), dtype=jnp.int32)
+    flat = flat.at[flat_ids.reshape(-1)].add(1)
+    abs_dev = jnp.where(finite, jnp.abs(x - mean[None, :]), 0.0).sum(axis=0)
+    return {
+        "counts": state["counts"] + flat[: n_cols * bins].reshape(n_cols, bins),
+        "abs_dev": state["abs_dev"] + abs_dev,
+    }
+
+
+def merge(a: HistState, b: HistState) -> HistState:
+    return {"counts": a["counts"] + b["counts"],
+            "abs_dev": a["abs_dev"] + b["abs_dev"]}
+
+
+def finalize(state, lo, hi, n, bins: int) -> Tuple["object", "object"]:
+    """Host-side: (per-column (counts, edges) histograms, MAD array)."""
+    import numpy as np
+
+    counts = np.asarray(state["counts"]).astype(np.int64)
+    abs_dev = np.asarray(state["abs_dev"], dtype=np.float64)
+    n = np.asarray(n, dtype=np.float64)
+    hists = []
+    for c in range(counts.shape[0]):
+        if np.isfinite(lo[c]) and np.isfinite(hi[c]):
+            edges = np.linspace(lo[c], hi[c], bins + 1)
+            hists.append((counts[c], edges))
+        else:
+            hists.append(None)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        mad = np.where(n > 0, abs_dev / np.maximum(n, 1.0), np.nan)
+    return hists, mad

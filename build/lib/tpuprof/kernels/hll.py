@@ -1,0 +1,144 @@
+"""HyperLogLog cardinality registers on TPU.
+
+The reference's distinct counts are ``countDistinct`` /
+``approx_count_distinct`` Spark jobs (HLL++ inside Spark, one job per
+column — SURVEY.md §2.2).  Here: one (cols, 2^p) int32 register plane for
+ALL columns at once, updated per batch with a single flattened
+scatter-max, merged across devices with an elementwise ``max`` (the
+canonical mergeable sketch — SURVEY §2.3).
+
+Hashing happens host-side during Arrow decode (TPUs don't do strings —
+SURVEY §7.2), and the device receives PACKED observations: one uint16
+per cell holding ``(register_index << 5) | rho`` with 0 as the
+null/padding marker.  Packing matters because host→device bandwidth is
+the profile scan's scarcest resource — 2 bytes/cell instead of the 9
+(two u32 hash lanes + validity byte) an unpacked design ships, with no
+information loss: idx needs p ≤ 11 bits and ρ is capped at 31 (register
+saturation at ρ=31 bounds estimates only beyond ~2^41 distincts).
+
+Standard error ≈ 1.04/√(2^p): ~2.3% at the default p=11 — matching the
+reference's approx_count_distinct default accuracy class.  Small
+cardinalities use linear counting (exact in practice), so CONST/UNIQUE
+classification stays reliable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+RHO_BITS = 5
+RHO_MAX = 31          # 5-bit field; 0 is the invalid marker
+MAX_PRECISION = 11    # idx (11) + rho (5) = 16 bits
+
+
+def init(n_cols: int, precision: int) -> Array:
+    return jnp.zeros((n_cols, 1 << precision), dtype=jnp.int32)
+
+
+def pack(h64: np.ndarray, valid: np.ndarray, precision: int) -> np.ndarray:
+    """Host-side: 64-bit hashes -> packed uint16 observations.
+
+    idx = top ``precision`` bits; ρ = clz of the next 32 bits + 1
+    (capped at 31, floored at 1 so packed == 0 iff invalid)."""
+    if precision > MAX_PRECISION:
+        raise ValueError(f"hll precision > {MAX_PRECISION} cannot pack "
+                         f"into uint16")
+    idx = (h64 >> np.uint64(64 - precision)).astype(np.uint32)
+    b = ((h64 >> np.uint64(64 - precision - 32))
+         & np.uint64(0xFFFFFFFF)).astype(np.uint64)
+    # clz32 via exact f64 log2 (uint32 is exact in f64)
+    bl = np.floor(np.log2((b | np.uint64(1)).astype(np.float64))).astype(
+        np.uint32) + 1
+    rho = np.clip(33 - bl, 1, RHO_MAX).astype(np.uint32)
+    packed = ((idx << RHO_BITS) | rho).astype(np.uint16)
+    return np.where(valid, packed, np.uint16(0))
+
+
+def update(regs: Array, packed: Array) -> Array:
+    """``packed``: (rows, cols) uint16 observations (0 = null/padding).
+
+    The packing precision is implied by ``regs.shape[1]``; observations
+    whose index exceeds the register count (a batch packed with a larger
+    precision than the registers were allocated for) are routed to the
+    spill slot rather than scattered into neighboring columns."""
+    n_cols, m = regs.shape
+    if n_cols == 0 or packed.shape[1] == 0:
+        # empty observation plane: hash columns absent, or the fold
+        # happens host-side this run (kernels/hll.HostRegisters) and the
+        # plane was never shipped
+        return regs
+    p32 = packed.astype(jnp.int32)
+    idx = p32 >> RHO_BITS
+    rho = p32 & RHO_MAX
+    valid = (p32 != 0) & (idx < m)
+    col_ids = jnp.arange(n_cols, dtype=jnp.int32)[None, :]
+    flat_ids = jnp.where(valid, col_ids * m + idx, n_cols * m)  # spill slot
+    flat = jnp.zeros((n_cols * m + 1,), dtype=jnp.int32)
+    flat = flat.at[flat_ids.reshape(-1)].max(rho.reshape(-1))
+    return jnp.maximum(regs, flat[: n_cols * m].reshape(n_cols, m))
+
+
+def merge(a: Array, b: Array) -> Array:
+    return jnp.maximum(a, b)
+
+
+class HostRegisters:
+    """Host-side HLL registers, updated while the packed observations are
+    still in host RAM (via the native C++ fold — tpuprof/native).
+
+    Exists because on the target device the register scatter-max is the
+    XLA op that serializes (measured ~37ms/batch at 24 hash columns),
+    and the observations originate host-side anyway (hashing happens at
+    Arrow decode, SURVEY §7.2).  With host registers the packed plane is
+    never shipped to the device at all.  Register contents are
+    BIT-IDENTICAL to the device path — same packed format, same max
+    fold — so estimates, checkpoints and merges are interchangeable.
+
+    ``update`` uses the native library when available and a numpy
+    fallback otherwise (slow but correct).  In production the fallback
+    is defensive only: both the backend and the streaming profiler gate
+    host registers on ``native.available()``, and checkpoint restore
+    separately rejects native/pandas hash mismatches (hashes, not
+    register folds, are what differ between the implementations)."""
+
+    def __init__(self, n_cols: int, precision: int):
+        self.regs = np.zeros((n_cols, 1 << precision), dtype=np.int32)
+
+    def update(self, packed: np.ndarray, nrows: int) -> None:
+        from tpuprof import native
+        obs = packed[:nrows]
+        if obs.size == 0:
+            return
+        if not native.hll_update(self.regs, obs):
+            p32 = obs.astype(np.int32)
+            idx = p32 >> RHO_BITS
+            rho = p32 & RHO_MAX
+            m = self.regs.shape[1]
+            for c in range(self.regs.shape[0]):
+                ok = (p32[:, c] != 0) & (idx[:, c] < m)
+                np.maximum.at(self.regs[c], idx[ok, c], rho[ok, c])
+
+    def merge(self, other: "HostRegisters") -> "HostRegisters":
+        np.maximum(self.regs, other.regs, out=self.regs)
+        return self
+
+
+def finalize(regs) -> "object":
+    """Host-side HLL estimator with the standard small-range (linear
+    counting) correction; float64 estimates per column."""
+    import numpy as np
+
+    regs = np.asarray(regs)
+    n_cols, m = regs.shape
+    alpha = {16: 0.673, 32: 0.697, 64: 0.709}.get(
+        m, 0.7213 / (1.0 + 1.079 / m))
+    with np.errstate(divide="ignore"):
+        raw = alpha * m * m / np.sum(np.exp2(-regs.astype(np.float64)), axis=1)
+    zeros = (regs == 0).sum(axis=1)
+    linear = np.where(zeros > 0, m * np.log(m / np.maximum(zeros, 1)), raw)
+    est = np.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)
+    return est

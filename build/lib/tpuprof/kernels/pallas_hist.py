@@ -1,0 +1,133 @@
+"""Pallas TPU kernel: all-column fixed-bin histograms + exact-MAD sums.
+
+Why a custom kernel: XLA lowers the scatter-add in kernels/histogram.py
+to a serialized per-element scatter on TPU — the one op in the profile
+scan that doesn't vectorize.  Binning is really a *dense* computation:
+for bins ≤ ~128, comparing every element against every bin id is only
+``bins`` VPU passes over the tile, with all accumulation in registers/
+VMEM — no scatter at all.  The MAD numerator Σ|x−mean| rides the same
+read (a separate XLA reduction measured as expensive as the histogram
+itself on the target device — PERF.md).
+
+Layout (per /opt/skills/guides/pallas_guide.md tiling rules, matching
+kernels/fused.py): the batch arrives as the mesh ships it — ``xt`` is
+(cols, rows), columns on the sublane axis (8-aligned for f32, so
+typical column counts need no padding copy), rows on the lane axis,
+grid over row tiles; all reductions run along lanes.  Output blocks
+have constant index maps so Mosaic keeps them VMEM-resident across the
+grid and writes them back once.  ``row_valid`` masks padding in-kernel
+(no NaN-masking pre-pass over the batch).
+
+The kernel is exact (same clip semantics as the XLA path) and is tested
+in interpreter mode on CPU against both numpy and the scatter version
+(tests/test_pallas.py); the mesh runtime enables it on real TPU only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+C_ALIGN = 8             # sublane-axis (column) alignment, f32
+MAX_BINS = 128
+# ~5 (C, R) f32/int32 temporaries live per block; the row tile shrinks
+# with width to stay inside VMEM (empirical compile probe on v5e), and
+# the mesh runtime falls back to the XLA scatter beyond MAX_HIST_COLS
+MAX_HIST_COLS = 1024
+R_TILE = 1024           # lane-axis (row) tile at narrow widths
+
+
+def _pick_r_tile(C: int) -> int:
+    return 1024 if C <= 512 else 256
+
+
+def _hist_kernel(xt_ref, rv_ref, lo_ref, scale_ref, mean_ref, out_ref,
+                 dev_ref, *, nbins: int):
+    i = pl.program_id(0)
+    x = xt_ref[...]                           # (C, R)
+    rv = rv_ref[...] > 0                      # (1, R)
+    lo = lo_ref[...]                          # (C, 1)
+    scale = scale_ref[...]                    # (C, 1)
+    mean = mean_ref[...]                      # (C, 1)
+    finite = rv & jnp.isfinite(x)
+    idx = jnp.floor((x - lo) * scale)
+    idx = jnp.clip(idx, 0, nbins - 1).astype(jnp.int32)
+    idx = jnp.where(finite, idx, -1)          # -1 never matches a bin id
+
+    # dense bin counting: one vectorized compare+lane-reduce per bin
+    counts = jnp.concatenate(
+        [jnp.sum((idx == b).astype(jnp.int32), axis=1, keepdims=True)
+         for b in range(nbins)], axis=1)      # (C, nbins)
+
+    dev = jnp.sum(jnp.where(finite, jnp.abs(x - mean), 0.0),
+                  axis=1, keepdims=True)      # (C, 1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+        dev_ref[...] = jnp.zeros_like(dev_ref)
+
+    out_ref[...] += counts
+    dev_ref[...] += dev
+
+
+@functools.partial(jax.jit, static_argnames=("nbins", "interpret"))
+def histogram_tiles(xt: jnp.ndarray, row_valid: jnp.ndarray,
+                    lo: jnp.ndarray, hi: jnp.ndarray, mean: jnp.ndarray,
+                    nbins: int, interpret: bool = False):
+    """(cols, rows) f32 (NaN = skip; padding rows via ``row_valid``) →
+    ((cols, nbins) int32 counts, (cols,) f32 Σ|x−mean|).
+
+    ``lo``/``hi`` are per-column finite ranges (pass-A min/max); values
+    land in ``clip(floor((x-lo)/(hi-lo)*nbins), 0, nbins-1)`` — identical
+    semantics to kernels/histogram.py and np.histogram's inclusive last
+    edge.  ``mean`` is the pass-A mean feeding the exact-MAD numerator."""
+    if nbins > MAX_BINS:
+        raise ValueError(f"pallas histogram supports bins <= {MAX_BINS}")
+    cols, rows = xt.shape
+    cpad = -cols % C_ALIGN
+    C = cols + cpad
+    r_tile = _pick_r_tile(C)
+    rpad = -rows % r_tile
+    xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
+    rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
+    lo_p = jnp.pad(lo.astype(jnp.float32), (0, cpad))[:, None]
+    width = jnp.maximum(hi - lo, 1e-30).astype(jnp.float32)
+    scale_p = jnp.pad(nbins / width, (0, cpad))[:, None]
+    mean_p = jnp.pad(mean.astype(jnp.float32), (0, cpad))[:, None]
+
+    n_rt = (rows + rpad) // r_tile
+    counts, dev = pl.pallas_call(
+        functools.partial(_hist_kernel, nbins=nbins),
+        grid=(n_rt,),
+        in_specs=[
+            pl.BlockSpec((C, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((1, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((C, nbins), lambda i: (0, 0)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((C, nbins), jnp.int32),
+            jax.ShapeDtypeStruct((C, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xt_p, rv_p, lo_p, scale_p, mean_p)
+    return counts[:cols], dev[:cols, 0]
+
+
+def histogram_batch(xt, row_valid, lo, hi, mean, nbins: int,
+                    interpret: bool = False):
+    """Batch entry point matching kernels/histogram.update semantics;
+    ``xt`` is (cols, rows) as the mesh ships batches."""
+    return histogram_tiles(xt, row_valid, lo, hi, mean, nbins,
+                           interpret=interpret)

@@ -1,0 +1,124 @@
+"""Mergeable quantile sketch: fixed-shape bottom-k priority sampling.
+
+The reference's quantiles come from ``DataFrame.approxQuantile`` — one
+Greenwald-Khanna Spark job per numeric column (SURVEY.md §2.2).  The
+TPU-native replacement must be a *fixed-shape, mergeable* state so it can
+live inside one jit-compiled step and tree-reduce across devices.  KLL's
+data-dependent level compaction fights XLA's static-shape model, so per
+SURVEY §7.2 we use the sanctioned alternative with clean bounds:
+
+**Bottom-k (priority) sampling.**  Every element draws an i.i.d. uniform
+priority; the sketch keeps the K elements with the *highest* priority.
+Keeping the global top-K priorities over any partition of the stream is
+exactly a uniform random sample of size K without replacement — so
+
+    merge(sketch(A), sketch(B)) = concat + top-K  ≡  sketch(A ∪ B)
+
+holds *exactly in distribution* (the merge law, SURVEY §4.2), and
+quantiles of the sample have rank error O(sqrt(ln(1/δ)/K)) — ~1.6% at
+K=4096 — comparable to Spark's default approxQuantile accuracy.  When the
+column has n ≤ K values the sample is the whole column and quantiles are
+exact (the common case for test fixtures and small tables).
+
+Per-batch cost: one (cols, K + rows) top_k — the concat trick keeps it a
+single static-shape primitive XLA schedules well.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+SketchState = Dict[str, Array]
+
+_NEG = jnp.float32(-jnp.inf)
+
+
+def init(n_cols: int, k: int) -> SketchState:
+    return {
+        "values": jnp.zeros((n_cols, k), dtype=jnp.float32),
+        "prio": jnp.full((n_cols, k), _NEG, dtype=jnp.float32),
+    }
+
+
+def update(state: SketchState, x: Array, row_valid: Array,
+           key: Array, approx: bool = False) -> SketchState:
+    """Fold a batch in.  ``x``: (rows, cols) float32 NaN-for-missing;
+    non-finite values get priority −inf (quantiles are over finite values,
+    matching the oracle).
+
+    ``approx=True`` uses ``lax.approx_max_k`` (the TPU-optimized partial
+    reduction) instead of a full ``top_k``.  This is statistically safe
+    for THIS sketch: priorities are i.i.d. uniform and independent of the
+    values, so any selection rule driven purely by priorities — including
+    an approximate one that occasionally swaps in the (K+j)-th priority —
+    still yields an unbiased uniform sample.  The exact path remains the
+    default (and is always used for merges, which are only 2K wide).
+
+    Priorities are drawn per ROW and shared across columns: per column
+    the kept set is still the top-K priorities among that column's
+    finite rows — a uniform sample of its values — so every per-column
+    marginal (and the merge law) is unchanged; only cross-column
+    sampling independence is given up, which nothing downstream uses.
+    This cuts the PRNG work from rows x cols to rows (measured: the
+    threefry draw was the scan's single largest compute block at 200
+    columns)."""
+    rows, cols = x.shape
+    finite = row_valid[:, None] & jnp.isfinite(x)       # (rows, cols)
+    prio_row = jax.random.uniform(key, (rows,), dtype=jnp.float32)
+    prio = jnp.where(finite, prio_row[:, None], _NEG)
+    xt = jnp.where(finite, x, 0.0).T                    # (cols, rows)
+    cand_v = jnp.concatenate([state["values"], xt], axis=1)
+    cand_p = jnp.concatenate([state["prio"], prio.T], axis=1)
+    k = state["prio"].shape[1]
+    if approx:
+        top_p, idx = jax.lax.approx_max_k(cand_p, k)
+    else:
+        top_p, idx = jax.lax.top_k(cand_p, k)
+    top_v = jnp.take_along_axis(cand_v, idx, axis=1)
+    return {"values": top_v, "prio": top_p}
+
+
+def merge(a: SketchState, b: SketchState) -> SketchState:
+    k = a["prio"].shape[1]
+    cand_v = jnp.concatenate([a["values"], b["values"]], axis=1)
+    cand_p = jnp.concatenate([a["prio"], b["prio"]], axis=1)
+    top_p, idx = jax.lax.top_k(cand_p, k)
+    return {"values": jnp.take_along_axis(cand_v, idx, axis=1), "prio": top_p}
+
+
+def finalize(state, probes: Sequence[float]) -> "object":
+    """Host-side: per-column quantiles of the kept sample (numpy linear
+    interpolation, matching the oracle's np.quantile).  Returns
+    (n_probes, cols) float64 with NaN where a column kept no values."""
+    import numpy as np
+
+    values = np.asarray(state["values"], dtype=np.float64)
+    prio = np.asarray(state["prio"])
+    out = np.full((len(probes), values.shape[0]), np.nan)
+    for c in range(values.shape[0]):
+        kept = values[c, prio[c] > -np.inf]
+        if kept.size:
+            out[:, c] = np.quantile(kept, list(probes))
+    return out
+
+
+def sample_histogram(state, lo, hi, bins: int) -> "object":
+    """Streaming-mode fallback (single-pass, SURVEY §7.1 stage 6): scale
+    the uniform sample's histogram to the column's total count at assembly
+    time.  Pass-B exact histograms are preferred when the source is
+    rescannable."""
+    import numpy as np
+
+    values = np.asarray(state["values"], dtype=np.float64)
+    prio = np.asarray(state["prio"])
+    cols = values.shape[0]
+    counts = np.zeros((cols, bins), dtype=np.float64)
+    for c in range(cols):
+        kept = values[c, prio[c] > -np.inf]
+        if kept.size and np.isfinite(lo[c]) and hi[c] > lo[c]:
+            counts[c], _ = np.histogram(kept, bins=bins, range=(lo[c], hi[c]))
+    return counts

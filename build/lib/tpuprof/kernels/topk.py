@@ -1,0 +1,81 @@
+"""Top-k frequent values: host-side Misra-Gries summaries.
+
+The reference's value-count tables come from one exact
+``groupBy(col).count().orderBy(desc)`` Spark job per categorical column
+(SURVEY.md §2.2).  TPUs have no hash tables and no strings, so frequency
+tracking is deliberately a *host* responsibility (SURVEY §7.2 "Strings on
+TPU"): during Arrow decode each batch is dictionary-encoded anyway, and a
+Misra-Gries summary per column absorbs the per-batch counts at vectorized
+numpy speed.
+
+Guarantees (Agarwal et al., "Mergeable Summaries"): with capacity k, every
+kept count is an underestimate by at most n/k, any value with true
+frequency > n/k is retained, and the merge below (add counts, subtract the
+(k+1)-th largest, drop ≤0) preserves those bounds — so summaries built per
+fragment/host can be combined.  When a column's total distinct count never
+exceeds the capacity, counts are *exact*.
+
+Exactness parity with Spark's groupBy: pass B recounts the surviving
+candidates exactly (tpuprof/backends/tpu.py), so reported top-k rows are
+exact whenever the source is rescannable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+
+class MisraGries:
+    """One column's frequent-values summary (value -> count)."""
+
+    __slots__ = ("capacity", "counts", "offset", "overflowed")
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self.counts: Dict[object, int] = {}
+        self.offset = 0          # total decrement applied (error bound)
+        self.overflowed = False  # True once any eviction happened
+
+    def update_batch(self, values: np.ndarray, counts: np.ndarray) -> None:
+        """Fold pre-aggregated (unique values, counts) from one batch in."""
+        d = self.counts
+        for v, c in zip(values.tolist(), counts.tolist()):
+            d[v] = d.get(v, 0) + c
+        if len(d) > self.capacity:
+            self._compact()
+
+    def _compact(self) -> None:
+        self.overflowed = True
+        arr = np.fromiter(self.counts.values(), dtype=np.int64,
+                          count=len(self.counts))
+        # subtract the (capacity+1)-th largest count from everyone (the
+        # Misra-Gries decrement step, batched), drop the non-positive
+        kth = np.partition(arr, -(self.capacity + 1))[-(self.capacity + 1)]
+        self.offset += int(kth)
+        self.counts = {v: c - kth for v, c in self.counts.items() if c > kth}
+
+    def merge(self, other: "MisraGries") -> None:
+        for v, c in other.counts.items():
+            self.counts[v] = self.counts.get(v, 0) + c
+        self.offset += other.offset
+        self.overflowed |= other.overflowed
+        if len(self.counts) > self.capacity:
+            self._compact()
+
+    @property
+    def exact(self) -> bool:
+        """True when every stored count is the true frequency."""
+        return not self.overflowed
+
+    def top(self, k: int) -> List[Tuple[object, int]]:
+        items = sorted(self.counts.items(), key=lambda kv: -kv[1])[:k]
+        return [(v, int(c)) for v, c in items]
+
+    def distinct_count(self) -> Optional[int]:
+        """Exact distinct count, or None if the summary overflowed."""
+        return len(self.counts) if self.exact else None
+
+    def candidates(self) -> Iterable[object]:
+        return self.counts.keys()

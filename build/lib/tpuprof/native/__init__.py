@@ -1,0 +1,145 @@
+"""Native host-path acceleration (C++ via ctypes — SURVEY.md §2.3's
+"optional C++ extension ... if host-side Arrow decode/hash becomes the
+bottleneck").
+
+Compiled lazily with g++ on first use and cached beside the source; every
+entry point has a pure-Python/pandas fallback, and the choice is made
+ONCE per process so hashes stay consistent across batches (HLL registers
+from different batches must agree).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("tpuprof")
+
+_SRC = os.path.join(os.path.dirname(__file__), "hash.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_tpuprof_hash.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except (OSError, subprocess.SubprocessError) as exc:
+        logger.info("tpuprof native hash build failed (%s); using pandas "
+                    "fallback", exc)
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.tpuprof_hash_u64.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.tpuprof_hash_bytes.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_size_t]
+    lib.tpuprof_hll_update.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+        ctypes.c_ssize_t, ctypes.c_ssize_t, ctypes.c_void_p,
+        ctypes.c_size_t]
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+            _bind(lib)
+        except (OSError, AttributeError):
+            # a cached .so from an older source (mtime-preserving deploys)
+            # may predate a symbol: rebuild once from current source, and
+            # fall back cleanly if that still fails
+            try:
+                os.remove(so)
+                rebuilt = _build()
+                if rebuilt is None:
+                    return None
+                lib = ctypes.CDLL(rebuilt)
+                _bind(lib)
+            except (OSError, AttributeError) as exc:
+                logger.info("tpuprof native hash unusable (%s); using "
+                            "fallbacks", exc)
+                return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def hash_u64_array(bits: np.ndarray) -> Optional[np.ndarray]:
+    """Avalanche-hash raw 64-bit patterns; None if native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    bits = np.ascontiguousarray(bits, dtype=np.uint64)
+    out = np.empty(bits.shape, dtype=np.uint64)
+    lib.tpuprof_hash_u64(bits.ctypes.data, out.ctypes.data, bits.size)
+    return out
+
+
+def hll_update(regs: np.ndarray, packed: np.ndarray) -> bool:
+    """Fold a (rows, cols) uint16 packed-observation plane into
+    (cols, m) int32 HLL registers in place; False if native is
+    unavailable (caller falls back to the device scatter or numpy)."""
+    lib = _load()
+    if lib is None:
+        return False
+    assert regs.dtype == np.int32 and regs.flags.c_contiguous
+    packed = packed if packed.dtype == np.uint16 else \
+        packed.astype(np.uint16)
+    n_rows, n_cols = packed.shape
+    assert regs.shape[0] == n_cols
+    rs, cs = (s // packed.itemsize for s in packed.strides)
+    lib.tpuprof_hll_update(packed.ctypes.data, n_rows, n_cols, rs, cs,
+                           regs.ctypes.data, regs.shape[1])
+    return True
+
+
+def hash_string_dictionary(arr) -> Optional[np.ndarray]:
+    """xxHash64 an Arrow string array straight from its buffers (no Python
+    objects); None if native is unavailable or the layout doesn't apply."""
+    lib = _load()
+    if lib is None:
+        return None
+    import pyarrow as pa
+    try:
+        arr = arr.cast(pa.large_string())
+    except pa.ArrowInvalid:
+        return None
+    if arr.null_count or arr.offset:
+        arr = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
+    buffers = arr.buffers()           # [validity, offsets(int64), data]
+    if len(buffers) < 3 or buffers[2] is None:
+        return None
+    offsets = np.frombuffer(buffers[1], dtype=np.int64,
+                            count=len(arr) + 1 + arr.offset)
+    if arr.offset:
+        return None                   # sliced arrays: fall back
+    data = np.frombuffer(buffers[2], dtype=np.uint8)
+    out = np.empty(len(arr), dtype=np.uint64)
+    lib.tpuprof_hash_bytes(data.ctypes.data, offsets.ctypes.data,
+                           out.ctypes.data, len(arr))
+    return out

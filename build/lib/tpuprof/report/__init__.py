@@ -1,0 +1,17 @@
+"""Report rendering layer (L3).
+
+Reference: base.py render half + templates.py + formatters.py +
+templates/*.html (SURVEY.md §1, §2.1).  Consumes the stats dict contract
+and nothing else — it never knows which backend produced the numbers.
+
+Differences from the reference, by design:
+
+* Histograms are inline SVG fragments instead of matplotlib-PNG-base64
+  (the reference's driver-side hot spot, SURVEY §3.1) — smaller output,
+  zero image-library dependency, resolution independent.
+* CSS is self-contained (no Bootstrap-era external assets).
+"""
+
+from tpuprof.report.render import to_html, to_standalone_html
+
+__all__ = ["to_html", "to_standalone_html"]

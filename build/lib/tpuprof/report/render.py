@@ -1,0 +1,96 @@
+"""stats dict → HTML.
+
+Reference: base.to_html() + templates.template() (SURVEY.md §2.1): per-row-
+type template dispatch, freq-table and histogram fragment assembly, wrapped
+by base.html for ``to_file`` (SURVEY §3.2).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jinja2
+from markupsafe import Markup
+
+from tpuprof.config import ProfilerConfig
+from tpuprof.report import formatters, svg
+
+_TEMPLATE_DIR = os.path.join(os.path.dirname(__file__), "templates")
+
+
+def _alert_if(value, threshold) -> str:
+    return formatters.alert_class(value, threshold)
+
+
+def _abs_alert_if(value, threshold) -> str:
+    try:
+        return formatters.alert_class(abs(float(value)), threshold)
+    except (TypeError, ValueError):
+        return ""
+
+
+def _corr_cell(rho) -> str:
+    try:
+        return svg.corr_cell_style(float(rho))
+    except (TypeError, ValueError):
+        return ""
+
+
+def _env() -> jinja2.Environment:
+    env = jinja2.Environment(
+        loader=jinja2.FileSystemLoader(_TEMPLATE_DIR),
+        autoescape=jinja2.select_autoescape(["html"]),
+    )
+    env.filters.update({
+        "fmt": formatters.fmt_value,
+        "pct": formatters.fmt_percent,
+        "bytesize": formatters.fmt_bytesize,
+        "alert_if": _alert_if,
+        "abs_alert_if": _abs_alert_if,
+        "histogram_svg": lambda h: Markup(svg.histogram_svg(h)),
+        "mini_histogram_svg":
+            lambda h: Markup(svg.histogram_svg(h, mini=True)),
+        "freq_bar": lambda f: Markup(svg.bar_svg(f)),
+        "corr_cell": _corr_cell,
+    })
+    return env
+
+
+_ENV = None
+
+
+def _get_env() -> jinja2.Environment:
+    global _ENV
+    if _ENV is None:
+        _ENV = _env()
+    return _ENV
+
+
+def to_html(stats: Dict[str, Any], config: ProfilerConfig,
+            perf: str = "") -> str:
+    """Render the report fragment (reference: ProfileReport.html)."""
+    from tpuprof import __version__
+    template = _get_env().get_template("report.html")
+    return template.render(
+        table=stats["table"],
+        variables=stats["variables"],
+        freq=stats["freq"],
+        correlations=stats["correlations"],
+        messages=stats["messages"],
+        sample=stats.get("sample"),
+        config=config,
+        version=__version__,
+        perf=perf,
+    )
+
+
+def to_standalone_html(stats: Dict[str, Any], config: ProfilerConfig,
+                       title: str = "tpuprof report") -> str:
+    """Wrap the fragment with the standalone page shell (reference:
+    to_file's base.html wrapper, SURVEY §3.2)."""
+    from tpuprof import __version__
+    fragment = to_html(stats, config)
+    template = _get_env().get_template("base.html")
+    return template.render(
+        title=title, version=__version__, content=Markup(fragment)).lstrip()

@@ -1,0 +1,13 @@
+"""TPU runtime: device mesh, sharded stepping, collective sketch merge.
+
+The reference's distribution layer is Spark's driver→executor RPC +
+Netty shuffle, external to the repo (SURVEY.md §1 L0).  tpuprof's is
+jax.sharding: a 1-D ``data`` mesh, row-sharded batches via ``shard_map``,
+per-device sketch states, and one collective merge (psum/pmax/all_gather
+over ICI) at finalize (SURVEY §2.3, §5 'Distributed communication
+backend').
+"""
+
+from tpuprof.runtime.mesh import MeshRunner
+
+__all__ = ["MeshRunner"]

@@ -1,0 +1,100 @@
+"""Checkpoint / resume for profiling runs (SURVEY.md §5).
+
+The reference has nothing here — a profile is one-shot and Spark task
+retry is its only recovery story.  tpuprof's sketch states are small
+mergeable pytrees, so durability is almost free: serialize
+``(device state, host aggregators, batch cursor)`` every N batches;
+resume = load + continue streaming from the cursor.
+
+Format: a single ``.npz``-style numpy archive for the device pytree
+(flattened ``/``-joined key paths) + a pickled host blob (Misra-Gries
+dicts hold arbitrary python values — strings, timestamps).  Not a
+wire-portable format; it is a crash-recovery artifact, same machine
+class in and out.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+
+# v3: the quantile sample moved off-device (ingest/sample.RowSampler in
+# the host blob); the pass-A device state lost its "qs" and "step"
+# leaves.  v2 and earlier checkpoints neither restore nor merge
+# correctly, so they are rejected at load time.
+FORMAT_VERSION = 3
+
+
+def _flatten(tree: Any) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten(template: Any, flat: Dict[str, np.ndarray]) -> Any:
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        arr = flat[key]
+        if arr.shape != np.shape(leaf):
+            raise ValueError(
+                f"checkpoint leaf {key!r} has shape {arr.shape}, "
+                f"expected {np.shape(leaf)} — config/schema mismatch")
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save(path: str, state: Any, host_blob: Any, cursor: int,
+         meta: Dict[str, Any]) -> None:
+    """Write one atomic checkpoint file."""
+    flat = _flatten(jax.device_get(state))
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    payload = {
+        "format_version": FORMAT_VERSION,
+        "arrays_npz": buf.getvalue(),
+        "host_blob": host_blob,
+        "cursor": int(cursor),
+        "meta": meta,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
+    import os
+    os.replace(tmp, path)
+
+
+def load_payload(path: str) -> Dict[str, Any]:
+    """Read and version-check the raw checkpoint payload (one disk read;
+    materialize the device state separately with :func:`materialize`)."""
+    with open(path, "rb") as fh:
+        payload = pickle.load(fh)
+    if payload.get("format_version") != FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported checkpoint format {payload.get('format_version')}")
+    return payload
+
+
+def materialize(payload: Dict[str, Any], state_template: Any) -> Any:
+    """Decode the device pytree from a payload, validated against (and
+    shaped like) ``state_template``."""
+    with np.load(io.BytesIO(payload["arrays_npz"])) as npz:
+        flat = {k: npz[k] for k in npz.files}
+    return _unflatten(state_template, flat)
+
+
+def load(path: str, state_template: Any) -> Tuple[Any, Any, int,
+                                                  Dict[str, Any]]:
+    """One-call convenience: (state, host_blob, cursor, meta)."""
+    payload = load_payload(path)
+    state = materialize(payload, state_template)
+    return state, payload["host_blob"], payload["cursor"], payload["meta"]

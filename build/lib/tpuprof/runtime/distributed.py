@@ -1,0 +1,154 @@
+"""Multi-host execution (SURVEY.md §5 'Distributed communication backend').
+
+The reference's multi-node story is Spark's driver→executor RPC + Netty
+shuffle.  tpuprof's: ``jax.distributed`` + a global device mesh.  The
+division of traffic follows the survey's prescription —
+
+* **ICI** carries the collective sketch merge (the psum/pmax/all_gather
+  program in runtime/mesh.py, unchanged: with a global mesh the same
+  collectives span the slice);
+* **DCN** carries only ingestion fan-out (each host reads its own
+  striped subset of Arrow fragments) and the final host-side aggregate
+  gather (Misra-Gries summaries, date min/max, null tallies — all
+  mergeable, all tiny).
+
+Everything here degrades to a no-op at ``process_count() == 1``, which is
+how the single-host test suite exercises the code paths.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up jax.distributed (no-op if already initialized or args are
+    all None in a single-process run)."""
+    import jax
+    if coordinator_address is None and num_processes is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+
+
+def process_info():
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+def assign_fragments(fragments, process_index: int,
+                     process_count: int) -> Iterator:
+    """Stripe dataset fragments across hosts: host i reads fragments
+    i, i+n, i+2n, ... — deterministic, no coordination traffic."""
+    for k, frag in enumerate(fragments):
+        if k % process_count == process_index:
+            yield frag
+
+
+def allgather_objects(obj):
+    """Gather one pickled python object per host onto ALL hosts (the
+    final DCN gather the survey allots to host traffic — a few KB).
+    Single-process: [obj]."""
+    import jax
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # pad to a common length across hosts (allgather needs equal shapes)
+    length = np.asarray([blob.size], dtype=np.int64)
+    all_lengths = np.asarray(
+        multihost_utils.process_allgather(length)).reshape(-1)
+    maxlen = int(all_lengths.max())
+    padded = np.zeros(maxlen, dtype=np.uint8)
+    padded[: blob.size] = blob
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return [pickle.loads(row[: int(ln)].tobytes())
+            for row, ln in zip(gathered, all_lengths)]
+
+
+def merge_host_aggs(hostagg):
+    """Merge every host's HostAgg into a complete one (on all hosts).
+    Misra-Gries merge keeps its mergeability bounds (kernels/topk.py)."""
+    parts = allgather_objects(hostagg)
+    merged = parts[0]
+    for other in parts[1:]:
+        merged = _merge_pair(merged, other)
+    return merged
+
+
+def merge_shift_estimates(local_shift):
+    """Agree on ONE centering shift across hosts (mean of the hosts that
+    saw data; None if none did).  Every process MUST call this exactly
+    once before init_pass_a — a host whose fragment stripe is empty
+    passes None and still participates, so the collective cannot
+    deadlock.  A shared shift makes the device-state merge's rebase the
+    identity (runtime/mesh.init_pass_a)."""
+    parts = [p for p in allgather_objects(local_shift) if p is not None]
+    if not parts:
+        return None
+    return np.mean(np.stack(parts), axis=0).astype(np.float32)
+
+
+def merge_samplers(sampler):
+    """Merge every host's RowSampler (ingest/sample.py) into a complete
+    one — the host-side analogue of the device sketch collectives; the
+    bottom-k priority merge law makes the result order-independent."""
+    parts = allgather_objects(sampler)
+    merged = parts[0]
+    for other in parts[1:]:
+        merged = merged.merge(other)
+    return merged
+
+
+def merge_hll_registers(host_hll):
+    """Elementwise-max every host's HLL registers (kernels/hll.py
+    HostRegisters) — same law as the device pmax merge, over DCN."""
+    parts = allgather_objects(host_hll)
+    merged = parts[0]
+    for other in parts[1:]:
+        merged = merged.merge(other)
+    return merged
+
+
+def merge_recount_arrays(counts_by_col):
+    """Sum each host's exact pass-B recount vectors (candidate sets are
+    identical on every host: they derive from the merged HostAgg)."""
+    parts = allgather_objects(counts_by_col)
+    merged = parts[0]
+    for other in parts[1:]:
+        for name, arr in other.items():
+            merged[name] = merged[name] + arr
+    return merged
+
+
+def _merge_pair(a, b):
+    """Combine two HostAggs (commutative — same laws as the device
+    sketches; see tests/test_distributed.py)."""
+    a.n_rows += b.n_rows
+    for name, nb in b.col_nbytes.items():
+        a.col_nbytes[name] = a.col_nbytes.get(name, 0) + nb
+    for name, nb in b.col_dict_nbytes.items():
+        # SUM across hosts: batches share a dictionary within a host's
+        # fragment stripe (hence per-host max in HostAgg.update) but each
+        # host holds its own dictionary object
+        a.col_dict_nbytes[name] = a.col_dict_nbytes.get(name, 0) + nb
+    for name, mg in b.mg.items():
+        a.mg[name].merge(mg)
+    for name, cnt in b.cat_null.items():
+        a.cat_null[name] += cnt
+    for name, cnt in b.date_null.items():
+        a.date_null[name] += cnt
+    for name, lo in b.date_min.items():
+        a.date_min[name] = min(a.date_min.get(name, lo), lo)
+    for name, hi in b.date_max.items():
+        a.date_max[name] = max(a.date_max.get(name, hi), hi)
+    for name, vals in b.first_values.items():
+        a.first_values.setdefault(name, vals)
+    return a

@@ -1,0 +1,459 @@
+"""Row-sharded SPMD execution over a 1-D device mesh.
+
+The reference's parallelism is Spark data parallelism: rows partitioned
+across executors, partial aggregates shuffle-merged (SURVEY.md §2.3).
+The TPU-native equivalent here:
+
+* a 1-D ``Mesh(devices, ("data",))``;
+* each host batch (G rows, padded) is row-sharded ``P("data")`` so every
+  device folds G/D rows into its OWN sketch state (state leaves carry a
+  leading device axis, also sharded ``P("data")`` — purely local update,
+  zero per-step communication);
+* at finalize, ONE collective program merges the per-device states:
+  ``psum`` for additive leaves (after an exact rebase to a collectively
+  agreed shift), ``pmin``/``pmax`` for bounds and HLL registers, and an
+  ``all_gather`` + top-k for the sample sketch — the "single psum
+  tree-reduce" of the north star (BASELINE.json), riding ICI within a
+  slice.
+
+Multi-host note: under ``jax.distributed`` the same program spans hosts —
+each host feeds its own Arrow fragments (DCN only carries ingestion and
+the final host-0 gather, SURVEY §5); the collective merge is unchanged
+because every sketch state is a commutative monoid (tests/test_merge_laws).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuprof.kernels import corr, fused, histogram, hll, moments
+
+Pytree = Any
+
+
+class DeviceBatch(NamedTuple):
+    """A host batch explicitly placed on the mesh.
+
+    Feeding raw numpy into a sharded jit lets JAX pick the implicit
+    transfer path, which on real TPU measured ~160x slower than an
+    explicit ``device_put`` with the target sharding (8.9s vs 55ms for a
+    64k x 200 f32 batch).  Ingest fills column-major (F-order) buffers —
+    whose transpose is a zero-copy C-order view — so batches ship as
+    (cols, rows) and the step transposes on device (HBM-speed, ~0.1ms).
+    """
+
+    xt: Any         # (n_num, rows) float32, sharded P(None, "data")
+    row_valid: Any  # (rows,) bool, sharded P("data")
+    hllt: Any       # (n_hash, rows) uint16, sharded P(None, "data")
+
+
+class StackedBatch(NamedTuple):
+    """Several host batches shipped as one stacked device placement, for
+    the multi-batch ``scan_a`` dispatch (leading axis = batch index)."""
+
+    xts: Any          # (S, n_num, rows) float32, sharded P(None, None, "data")
+    row_valids: Any   # (S, rows) bool, sharded P(None, "data")
+    hllts: Any        # (S, n_hash, rows) uint16, sharded P(None, None, "data")
+    n_batches: int
+
+
+def _unstack(tree: Pytree) -> Pytree:
+    """Inside shard_map each state leaf arrives as a (1, ...) block of the
+    device-stacked axis; strip it for the kernel code."""
+    return jax.tree.map(lambda a: a[0], tree)
+
+
+def _restack(tree: Pytree) -> Pytree:
+    return jax.tree.map(lambda a: a[None], tree)
+
+
+class MeshRunner:
+    """Owns the mesh, the compiled sharded step/merge programs, and the
+    per-device state layout."""
+
+    def __init__(self, config, n_num: int, n_hash: int,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        devs = list(devices if devices is not None else jax.devices())
+        if config.mesh_devices:
+            devs = devs[: config.mesh_devices]
+        self.n_dev = len(devs)
+        self.mesh = Mesh(np.asarray(devs), ("data",))
+        # host batches are padded to a device-divisible row count
+        self.rows = -(-config.batch_rows // self.n_dev) * self.n_dev
+        self.n_num = n_num
+        self.n_hash = n_hash
+        self.precision = config.hll_precision
+        self.bins = config.bins
+        # dense pallas binning beats XLA's serialized scatter on real TPU;
+        # the scatter path stays for CPU meshes, very wide tables (the
+        # kernels keep per-column blocks VMEM-resident — see the
+        # MAX_*_COLS probes in each kernel module), and as an opt-out
+        from tpuprof.kernels.pallas_hist import MAX_BINS, MAX_HIST_COLS
+        hist_fits = self.bins <= MAX_BINS and n_num <= MAX_HIST_COLS
+        if config.use_pallas is None:
+            self.use_pallas = devs[0].platform == "tpu" and hist_fits
+        else:
+            self.use_pallas = config.use_pallas and hist_fits
+        # fused pallas pass A (kernels/fused.py; single-read kernel up to
+        # MAX_FUSED_COLS, column-tiled beyond) on real TPU; the
+        # per-kernel XLA formulation on CPU meshes and past the tiled
+        # kernel's width limit
+        fused_fits = n_num <= fused.MAX_FUSED_COLS_WIDE
+        self.use_fused = (devs[0].platform == "tpu" and fused_fits
+                          if config.use_fused is None
+                          else bool(config.use_fused) and fused_fits)
+        # the Spearman grid tier follows the fused pass (narrow
+        # single-pass kernel, or rank-transform + tiled Gram when wide)
+        self.spear_grid = self.use_fused
+        self._sh_rows = NamedSharding(self.mesh, P("data"))
+        self._sh_cols_rows = NamedSharding(self.mesh, P(None, "data"))
+        self._sh_rep = NamedSharding(self.mesh, P())
+        self._build_programs()
+
+    # -- explicit host->device placement ------------------------------------
+
+    def _host_views(self, hb, with_hll: bool):
+        """(xt, row_valid, hllt) host views of one batch — zero-copy when
+        ingest delivered its F-order buffers."""
+        x = hb.x
+        h = hb.hll if with_hll else hb.hll[:, :0]
+        if with_hll and self.n_hash and hb.hll_precision != self.precision:
+            raise ValueError(
+                f"batch packed with hll_precision={hb.hll_precision} but "
+                f"runner registers use precision={self.precision} — a "
+                "mismatched index would scatter into neighboring columns")
+        xt = x.T if x.flags.f_contiguous else np.ascontiguousarray(x.T)
+        ht = h.T if h.flags.f_contiguous else np.ascontiguousarray(h.T)
+        return xt, np.ascontiguousarray(hb.row_valid), ht
+
+    def put_batch(self, hb, with_hll: bool = True) -> DeviceBatch:
+        """Ship a HostBatch to the mesh with explicit shardings (async —
+        returns immediately; the transfer overlaps host work).
+
+        ``with_hll=False`` skips the packed-HLL plane — pass B, the
+        spearman pass and host-side register folds never read it, and
+        for wide categorical tables it is a large share of the transfer
+        volume."""
+        xt, rv, ht = self._host_views(hb, with_hll)
+        return DeviceBatch(
+            jax.device_put(xt, self._sh_cols_rows),
+            jax.device_put(rv, self._sh_rows),
+            jax.device_put(ht, self._sh_cols_rows))
+
+    def stage_batches(self, hbs, with_hll: bool = True) -> "StackedBatch":
+        """Ship several HostBatches as ONE stacked placement so they can be
+        folded by a single ``scan_a`` dispatch.  Multi-batch dispatch exists
+        because per-program dispatch latency (~15ms through a tunneled
+        device) would otherwise dominate the fused step's compute."""
+        views = [self._host_views(hb, with_hll) for hb in hbs]
+        return StackedBatch(
+            jax.device_put(np.stack([v[0] for v in views]),
+                           NamedSharding(self.mesh, P(None, None, "data"))),
+            jax.device_put(np.stack([v[1] for v in views]),
+                           NamedSharding(self.mesh, P(None, "data"))),
+            jax.device_put(np.stack([v[2] for v in views]),
+                           NamedSharding(self.mesh, P(None, None, "data"))),
+            len(hbs))
+
+    def scan_a(self, state: Pytree, sb: "StackedBatch") -> Pytree:
+        """Fold ``sb.n_batches`` staged batches in one compiled dispatch."""
+        return self._scan_a(state, sb.xts, sb.row_valids, sb.hllts)
+
+    def put_replicated(self, arr, dtype=None):
+        """Place a small constant (e.g. histogram lo/hi/mean) once, so the
+        per-step calls do not re-transfer it.  Device arrays pass through
+        untouched (implicit transfer into a sharded jit is slow)."""
+        if isinstance(arr, jax.Array):
+            return arr
+        a = np.asarray(arr, dtype=dtype) if dtype is not None \
+            else np.asarray(arr)
+        return jax.device_put(a, self._sh_rep)
+
+    # -- state ------------------------------------------------------------
+
+    def init_pass_a(self, shift=None) -> Pytree:
+        """``shift``: optional (n_num,) centering values (the backend
+        estimates them from a prefix of the first batch).  With a shared
+        explicit shift every device accumulates about the same center and
+        the collective merge's rebase is exactly the identity; the fused
+        pallas path requires it for well-conditioned f32 sums.  Without
+        it the XLA path falls back to adapting each device's shift to its
+        first batch's means."""
+        if shift is None:
+            shift_arr = jnp.zeros((self.n_num,), dtype=jnp.float32)
+            set_flag = jnp.zeros((), dtype=jnp.int32)
+        else:
+            shift_arr = jnp.asarray(shift, dtype=jnp.float32)
+            set_flag = jnp.ones((), dtype=jnp.int32)
+
+        def one_device(_):
+            mom = moments.init(self.n_num)
+            mom["shift"] = shift_arr
+            co = corr.init(self.n_num)
+            co["shift"] = shift_arr
+            co["set"] = set_flag
+            return {
+                "mom": mom,
+                "corr": co,
+                "hll": hll.init(self.n_hash, self.precision),
+            }
+        return jax.vmap(one_device)(jnp.arange(self.n_dev))
+
+    def init_pass_b(self) -> Pytree:
+        return jax.vmap(lambda _: histogram.init(self.n_num, self.bins))(
+            jnp.arange(self.n_dev))
+
+    # -- compiled programs -------------------------------------------------
+
+    def _build_programs(self) -> None:
+        mesh = self.mesh
+        use_fused = self.use_fused
+
+        def step_a_core(s, xt, row_valid, hllt):
+            """One batch folded into an UNSTACKED per-device state — shared
+            by the single-batch program and the multi-batch lax.scan
+            program (which amortizes per-dispatch latency)."""
+            if use_fused:
+                mom, co = fused.update(s["mom"], s["corr"], xt, row_valid)
+            else:
+                mom, co = fused.update_xla(s["mom"], s["corr"], xt,
+                                           row_valid)
+            return {
+                "mom": mom,
+                "corr": co,
+                "hll": hll.update(s["hll"], hllt.T),
+            }
+
+        def local_step_a(state, xt, row_valid, hllt):
+            return _restack(step_a_core(_unstack(state), xt, row_valid, hllt))
+
+        def local_scan_a(state, xts, row_valids, hllts):
+            def body(carry, inp):
+                return step_a_core(carry, *inp), None
+            out, _ = jax.lax.scan(
+                body, _unstack(state), (xts, row_valids, hllts))
+            return _restack(out)
+
+        use_pallas = self.use_pallas
+
+        def local_step_b(state, xt, row_valid, lo, hi, mean):
+            s = _unstack(state)
+            if use_pallas:
+                from tpuprof.kernels import pallas_hist
+                counts, abs_dev = pallas_hist.histogram_batch(
+                    xt, row_valid, lo, hi, mean, s["counts"].shape[1])
+                out = {"counts": s["counts"] + counts,
+                       "abs_dev": s["abs_dev"] + abs_dev}
+            else:
+                out = histogram.update(s, xt.T, row_valid, lo, hi, mean)
+            return _restack(out)
+
+        def merge_corr_local(co, common_shift):
+            wc = jnp.broadcast_to((co["set"] > 0).astype(jnp.float32),
+                                  co["shift"].shape)
+            co = corr.rebase(co, common_shift(co["shift"], wc))
+            return {
+                "shift": co["shift"],
+                "set": jax.lax.pmax(co["set"], "data"),
+                "N": jax.lax.psum(co["N"], "data"),
+                "S1": jax.lax.psum(co["S1"], "data"),
+                "S2": jax.lax.psum(co["S2"], "data"),
+                "P": jax.lax.psum(co["P"], "data"),
+            }
+
+        def _common_shift(shift, weight):
+            wsum = jax.lax.psum(weight, "data")
+            return jax.lax.psum(shift * weight, "data") / jnp.maximum(
+                wsum, 1.0)
+
+        def local_step_spear(state, xt, row_valid, sample, kept):
+            """Spearman pass, exact tier: rank-transform each value through
+            the pass-A sample CDF (average rank of the two searchsorted
+            sides — exact average-tie ranks when the sample holds the whole
+            column) and accumulate the same Gram state Pearson uses
+            (SURVEY §7.2)."""
+            s = _unstack(state)
+            x = xt.T
+            finite = row_valid[:, None] & jnp.isfinite(x)
+            left = jax.vmap(
+                lambda a, v: jnp.searchsorted(a, v, side="left"))(sample, xt)
+            right = jax.vmap(
+                lambda a, v: jnp.searchsorted(a, v, side="right"))(sample, xt)
+            denom = jnp.maximum(kept, 1).astype(jnp.float32)[:, None]
+            ranks = (left + right).astype(jnp.float32) * 0.5 / denom
+            r = jnp.where(finite, ranks.T, jnp.nan)
+            return _restack(corr.update(s, r, row_valid))
+
+        def local_step_spear_grid(state, xt, row_valid, grid):
+            """Spearman pass, pallas tier (narrow): dense compare against a
+            G-point CDF grid in one program (kernels/fused.spearman_update;
+            rank resolution 1/G)."""
+            s = _unstack(state)
+            return _restack(fused.spearman_update(s, xt, row_valid, grid))
+
+        def local_rank_grid(xt, row_valid, grid):
+            return fused.rank_transform(xt, row_valid, grid)
+
+        def local_step_spear_wide(state, ranks_t, row_valid):
+            s = _unstack(state)
+            return _restack(
+                fused.spearman_update_wide(s, ranks_t, row_valid))
+
+        def local_merge_spear(state):
+            return _restack(merge_corr_local(_unstack(state), _common_shift))
+
+        def local_merge_a(state):
+            """The collective tree-reduce: merge all devices' pass-A states
+            into one replicated state."""
+            s = _unstack(state)
+            # ---- moments + corr: psum additive leaves after rebasing to a
+            # collectively agreed shift (weighted mean of device shifts)
+            mom = s["mom"]
+            w = (mom["n"] > 0).astype(jnp.float32)
+            mom = moments.rebase(mom, _common_shift(mom["shift"], w))
+            merged_mom = {
+                "shift": mom["shift"],
+                "minv": jax.lax.pmin(mom["minv"], "data"),
+                "maxv": jax.lax.pmax(mom["maxv"], "data"),
+                "fmin": jax.lax.pmin(mom["fmin"], "data"),
+                "fmax": jax.lax.pmax(mom["fmax"], "data"),
+            }
+            for leaf in ("n", "s1", "s2", "s3", "s4",
+                         "n_zeros", "n_inf", "n_missing"):
+                merged_mom[leaf] = jax.lax.psum(mom[leaf], "data")
+
+            merged_corr = merge_corr_local(s["corr"], _common_shift)
+
+            # ---- HLL: registers are max-mergeable
+            merged_hll = jax.lax.pmax(s["hll"], "data")
+
+            return _restack({"mom": merged_mom, "corr": merged_corr,
+                             "hll": merged_hll})
+
+        def local_merge_b(state):
+            return _restack(jax.tree.map(
+                lambda a: jax.lax.psum(a, "data"), _unstack(state)))
+
+        state_spec = P("data")
+        rows_spec = P("data")
+        cols_rows_spec = P(None, "data")
+        rep = P()
+
+        self._step_a = jax.jit(shard_map(
+            local_step_a, mesh=mesh,
+            in_specs=(state_spec, cols_rows_spec, rows_spec, cols_rows_spec),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
+        self._scan_a = jax.jit(shard_map(
+            local_scan_a, mesh=mesh,
+            in_specs=(state_spec, P(None, None, "data"), P(None, "data"),
+                      P(None, None, "data")),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
+        self._step_b = jax.jit(shard_map(
+            local_step_b, mesh=mesh,
+            in_specs=(state_spec, cols_rows_spec, rows_spec, rep, rep, rep),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
+        self._merge_a = jax.jit(shard_map(
+            local_merge_a, mesh=mesh, in_specs=(state_spec,),
+            out_specs=state_spec, check_vma=False))
+        self._merge_b = jax.jit(shard_map(
+            local_merge_b, mesh=mesh, in_specs=(state_spec,),
+            out_specs=state_spec, check_vma=False))
+        self._step_spear = jax.jit(shard_map(
+            local_step_spear, mesh=mesh,
+            in_specs=(state_spec, cols_rows_spec, rows_spec, rep, rep),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
+        self._step_spear_grid = jax.jit(shard_map(
+            local_step_spear_grid, mesh=mesh,
+            in_specs=(state_spec, cols_rows_spec, rows_spec, rep),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
+        # wide tier: rank transform and rank Gram are SEPARATE dispatches
+        # (two pallas calls in one module trip scoped-VMEM accounting)
+        self._rank_grid = jax.jit(shard_map(
+            local_rank_grid, mesh=mesh,
+            in_specs=(cols_rows_spec, rows_spec, rep),
+            out_specs=cols_rows_spec, check_vma=False))
+        self._step_spear_wide = jax.jit(shard_map(
+            local_step_spear_wide, mesh=mesh,
+            in_specs=(state_spec, cols_rows_spec, rows_spec),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
+        self._merge_spear = jax.jit(shard_map(
+            local_merge_spear, mesh=mesh, in_specs=(state_spec,),
+            out_specs=state_spec, check_vma=False))
+
+    # -- driver API --------------------------------------------------------
+
+    def _as_device(self, hb) -> DeviceBatch:
+        return hb if isinstance(hb, DeviceBatch) else self.put_batch(hb)
+
+    def step_a(self, state: Pytree, hb, step_idx: int = 0) -> Pytree:
+        """Fold one batch (HostBatch or pre-placed DeviceBatch).
+
+        ``step_idx`` is accepted for caller convenience (cursor-style
+        loops); the update itself is deterministic and order-free."""
+        db = self._as_device(hb)
+        return self._step_a(state, db.xt, db.row_valid, db.hllt)
+
+    def step_b(self, state: Pytree, hb, lo, hi, mean) -> Pytree:
+        db = self._as_device(hb)
+        return self._step_b(state, db.xt, db.row_valid,
+                            self.put_replicated(lo, dtype=jnp.float32),
+                            self.put_replicated(hi, dtype=jnp.float32),
+                            self.put_replicated(mean, dtype=jnp.float32))
+
+    def init_spearman(self) -> Pytree:
+        def one_device(_):
+            co = corr.init(self.n_num)
+            if self.use_fused:
+                # grid ranks live in [0,1]: a constant 0.5 shift is the
+                # perfectly conditioned center (fused.spearman_update)
+                co["shift"] = jnp.full((self.n_num,), 0.5,
+                                       dtype=jnp.float32)
+                co["set"] = jnp.ones((), dtype=jnp.int32)
+            return co
+        return jax.vmap(one_device)(jnp.arange(self.n_dev))
+
+    def step_spearman(self, state: Pytree, hb, sorted_sample,
+                      kept) -> Pytree:
+        db = self._as_device(hb)
+        return self._step_spear(
+            state, db.xt, db.row_valid,
+            self.put_replicated(sorted_sample, dtype=jnp.float32),
+            self.put_replicated(kept, dtype=jnp.int32))
+
+    def step_spearman_grid(self, state: Pytree, hb, grid) -> Pytree:
+        """Pallas-tier Spearman step: ``grid`` is the (n_num, G) host CDF
+        grid (RowSampler.cdf_grid).  Narrow widths run one program; wide
+        widths dispatch rank transform and rank Gram separately."""
+        db = self._as_device(hb)
+        grid_d = self.put_replicated(grid, dtype=jnp.float32)
+        if self.n_num <= fused.MAX_FUSED_COLS:
+            return self._step_spear_grid(state, db.xt, db.row_valid,
+                                         grid_d)
+        ranks = self._rank_grid(db.xt, db.row_valid, grid_d)
+        return self._step_spear_wide(state, ranks, db.row_valid)
+
+    def finalize_spearman(self, state: Pytree):
+        return jax.device_get(
+            jax.tree.map(lambda a: a[0], self._merge_spear(state)))
+
+    def finalize_a(self, state: Pytree) -> Dict[str, Any]:
+        """Collective merge on-device, then pull ONE replica to host."""
+        merged = jax.device_get(
+            jax.tree.map(lambda a: a[0], self._merge_a(state)))
+        return merged
+
+    def finalize_b(self, state: Pytree) -> Dict[str, Any]:
+        return jax.device_get(
+            jax.tree.map(lambda a: a[0], self._merge_b(state)))

@@ -1,0 +1,231 @@
+"""Streaming micro-batch profiling (BASELINE.json config 5: Kafka→Arrow
+micro-batches with a running sketch merge).
+
+The reference cannot do this at all — ``ProfileReport`` is one-shot over
+a static DataFrame.  Because every tpuprof statistic lives in a
+fixed-shape mergeable state, a profile can instead be *maintained*: feed
+micro-batches as they arrive, snapshot the stats dict (or the full HTML
+report) at any moment, checkpoint/restore across process restarts
+(SURVEY.md §5 'Checkpoint / resume').
+
+Single-pass accuracy: exact moments/min-max/zeros/inf/bool/date stats,
+sketch-bounded quantiles/distincts, Misra-Gries top-k (error ≤ n/capacity),
+sample-derived histograms — the documented exact_passes=False tier.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from tpuprof.config import ProfilerConfig
+from tpuprof.ingest.arrow import ColumnPlan, prepare_batch
+from tpuprof.ingest.sample import RowSampler
+from tpuprof.kernels import corr as kcorr
+from tpuprof.kernels import hll as khll
+from tpuprof.kernels import moments as kmoments
+from tpuprof.runtime import checkpoint as ckpt
+from tpuprof.runtime.mesh import MeshRunner
+from tpuprof.utils.trace import log_event
+
+
+def _to_record_batches(batch: Any, schema: Optional[pa.Schema]):
+    if isinstance(batch, pd.DataFrame):
+        got = list(batch.columns)
+        expected = schema.names if schema is not None else got
+        if got != list(expected):
+            raise ValueError(
+                f"micro-batch columns {got} do not match the stream schema "
+                f"{list(expected)} — column sets must be stable over a "
+                f"stream (sketch lanes are fixed shapes)")
+        table = pa.Table.from_pandas(batch, preserve_index=False, schema=schema)
+        return table.to_batches()
+    if isinstance(batch, (pa.Table, pa.RecordBatch)):
+        if schema is not None and (batch.schema.names != schema.names
+                                   or batch.schema.types != schema.types):
+            # validate names AND types up front: a cast failure halfway
+            # through folding would leave the running state partially
+            # updated with no rollback
+            raise ValueError(
+                f"micro-batch schema {batch.schema} does not match the "
+                f"stream schema {schema}")
+        return batch.to_batches() if isinstance(batch, pa.Table) else [batch]
+    raise TypeError(f"cannot stream {type(batch)!r}")
+
+
+class StreamingProfiler:
+    """A live, mergeable profile over an unbounded stream.
+
+    >>> prof = StreamingProfiler(arrow_schema, config)
+    >>> for micro_batch in kafka_arrow_stream():
+    ...     prof.update(micro_batch)
+    >>> html = prof.report_html()
+    """
+
+    def __init__(self, arrow_schema: pa.Schema,
+                 config: Optional[ProfilerConfig] = None,
+                 devices: Optional[Sequence] = None):
+        import dataclasses
+        self.config = dataclasses.replace(    # streaming is single-pass
+            config or ProfilerConfig(), exact_passes=False)
+        self.arrow_schema = arrow_schema
+        self.plan = ColumnPlan.from_schema(arrow_schema)
+        self.runner = MeshRunner(self.config, self.plan.n_num,
+                                 self.plan.n_hash, devices=devices)
+        from tpuprof.backends.tpu import HostAgg
+        self.hostagg = HostAgg(self.plan, self.config)
+        self.sampler = RowSampler(self.config.quantile_sketch_size,
+                                  self.plan.n_num, seed=self.config.seed)
+        from tpuprof import native
+        self.host_hll = khll.HostRegisters(
+            self.plan.n_hash, self.config.hll_precision) \
+            if self.plan.n_hash > 0 and native.available() else None
+        # device state is created on the first micro-batch so the fused
+        # kernel's centering shift can come from real data
+        self.state = None
+        self.cursor = 0                      # micro-batches folded in
+        self._sample: Optional[pd.DataFrame] = None
+
+    @classmethod
+    def for_example(cls, example: Any, **kwargs) -> "StreamingProfiler":
+        """Infer the Arrow schema from an example batch/frame."""
+        if isinstance(example, pd.DataFrame):
+            # infer from the FULL example: head(1) would type an
+            # all-null-leading column as Arrow null and poison the stream
+            schema = pa.Table.from_pandas(
+                example, preserve_index=False).schema
+        elif isinstance(example, (pa.Table, pa.RecordBatch)):
+            schema = example.schema
+        else:
+            raise TypeError(f"cannot infer schema from {type(example)!r}")
+        return cls(schema, **kwargs)
+
+    # -- ingestion ---------------------------------------------------------
+
+    def update(self, batch: Any) -> None:
+        """Fold one micro-batch (pandas DataFrame / Arrow Table or
+        RecordBatch) into the running profile."""
+        for rb in _to_record_batches(batch, self.arrow_schema):
+            if self._sample is None or len(self._sample) < \
+                    self.config.sample_rows:
+                head = pa.Table.from_batches([rb]).to_pandas().head(
+                    self.config.sample_rows)
+                self._sample = head if self._sample is None else pd.concat(
+                    [self._sample, head], ignore_index=True).head(
+                        self.config.sample_rows)
+            # micro-batches larger than the device batch are chunked
+            for start in range(0, rb.num_rows, self.runner.rows):
+                chunk = rb.slice(start, self.runner.rows)
+                hb = prepare_batch(chunk, self.plan, self.runner.rows,
+                                   self.config.hll_precision)
+                if self.state is None:
+                    from tpuprof.backends.tpu import estimate_shift
+                    self.state = self.runner.init_pass_a(estimate_shift(hb))
+                db = self.runner.put_batch(
+                    hb, with_hll=self.host_hll is None)
+                self.state = self.runner.step_a(self.state, db, self.cursor)
+                self.sampler.update(hb.x, hb.nrows)
+                if self.host_hll is not None:
+                    self.host_hll.update(hb.hll, hb.nrows)
+                self.hostagg.update(hb)
+                self.cursor += 1
+        log_event("stream_update", cursor=self.cursor,
+                  rows=self.hostagg.n_rows)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Snapshot the stats dict (non-destructive; streaming continues)."""
+        from tpuprof.backends.tpu import _assemble, _empty_stats
+        if not self.plan.specs:
+            return _empty_stats(self.config)
+        state = self.state if self.state is not None \
+            else self.runner.init_pass_a()
+        res = self.runner.finalize_a(state)
+        momf = kmoments.finalize(res["mom"])
+        probes = list(self.config.quantile_probes)
+        sample_vals, sample_kept = self.sampler.columns()
+        hll_regs = self.host_hll.regs if self.host_hll is not None \
+            else res["hll"]
+        return _assemble(
+            self.plan, self.config,
+            self._sample if self._sample is not None else pd.DataFrame(),
+            self.hostagg, momf, kcorr.finalize(res["corr"]),
+            self.sampler.quantiles(probes), sample_vals, sample_kept,
+            khll.finalize(hll_regs), None, None, None, probes)
+
+    def report_html(self) -> str:
+        from tpuprof.report.render import to_standalone_html
+        return to_standalone_html(self.stats(), self.config)
+
+    # -- durability --------------------------------------------------------
+
+    def checkpoint(self, path: str) -> None:
+        """Persist (device state, host aggregators, cursor) atomically."""
+        host_blob = {
+            "hostagg": self.hostagg,
+            "sampler": self.sampler,
+            "host_hll": self.host_hll,
+            "sample": self._sample,
+            "schema": self.arrow_schema.serialize().to_pybytes(),
+        }
+        from tpuprof import native
+        ckpt.save(path, self.state, host_blob, self.cursor,
+                  meta={"n_num": self.plan.n_num, "n_hash": self.plan.n_hash,
+                        "batch_rows": self.config.batch_rows,
+                        "has_state": self.state is not None,
+                        # HLL registers only merge with same-impl hashes
+                        "native_hash": native.available()})
+
+    @classmethod
+    def restore(cls, path: str, config: Optional[ProfilerConfig] = None,
+                devices: Optional[Sequence] = None) -> "StreamingProfiler":
+        """Rebuild a profiler from a checkpoint and continue streaming."""
+        payload = ckpt.load_payload(path)
+        host_blob = payload["host_blob"]
+        from tpuprof import native
+        saved_native = payload["meta"].get("native_hash")
+        if saved_native is not None and saved_native != native.available():
+            raise ValueError(
+                "checkpoint was written with "
+                f"{'native' if saved_native else 'pandas'} hashing but this "
+                "process has the other implementation — HLL registers would "
+                "not merge consistently")
+        arrow_schema = pa.ipc.read_schema(pa.py_buffer(host_blob["schema"]))
+        prof = cls(arrow_schema, config=config, devices=devices)
+        if payload["meta"].get("has_state", True):
+            # leave leaves as host numpy (uncommitted): the first sharded
+            # step places them onto the mesh like freshly-init'd state
+            prof.state = ckpt.materialize(payload,
+                                          prof.runner.init_pass_a())
+        prof.hostagg = host_blob["hostagg"]
+        saved_sampler = host_blob["sampler"]
+        if saved_sampler.k != prof.config.quantile_sketch_size:
+            raise ValueError(
+                f"checkpoint sampler has k={saved_sampler.k} but config "
+                f"requests quantile_sketch_size="
+                f"{prof.config.quantile_sketch_size} — the sample cannot "
+                "be re-sized after the fact")
+        prof.sampler = saved_sampler
+        # registers are interchangeable between host and device paths
+        # (bit-identical fold), so restore whichever side wrote them —
+        # a process without the native lib continues via the numpy
+        # fallback rather than dropping observations.  Absent key = the
+        # registers live in the device state (blob layouts without it
+        # are same-version; .get keeps them loadable).
+        saved_hll = host_blob.get("host_hll")
+        if saved_hll is not None:
+            m = saved_hll.regs.shape[1]
+            if m != 1 << prof.config.hll_precision:
+                raise ValueError(
+                    f"checkpoint HLL registers are {m} wide but config "
+                    f"requests hll_precision={prof.config.hll_precision} "
+                    f"(2^p={1 << prof.config.hll_precision}) — register "
+                    "planes of different widths cannot merge")
+        prof.host_hll = saved_hll
+        prof._sample = host_blob["sample"]
+        prof.cursor = payload["cursor"]
+        return prof

@@ -1,0 +1,12 @@
+"""Cross-cutting utilities: tracing, phase timing, structured logging.
+
+The reference has no tracing/metrics of its own — it delegates to the
+Spark UI and event log (SURVEY.md §5).  tpuprof owns its observability:
+``jax.profiler`` trace capture, per-phase wall-clock timers, and
+structured log records (rows ingested, batches, device count).
+"""
+
+from tpuprof.utils.trace import (get_phase_report, log_event, phase_timer,
+                                 trace_to)
+
+__all__ = ["trace_to", "phase_timer", "get_phase_report", "log_event"]

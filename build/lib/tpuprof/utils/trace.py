@@ -1,0 +1,62 @@
+"""Tracing / profiling / structured logging (SURVEY.md §5).
+
+* ``trace_to(dir)`` — capture a TensorBoard-viewable ``jax.profiler``
+  trace of everything inside the context (the ``--trace`` CLI flag);
+  no-op when dir is falsy.
+* ``phase_timer(name)`` — wall-clock a pipeline phase (ingest / scan /
+  merge / render); accumulated per-phase totals feed the report footer
+  and ``get_phase_report()``.
+* ``log_event(event, **fields)`` — structured single-line JSON records on
+  the ``tpuprof`` logger (rows ingested, batches, device util).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+logger = logging.getLogger("tpuprof")
+
+_lock = threading.Lock()
+_phase_totals: Dict[str, float] = {}
+
+
+@contextlib.contextmanager
+def trace_to(trace_dir: Optional[str]) -> Iterator[None]:
+    if not trace_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(trace_dir):
+        yield
+    logger.info("tpuprof trace written to %s (view with TensorBoard)",
+                trace_dir)
+
+
+@contextlib.contextmanager
+def phase_timer(name: str) -> Iterator[None]:
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            _phase_totals[name] = _phase_totals.get(name, 0.0) + dt
+        log_event("phase", name=name, seconds=round(dt, 4))
+
+
+def get_phase_report(reset: bool = False) -> Dict[str, float]:
+    """Per-phase accumulated wall-clock seconds."""
+    with _lock:
+        out = dict(_phase_totals)
+        if reset:
+            _phase_totals.clear()
+    return out
+
+
+def log_event(event: str, **fields) -> None:
+    logger.debug("%s", json.dumps({"event": event, **fields}, default=str))
