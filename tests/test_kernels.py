@@ -251,3 +251,46 @@ class TestMisraGries:
         b.update_batch(ub, cb)
         a.merge(b)
         assert a.counts["x"] == 13 and a.exact
+
+    def test_duplicate_keys_in_batch(self):
+        # contract-violating (non-pre-aggregated) batches must aggregate,
+        # not corrupt the store or lose counts in the fancy add
+        mg = topk.MisraGries(8)
+        mg.update_batch(np.array(["a", "a", "b"], dtype=object),
+                        np.array([1, 2, 3]))
+        assert mg.counts == {"a": 3, "b": 3}
+        mg.update_batch(np.array(["a", "b", "a", "c"], dtype=object),
+                        np.array([10, 1, 5, 2]))
+        assert mg.counts == {"a": 18, "b": 4, "c": 2} and mg.exact
+
+    def test_merge_across_hash_implementations(self):
+        # hosts may disagree on native-extension availability, so the
+        # same value can carry DIFFERENT hashes in the two stores; the
+        # value-keyed merge must still combine counts (and keep
+        # candidates unique for the pass-B Recounter)
+        a, b = topk.MisraGries(8), topk.MisraGries(8)
+        vals = np.array(["x", "y"], dtype=object)
+        a.update_batch(vals, np.array([5, 3]),
+                       hashes=np.array([111, 222], dtype=np.uint64))
+        b.update_batch(vals, np.array([5, 3]),
+                       hashes=np.array([999, 888], dtype=np.uint64))
+        b.update_batch(np.array(["z"], dtype=object), np.array([2]),
+                       hashes=np.array([777], dtype=np.uint64))
+        a.merge(b)
+        assert a.counts == {"x": 10, "y": 6, "z": 2}
+        assert sorted(a.candidates()) == ["x", "y", "z"]
+
+    def test_hash_keyed_updates_match_fallback(self):
+        # production feeds ingest-computed hashes; the store must behave
+        # identically however keys are supplied (per-instance consistency)
+        import pandas as pd
+        rng = np.random.default_rng(11)
+        a, b = topk.MisraGries(32), topk.MisraGries(32)
+        for _ in range(5):
+            vals = np.array([f"v{i}" for i in
+                             rng.integers(0, 100, 400)], dtype=object)
+            u, c = np.unique(vals, return_counts=True)
+            a.update_batch(u, c)
+            b.update_batch(u, c,
+                           hashes=pd.util.hash_array(u).astype(np.uint64))
+        assert a.counts == b.counts and a.offset == b.offset

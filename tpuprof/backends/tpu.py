@@ -95,7 +95,10 @@ class HostAgg:
             if valid.any() and len(dvals):
                 cnt = np.bincount(codes[valid], minlength=len(dvals))
                 nz = np.nonzero(cnt)[0]
-                self.mg[name].update_batch(dvals[nz], cnt[nz])
+                dh = (hb.cat_hashes or {}).get(name)
+                self.mg[name].update_batch(
+                    dvals[nz], cnt[nz],
+                    hashes=dh[nz] if dh is not None else None)
             if first:
                 self.first_values[name] = [
                     dvals[c] if c >= 0 else None for c in codes[:5]]
@@ -358,9 +361,14 @@ class TPUStatsBackend:
                     # The wide tier's rank kernel has a VMEM budget
                     # calibrated for G <= 256, so its grid is clamped.
                     from tpuprof.kernels import fused as kfused
-                    g = config.spearman_grid
-                    if plan.n_num > kfused.MAX_FUSED_COLS:
-                        g = min(g, kfused.MAX_WIDE_SPEAR_GRID)
+                    g = min(config.spearman_grid, kfused.MAX_SPEAR_GRID)
+                    if g < config.spearman_grid:
+                        from tpuprof.utils.trace import logger
+                        logger.warning(
+                            "spearman_grid=%d clamped to %d: the pallas "
+                            "grid tiers are compile-probed only up to "
+                            "that resolution (kernels/fused.py)",
+                            config.spearman_grid, g)
                     spear_grid = runner.put_replicated(
                         sampler.cdf_grid(g), dtype=np.float32)
                 else:
@@ -404,10 +412,15 @@ class TPUStatsBackend:
                 "exact_passes=True; the spearman matrix was skipped")
         if recounter is None and config.exact_passes \
                 and ingest.rescannable and hostagg.n_rows > 0:
-            # no numeric columns — only the top-k recount matters
+            # no numeric columns — only the top-k recount matters.
+            # hashes=False: the recount reads categorical codes only, so
+            # the host hash + HLL-packing loop is skipped on this scan.
             recounter = Recounter(hostagg)
-            for hb in ingest.batches(config.hll_precision):
+            for hb in prefetch_prepared(ingest, plan, pad,
+                                        config.hll_precision, hashes=False):
                 recounter.update(hb)
+            # each host recounts only its own fragment stripe
+            recounter.counts = merge_recount_arrays(recounter.counts)
 
         stats = _assemble(plan, config, ingest.sample(config.sample_rows),
                           hostagg, momf, rho_all, quants, sample_vals,

@@ -74,7 +74,12 @@ class ProfilerConfig:
     spearman_grid: int = 256        # G: CDF-grid resolution of the pallas
                                     # Spearman tier (rank error ~1/G on top
                                     # of the sample CDF error; the CPU-mesh
-                                    # tier keeps exact average-tie ranks)
+                                    # tier keeps exact average-tie ranks).
+                                    # The TPU tiers clamp to
+                                    # kernels.fused.MAX_SPEAR_GRID (=256,
+                                    # compile-probed) with a warning;
+                                    # higher values only take effect in
+                                    # interpreter/CPU paths.
 
     def __post_init__(self) -> None:
         if self.bins < 1:

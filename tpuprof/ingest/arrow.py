@@ -102,6 +102,11 @@ class HostBatch:
     # host-side views for MG / recount / dates: name -> payload
     cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]]   # (codes, dict_vals)
     date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]]   # (int64 ns, valid)
+    # 64-bit hashes of each column's dictionary values (aligned with
+    # dict_vals), when this batch was prepared with hashes=True.  The
+    # Misra-Gries store keys on these so its per-batch fold never hashes
+    # Python strings (tpuprof/kernels/topk.py).
+    cat_hashes: Optional[Dict[str, np.ndarray]] = None
     # precision the hll column was packed with — MeshRunner refuses a
     # batch whose packing disagrees with its register width (a mismatched
     # idx would silently scatter into NEIGHBORING columns' registers)
@@ -178,6 +183,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     row_valid = np.zeros((g,), dtype=bool)
     row_valid[:n] = True
     cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    cat_hashes: Dict[str, np.ndarray] = {}
     date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
 
     col_nbytes: Dict[str, int] = {}
@@ -239,7 +245,9 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                     dh = _hash64_dictionary(combined.dictionary, dvals)
                     h64 = dh[codes]
                 else:
+                    dh = np.zeros(0, dtype=np.uint64)
                     h64 = np.zeros(n, dtype=np.uint64)
+                cat_hashes[spec.name] = dh
                 hll_packed[:n, spec.hash_lane] = khll.pack(
                     h64, valid, hll_precision)
             cat_codes[spec.name] = (np.where(valid, codes, -1), dvals)
@@ -259,6 +267,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
 
     return HostBatch(nrows=n, x=x, row_valid=row_valid, hll=hll_packed,
                      cat_codes=cat_codes, date_ints=date_ints,
+                     cat_hashes=cat_hashes if hashes else None,
                      hll_precision=hll_precision, col_nbytes=col_nbytes,
                      col_dict_nbytes=col_dict_nbytes)
 
@@ -378,12 +387,16 @@ class ArrowIngest:
             h.update(f"{field.name}:{field.type}".encode())
         if self._table is not None:
             h.update(f"rows={self._table.num_rows}".encode())
-            head = self._table.slice(0, 4096)
-            for batch in head.to_batches():
-                for col in batch.columns:
-                    for buf in col.buffers():
-                        if buf is not None:
-                            h.update(memoryview(buf))
+            # IPC-serialize the head slice: pyarrow slices are zero-copy
+            # views whose buffers() still span the FULL parent column, so
+            # hashing buffers directly would read the whole table (and be
+            # chunking/offset-sensitive).  The IPC writer materializes
+            # exactly the sliced rows in a canonical layout.
+            head = self._table.slice(0, 4096).combine_chunks()
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, head.schema) as writer:
+                writer.write_table(head)
+            h.update(memoryview(sink.getvalue()))
         else:
             import os
             for frag in self._dataset.get_fragments():

@@ -546,7 +546,11 @@ def spearman_update_wide(co: Dict[str, Array], ranks_t: Array,
     return _fold_corr(co, P, S1, S2, N)
 
 
-# the wide rank kernel's tile budget is calibrated for G <= 256 (see
-# _grid_ranks/_rank_tiles); the backend clamps the grid it builds for
-# the wide tier to this
-MAX_WIDE_SPEAR_GRID = 256
+# Both grid tiers are calibrated for G <= 256 (see _grid_ranks): the
+# wide rank kernel's VMEM tile budget holds at (256, 128)xG=256, and the
+# narrow tier's fully-unrolled 2G-compare loop was compile-probed on
+# hardware — G=512 at 200 cols did not finish compiling in >9 min while
+# G=256 compiles in seconds.  The backend clamps the grid it builds for
+# EITHER tier to this and warns (config.spearman_grid accepts higher
+# values only for the interpreter/CPU paths).
+MAX_SPEAR_GRID = 256

@@ -18,6 +18,22 @@ exceeds the capacity, counts are *exact*.
 Exactness parity with Spark's groupBy: pass B recounts the surviving
 candidates exactly (tpuprof/backends/tpu.py), so reported top-k rows are
 exact whenever the source is rescannable.
+
+Performance: the store keys on the 64-bit value hashes that Arrow decode
+already computes for the HLL plane (``HostBatch.cat_hashes`` — the native
+C++ buffer hash when available), held in a uint64 pandas ``Index`` whose
+``get_indexer`` probes run in C.  The actual values ride in a parallel
+object array and are only touched when a NEW key is appended — the hot
+per-batch fold never hashes or compares Python strings.  (The old
+per-value dict loop was the measured host bottleneck at Criteo-like
+cardinality: ~1e5 distinct per batch × dozens of columns.)
+
+Hash caveats, both shared with the HLL plane's existing contract
+(ingest/arrow.py ``_hash64_dictionary`` is process-stable, and multi-host
+merges assume every process picked the same hash implementation): a
+64-bit collision folds two values into one entry with probability
+~k²/2⁶⁴ (≈1e-9 at 1e5 keys) — and the pass-B recount is value-keyed, so
+reported counts self-heal even then.
 """
 
 from __future__ import annotations
@@ -25,44 +41,135 @@ from __future__ import annotations
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
+import pandas as pd
+
+
+def _fallback_hashes(values: np.ndarray) -> np.ndarray:
+    """Hash keys for callers that have no precomputed ingest hashes
+    (tests, value-level merges).  A given MisraGries instance must be fed
+    from ONE hash source — production always passes ingest hashes."""
+    return pd.util.hash_array(
+        np.asarray(values, dtype=object)).astype(np.uint64)
 
 
 class MisraGries:
     """One column's frequent-values summary (value -> count)."""
 
-    __slots__ = ("capacity", "counts", "offset", "overflowed")
+    __slots__ = ("capacity", "_index", "_counts", "_values", "offset",
+                 "overflowed")
 
     def __init__(self, capacity: int):
         self.capacity = int(capacity)
-        self.counts: Dict[object, int] = {}
+        self._index = pd.Index([], dtype=np.uint64)   # value hashes
+        self._counts = np.zeros(0, dtype=np.int64)
+        self._values = np.zeros(0, dtype=object)      # aligned with _index
         self.offset = 0          # total decrement applied (error bound)
         self.overflowed = False  # True once any eviction happened
 
-    def update_batch(self, values: np.ndarray, counts: np.ndarray) -> None:
-        """Fold pre-aggregated (unique values, counts) from one batch in."""
-        d = self.counts
-        for v, c in zip(values.tolist(), counts.tolist()):
-            d[v] = d.get(v, 0) + c
-        if len(d) > self.capacity:
-            self._compact()
+    def update_batch(self, values: np.ndarray, counts: np.ndarray,
+                     hashes: Optional[np.ndarray] = None) -> None:
+        """Fold pre-aggregated (unique values, counts) from one batch in.
 
-    def _compact(self) -> None:
+        ``hashes`` is the aligned uint64 key array from Arrow decode;
+        computed from ``values`` when omitted."""
+        counts = np.asarray(counts, dtype=np.int64)
+        if hashes is None:
+            hashes = _fallback_hashes(values)
+        hashes = np.asarray(hashes, dtype=np.uint64)
+        if hashes.size > 1:
+            # Batches are normally pre-aggregated (unique keys) — verify
+            # with one cheap sort; a duplicated key would otherwise lose
+            # counts in the fancy add below and corrupt the store's
+            # uniqueness invariant.  Duplicates take the aggregate path.
+            sh = np.sort(hashes)
+            if (sh[1:] == sh[:-1]).any():
+                uh, first, inv = np.unique(hashes, return_index=True,
+                                           return_inverse=True)
+                agg = np.zeros(uh.size, dtype=np.int64)
+                np.add.at(agg, inv, counts)
+                values = np.asarray(values, dtype=object)[first]
+                hashes, counts = uh, agg
+        if len(self._index):
+            pos = self._index.get_indexer(hashes)
+            hit = np.flatnonzero(pos >= 0)
+            # per-batch keys are unique, so the fancy add is alias-free
+            self._counts[pos[hit]] += counts[hit]
+            miss = np.flatnonzero(pos < 0)
+        else:
+            miss = np.arange(len(counts))
+        if not miss.size:
+            return
+        # Append new keys with value slots DEFERRED: at high cardinality
+        # most of this batch's new keys are evicted by the very next
+        # compaction, so materializing only the survivors' values keeps
+        # the per-batch object traffic at O(capacity), not O(distinct).
+        start = len(self._counts)
+        self._index = self._index.append(
+            pd.Index(hashes[miss], copy=False))
+        self._counts = np.concatenate([self._counts, counts[miss]])
+        self._values = np.concatenate(
+            [self._values, np.empty(miss.size, dtype=object)])
+        if len(self._index) > self.capacity:
+            kept_new = self._compact(start)
+            src = miss[kept_new]        # compaction preserves order, so
+        else:                           # survivors of the new chunk are
+            src = miss                  # the tail of the store
+        n_new = src.size
+        if n_new:
+            self._values[len(self._values) - n_new:] = \
+                np.asarray(values, dtype=object)[src]
+
+    def _append(self, hashes: np.ndarray, counts: np.ndarray,
+                values: np.ndarray) -> None:
+        self._index = self._index.append(
+            pd.Index(np.asarray(hashes, dtype=np.uint64), copy=False))
+        self._counts = np.concatenate([self._counts, counts])
+        self._values = np.concatenate([self._values, values])
+
+    def _compact(self, new_start: int = 0) -> np.ndarray:
+        """Misra-Gries decrement step, batched: subtract the
+        (capacity+1)-th largest count from everyone, drop the
+        non-positive.  Returns the keep-mask slice for entries at
+        ``new_start:`` (whose value slots the caller fills in)."""
         self.overflowed = True
-        arr = np.fromiter(self.counts.values(), dtype=np.int64,
-                          count=len(self.counts))
-        # subtract the (capacity+1)-th largest count from everyone (the
-        # Misra-Gries decrement step, batched), drop the non-positive
+        arr = self._counts
         kth = np.partition(arr, -(self.capacity + 1))[-(self.capacity + 1)]
         self.offset += int(kth)
-        self.counts = {v: c - kth for v, c in self.counts.items() if c > kth}
+        keep = arr > kth
+        self._index = self._index[keep]
+        self._counts = arr[keep] - kth
+        self._values = self._values[keep]
+        return keep[new_start:]
 
     def merge(self, other: "MisraGries") -> None:
-        for v, c in other.counts.items():
-            self.counts[v] = self.counts.get(v, 0) + c
+        """Fold another summary in, keyed on VALUES rather than hashes:
+        the two stores may come from processes whose hash implementations
+        differ (native C++ vs pandas fallback — the same heterogeneous
+        deployment the HLL host-fold gates on in backends/tpu.py), and a
+        hash-keyed fold would then split one value across two entries.
+        Cold path: runs once per profile over O(capacity) entries.  After
+        a cross-implementation merge the hash index may hold foreign
+        keys, so ``update_batch`` must not be called again — in
+        production merges happen only after the scan completes."""
+        if len(other._index):
+            vidx = pd.Index(self._values)
+            pos = vidx.get_indexer(other._values)
+            hit = np.flatnonzero(pos >= 0)
+            self._counts[pos[hit]] += other._counts[hit]
+            miss = np.flatnonzero(pos < 0)
+            if miss.size:
+                self._append(other._index.to_numpy()[miss],
+                             other._counts[miss], other._values[miss])
+                if len(self._index) > self.capacity:
+                    self._compact()
         self.offset += other.offset
         self.overflowed |= other.overflowed
-        if len(self.counts) > self.capacity:
-            self._compact()
+
+    @property
+    def counts(self) -> Dict[object, int]:
+        """Dict view (value -> estimated count); built on demand — the
+        hot path never materializes it."""
+        return {v: int(c) for v, c in zip(self._values, self._counts)}
 
     @property
     def exact(self) -> bool:
@@ -70,12 +177,13 @@ class MisraGries:
         return not self.overflowed
 
     def top(self, k: int) -> List[Tuple[object, int]]:
-        items = sorted(self.counts.items(), key=lambda kv: -kv[1])[:k]
-        return [(v, int(c)) for v, c in items]
+        order = np.argsort(-self._counts, kind="stable")[:k]
+        return [(self._values[int(i)], int(self._counts[int(i)]))
+                for i in order]
 
     def distinct_count(self) -> Optional[int]:
         """Exact distinct count, or None if the summary overflowed."""
-        return len(self.counts) if self.exact else None
+        return len(self._index) if self.exact else None
 
     def candidates(self) -> Iterable[object]:
-        return self.counts.keys()
+        return list(self._values)
