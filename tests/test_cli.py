@@ -61,8 +61,8 @@ def test_dict_contract_snapshot():
     needs editing, the renderer and BOTH backends must change together
     (SURVEY §1: 'the single most important compatibility requirement')."""
     assert sorted(schema.COMMON_FIELDS) == [
-        "count", "distinct_count", "is_unique", "memorysize", "n_missing",
-        "p_missing", "p_unique", "type"]
+        "count", "distinct_approx", "distinct_count", "is_unique",
+        "memorysize", "n_missing", "p_missing", "p_unique", "type"]
     assert sorted(schema.NUM_FIELDS) == sorted(schema.COMMON_FIELDS + [
         "mean", "std", "variance", "min", "max", "range", "sum",
         "p5", "p25", "p50", "p75", "p95", "iqr", "cv", "mad",

@@ -64,8 +64,9 @@ def test_fallback_when_native_absent(monkeypatch):
     out = ia._hash64(np.array([1.5, 2.5, np.nan]))
     assert out.dtype == np.uint64 and out.shape == (3,)
     dvals = np.array(["a", "b"], dtype=object)
-    out = ia._hash64_dictionary(pa.array(["a", "b"]), dvals)
+    out, kind = ia._hash64_dictionary(pa.array(["a", "b"]), dvals)
     assert out.dtype == np.uint64 and len(np.unique(out)) == 2
+    assert kind == "pandas"
 
 
 @requires_native

@@ -1,0 +1,142 @@
+"""Exact UNIQUE classification at scale (kernels/unique.py).
+
+The reference's ``distinct == n -> UNIQUE`` rule is exact (SURVEY §2.1);
+these tests pin that tpuprof keeps it exact even after the Misra-Gries
+summary overflows, and that the approximation tier announces itself.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfileReport, schema
+from tpuprof.kernels import unique as kunique
+
+
+class TestUniqueTracker:
+    def test_within_batch_duplicate(self):
+        t = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+        t.update("c", np.array([1, 2, 2, 3], dtype=np.uint64))
+        assert t.status["c"] == kunique.DUP
+
+    def test_cross_batch_duplicate(self):
+        t = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+        t.update("c", np.arange(100, dtype=np.uint64))
+        assert t.status["c"] == kunique.UNIQUE
+        t.update("c", np.arange(100, 200, dtype=np.uint64))
+        assert t.status["c"] == kunique.UNIQUE
+        t.update("c", np.array([150], dtype=np.uint64))
+        assert t.status["c"] == kunique.DUP
+
+    def test_budget_overflow_frees_state(self):
+        t = kunique.UniqueTracker(["c"], 100, 1 << 20)
+        t.update("c", np.arange(101, dtype=np.uint64))
+        assert t.status["c"] == kunique.OVERFLOW
+        assert t._rows["c"] == 0 and not t._chunks["c"]
+        # demoted columns ignore further updates
+        t.update("c", np.array([1, 1], dtype=np.uint64))
+        assert t.status["c"] == kunique.OVERFLOW
+
+    def test_global_budget(self):
+        t = kunique.UniqueTracker(["a", "b"], 1 << 20, 150)
+        t.update("a", np.arange(100, dtype=np.uint64))
+        t.update("b", np.arange(100, dtype=np.uint64))
+        # second column pushed the global live count past the cap
+        assert kunique.OVERFLOW in (t.status["a"], t.status["b"])
+
+    def test_many_chunks_still_detects(self):
+        t = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+        for i in range(20):                 # > chunk-fold threshold
+            t.update("c", np.arange(i * 10, (i + 1) * 10, dtype=np.uint64))
+        assert t.status["c"] == kunique.UNIQUE
+        t.update("c", np.array([37], dtype=np.uint64))
+        assert t.status["c"] == kunique.DUP
+
+    def test_merge_laws(self):
+        def fresh(status_a, status_b):
+            a = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+            b = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+            a.status["c"], b.status["c"] = status_a, status_b
+            return a, b
+
+        a, b = fresh(kunique.OVERFLOW, kunique.DUP)
+        a.merge(b)
+        assert a.status["c"] == kunique.DUP     # dup anywhere is definitive
+        a, b = fresh(kunique.UNIQUE, kunique.OVERFLOW)
+        a.merge(b)
+        assert a.status["c"] == kunique.OVERFLOW
+
+    def test_merge_detects_cross_host_duplicate(self):
+        a = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+        b = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+        a.update("c", np.arange(0, 100, dtype=np.uint64))
+        b.update("c", np.arange(99, 200, dtype=np.uint64))   # 99 on both
+        a.merge(b)
+        assert a.status["c"] == kunique.DUP
+
+    def test_disabled_budget(self):
+        t = kunique.UniqueTracker(["c"], 0, 1 << 20)
+        assert t.status["c"] == kunique.OVERFLOW
+
+    def test_hash_kind_switch_demotes(self):
+        # native and pandas hash the same value differently, so a column
+        # whose stream switches implementations cannot be compared
+        # exactly — it must stop claiming uniqueness, not miss dups
+        t = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+        t.update("c", np.arange(10, dtype=np.uint64), hash_kind="native")
+        t.update("c", np.arange(20, 30, dtype=np.uint64),
+                 hash_kind="pandas")
+        assert t.status["c"] == kunique.OVERFLOW
+
+    def test_merge_across_hash_kinds_demotes(self):
+        a = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+        b = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+        a.update("c", np.arange(100, dtype=np.uint64), hash_kind="native")
+        # same value on both hosts under different hashes: the dup is
+        # invisible, so the merged claim must be OVERFLOW, never UNIQUE
+        b.update("c", np.arange(200, 300, dtype=np.uint64),
+                 hash_kind="pandas")
+        a.merge(b)
+        assert a.status["c"] == kunique.OVERFLOW
+
+
+@pytest.fixture(scope="module")
+def n_rows():
+    return 20_000      # well past the default topk_capacity of 4096
+
+
+class TestUniqueClassification:
+    def test_unique_id_column_past_mg_capacity(self, n_rows):
+        # reference semantics: an all-unique ID column is UNIQUE no
+        # matter its cardinality (the old HLL fallback classified it CAT)
+        df = pd.DataFrame({"uid": [f"u{i:07d}" for i in range(n_rows)],
+                           "x": np.arange(n_rows, dtype=np.float32)})
+        r = ProfileReport(df, backend="tpu")
+        v = r.description["variables"]["uid"]
+        assert v["type"] == schema.UNIQUE
+        assert v["distinct_count"] == n_rows and v["is_unique"]
+        assert not v["distinct_approx"]
+
+    def test_almost_unique_is_cat(self, n_rows):
+        ids = [f"u{i:07d}" for i in range(n_rows)]
+        ids[-1] = ids[0]                      # one duplicate
+        df = pd.DataFrame({"uid": ids,
+                           "x": np.arange(n_rows, dtype=np.float32)})
+        r = ProfileReport(df, backend="tpu")
+        v = r.description["variables"]["uid"]
+        assert v["type"] == schema.CAT
+        assert not v["is_unique"]
+        assert v["distinct_count"] <= n_rows - 1
+
+    def test_overflow_tier_warns(self, n_rows):
+        df = pd.DataFrame({"uid": [f"u{i:07d}" for i in range(n_rows)],
+                           "x": np.arange(n_rows, dtype=np.float32)})
+        r = ProfileReport(df, backend="tpu", unique_track_rows=256)
+        v = r.description["variables"]["uid"]
+        assert v["type"] == schema.CAT        # tracker overflowed: estimate
+        assert v["distinct_approx"]
+        kinds = [m.kind for m in r.description["messages"]
+                 if m.column == "uid"]
+        assert schema.MSG_APPROX_DISTINCT in kinds
+        assert "distinct\n      count is approximate" in r.html \
+            or "count is approximate" in r.html

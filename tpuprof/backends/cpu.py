@@ -151,6 +151,9 @@ def _common_fields(series: pd.Series, n: int) -> Dict[str, Any]:
         "distinct_count": distinct,
         "p_unique": distinct / count if count else 0.0,
         "is_unique": count > 0 and distinct == count,
+        # the oracle counts distincts exactly; the TPU backend sets this
+        # when a column's distinct count fell back to the HLL estimate
+        "distinct_approx": False,
         "memorysize": float(series.memory_usage(index=False, deep=True)),
     }
 

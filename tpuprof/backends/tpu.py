@@ -45,7 +45,9 @@ from tpuprof.kernels import corr as kcorr
 from tpuprof.kernels import hll as khll
 from tpuprof.kernels import moments as kmoments
 from tpuprof.kernels import histogram as khistogram
+from tpuprof.kernels import unique as kunique
 from tpuprof.kernels.topk import MisraGries
+from tpuprof.kernels.unique import UniqueTracker
 from tpuprof.runtime.mesh import MeshRunner
 from tpuprof.utils.trace import log_event, phase_timer
 
@@ -74,6 +76,11 @@ class HostAgg:
         self.mg: Dict[str, MisraGries] = {
             s.name: MisraGries(config.topk_capacity)
             for s in plan.by_role("cat")}
+        # exact "duplicate seen" flags: restores the reference's exact
+        # UNIQUE classification for columns whose MG summary overflows
+        self.unique = UniqueTracker(
+            (s.name for s in plan.by_role("cat")),
+            config.unique_track_rows, config.unique_track_total_rows)
         self.cat_null: Dict[str, int] = {s.name: 0 for s in plan.by_role("cat")}
         self.date_min: Dict[str, int] = {}
         self.date_max: Dict[str, int] = {}
@@ -99,6 +106,15 @@ class HostAgg:
                 self.mg[name].update_batch(
                     dvals[nz], cnt[nz],
                     hashes=dh[nz] if dh is not None else None)
+                if self.unique.active(name):
+                    if dh is None:
+                        # batch prepared without hashes: coverage broken,
+                        # an exact "no duplicate" claim is no longer safe
+                        self.unique.deactivate(name)
+                    else:
+                        kind = (hb.cat_hash_kind or {}).get(name, "")
+                        self.unique.update(name, dh[codes[valid]],
+                                           hash_kind=kind)
             if first:
                 self.first_values[name] = [
                     dvals[c] if c >= 0 else None for c in codes[:5]]
@@ -456,6 +472,7 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
     kinds: Dict[str, str] = {}
     commons: Dict[str, Dict[str, Any]] = {}
     for spec in plan.specs:
+        distinct_approx = False
         if spec.role == "num":
             lane = spec.num_lane
             n_missing = int(momf["n_missing"][lane])
@@ -467,31 +484,55 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
             else:
                 distinct = int(round(hll_est[spec.hash_lane]))
                 distinct = max(min(distinct, count), 1 if count else 0)
+                distinct_approx = count > 0
         elif spec.role == "date":
             n_missing = hostagg.date_null[spec.name]
             count = n - n_missing
             distinct = int(round(hll_est[spec.hash_lane]))
             distinct = max(min(distinct, count), 1 if count else 0)
+            distinct_approx = count > 0
         else:
             n_missing = hostagg.cat_null[spec.name]
             count = n - n_missing
             mg = hostagg.mg[spec.name]
             exact_distinct = mg.distinct_count()
-            distinct = exact_distinct if exact_distinct is not None \
-                else max(min(int(round(hll_est[spec.hash_lane])), count),
-                         1 if count else 0)
+            if exact_distinct is not None:
+                distinct = exact_distinct
+            else:
+                # MG overflowed — but the duplicate tracker keeps the
+                # reference's exact `distinct == count -> UNIQUE` rule
+                # (kernels/unique.py); only the OVERFLOW tier is an
+                # estimate, and it says so in the report warnings
+                est = max(min(int(round(hll_est[spec.hash_lane])), count),
+                          1 if count else 0)
+                status = hostagg.unique.status.get(spec.name)
+                if status == kunique.UNIQUE:
+                    distinct = count        # no duplicate in any row: exact
+                elif status == kunique.DUP:
+                    distinct = min(est, count - 1)  # a dup exists: < count
+                    distinct_approx = True
+                else:
+                    distinct = est
+                    distinct_approx = True
         commons[spec.name] = {
             "count": count,
             "n_missing": n_missing,
             "p_missing": n_missing / n if n else 0.0,
             "distinct_count": distinct,
             "p_unique": distinct / count if count else 0.0,
-            "is_unique": count > 0 and distinct == count,
+            # UNIQUE/is_unique are EXACT claims in the reference; an HLL
+            # estimate that happens to clamp to `count` must not make them
+            "is_unique": count > 0 and distinct == count
+            and not distinct_approx,
+            "distinct_approx": distinct_approx,
             # Arrow buffer bytes (the streamed-source analogue of the
             # reference's series.memory_usage)
             "memorysize": hostagg.memorysize(spec.name),
         }
-        kinds[spec.name] = schema.classify(spec.base_kind, distinct, count)
+        kind = schema.classify(spec.base_kind, distinct, count)
+        if kind == schema.UNIQUE and distinct_approx:
+            kind = schema.CAT
+        kinds[spec.name] = kind
 
     # ---- correlation rejection over refined-NUM columns ------------------
     num_specs = [s for s in plan.specs

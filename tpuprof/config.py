@@ -42,6 +42,15 @@ class ProfilerConfig:
                                     # ~= 1.04 / sqrt(2^p) (~2.3% at p=11)
     topk_capacity: int = 4096       # Misra-Gries candidate capacity per CAT
                                     # column; count error <= n / capacity
+    unique_track_rows: int = 1 << 22        # exact duplicate detection for
+                                            # CAT columns (kernels/unique.py):
+                                            # per-column row budget before the
+                                            # distinct count falls back to the
+                                            # HLL estimate (~32 MB/column held
+                                            # only while a column stays
+                                            # duplicate-free).  0 disables.
+    unique_track_total_rows: int = 1 << 25  # global cap across all columns
+                                            # (~256 MB worst case)
     exact_passes: bool = True       # second scan: exact histograms + exact
                                     # recount of top-k candidates (parity with
                                     # Spark's exact groupBy().count()).

@@ -105,8 +105,11 @@ class HostBatch:
     # 64-bit hashes of each column's dictionary values (aligned with
     # dict_vals), when this batch was prepared with hashes=True.  The
     # Misra-Gries store keys on these so its per-batch fold never hashes
-    # Python strings (tpuprof/kernels/topk.py).
+    # Python strings (tpuprof/kernels/topk.py).  cat_hash_kind records
+    # which implementation produced them ("native" | "pandas") — the
+    # exact-uniqueness tracker refuses to compare across implementations.
     cat_hashes: Optional[Dict[str, np.ndarray]] = None
+    cat_hash_kind: Optional[Dict[str, str]] = None
     # precision the hll column was packed with — MeshRunner refuses a
     # batch whose packing disagrees with its register width (a mismatched
     # idx would silently scatter into NEIGHBORING columns' registers)
@@ -148,14 +151,19 @@ def _num_keys(values: np.ndarray) -> np.ndarray:
     return values.astype(np.int64, copy=False).view(np.uint64)
 
 
-def _hash64_dictionary(dictionary, dvals: np.ndarray) -> np.ndarray:
+def _hash64_dictionary(dictionary, dvals: np.ndarray
+                       ) -> Tuple[np.ndarray, str]:
     """Hash a batch's string dictionary: native buffer path when possible,
-    else pandas over the materialized object values."""
+    else pandas over the materialized object values.  Also returns which
+    implementation ran ("native" | "pandas"): the two produce DIFFERENT
+    hashes for the same value, and the native path can decline per batch
+    (unusual layouts), so exact-uniqueness tracking must know when a
+    column's hash stream changed implementations (kernels/unique.py)."""
     from tpuprof import native
     h = native.hash_string_dictionary(dictionary)
     if h is not None:
-        return h
-    return pd.util.hash_array(dvals).astype(np.uint64)
+        return h, "native"
+    return pd.util.hash_array(dvals).astype(np.uint64), "pandas"
 
 
 def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
@@ -184,6 +192,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     row_valid[:n] = True
     cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
     cat_hashes: Dict[str, np.ndarray] = {}
+    cat_hash_kind: Dict[str, str] = {}
     date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
 
     col_nbytes: Dict[str, int] = {}
@@ -242,12 +251,15 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
             dvals = np.asarray(combined.dictionary.to_pandas(), dtype=object)
             if hashes:
                 if dvals.size:
-                    dh = _hash64_dictionary(combined.dictionary, dvals)
+                    dh, hkind = _hash64_dictionary(combined.dictionary,
+                                                   dvals)
                     h64 = dh[codes]
                 else:
                     dh = np.zeros(0, dtype=np.uint64)
+                    hkind = ""
                     h64 = np.zeros(n, dtype=np.uint64)
                 cat_hashes[spec.name] = dh
+                cat_hash_kind[spec.name] = hkind
                 hll_packed[:n, spec.hash_lane] = khll.pack(
                     h64, valid, hll_precision)
             cat_codes[spec.name] = (np.where(valid, codes, -1), dvals)
@@ -268,6 +280,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     return HostBatch(nrows=n, x=x, row_valid=row_valid, hll=hll_packed,
                      cat_codes=cat_codes, date_ints=date_ints,
                      cat_hashes=cat_hashes if hashes else None,
+                     cat_hash_kind=cat_hash_kind if hashes else None,
                      hll_precision=hll_precision, col_nbytes=col_nbytes,
                      col_dict_nbytes=col_dict_nbytes)
 

@@ -165,6 +165,36 @@ class MisraGries:
         self.offset += other.offset
         self.overflowed |= other.overflowed
 
+    def __getstate__(self) -> Dict[str, object]:
+        """Stable pickle layout (checkpoints, cross-host gathers)."""
+        return {"capacity": self.capacity, "offset": self.offset,
+                "overflowed": self.overflowed,
+                "hashes": self._index.to_numpy(),
+                "count_arr": self._counts, "values": self._values}
+
+    def __setstate__(self, state) -> None:
+        if isinstance(state, tuple):        # default __slots__ protocol
+            state = {**(state[0] or {}), **(state[1] or {})}
+        self.capacity = int(state["capacity"])
+        self.offset = int(state["offset"])
+        self.overflowed = bool(state["overflowed"])
+        if "hashes" in state:
+            self._index = pd.Index(
+                np.asarray(state["hashes"], dtype=np.uint64))
+            self._counts = np.asarray(state["count_arr"], dtype=np.int64)
+            self._values = np.asarray(state["values"], dtype=object)
+        else:
+            # legacy dict-backed layout (pre-v4 checkpoints): tolerate it
+            # so old artifacts unpickle far enough for the checkpoint
+            # version check to reject them cleanly
+            d = state.get("counts", {})
+            self._values = np.array(list(d.keys()), dtype=object)
+            self._counts = np.fromiter(d.values(), dtype=np.int64,
+                                       count=len(d))
+            self._index = pd.Index(_fallback_hashes(self._values)
+                                   if len(d) else
+                                   np.zeros(0, dtype=np.uint64))
+
     @property
     def counts(self) -> Dict[object, int]:
         """Dict view (value -> estimated count); built on demand — the

@@ -1,0 +1,132 @@
+"""Exact per-column duplicate detection from the host hash stream.
+
+Why this exists: the reference's ``distinct == n → UNIQUE`` type
+classification (SURVEY.md §2.1) is EXACT — Spark's countDistinct scans
+every value.  tpuprof's categorical distinct counts come from a
+Misra-Gries summary while it fits (exact) and the HLL estimate after it
+overflows (±1.04/√2¹¹ ≈ 2.3%), and an estimate essentially never equals
+``count`` — so a 1M-row all-unique ID column would silently classify CAT
+instead of UNIQUE.  This tracker restores the exact answer to the one
+question classification needs — "was any value seen twice?" — without
+exact distinct counting.
+
+Mechanism: per column, keep every seen 64-bit value hash in sorted
+chunks; each batch is sorted (exposing within-batch duplicates) and
+probed against the chunks with ``searchsorted``.  The first duplicate
+DEMOTES the column to ``DUP`` and frees its storage — for non-unique
+columns (the common case) that happens within the first batch or two, so
+memory concentrates on genuinely-unique columns only.  A per-column and
+a global row budget bound that worst case; columns past budget demote to
+``OVERFLOW`` and classification falls back to the HLL estimate with an
+explicit approximation warning in the report (schema.MSG_APPROX_DISTINCT).
+
+A 64-bit hash collision can mask a truly-unique column as DUP with
+probability ~n²/2⁶⁵ (≈3e-8 at n=1e6) — the same collision contract the
+HLL plane and the top-k store already accept (ingest/arrow.py).
+
+Merge law (multi-host, SURVEY §4.2): DUP anywhere is definitive; else
+OVERFLOW anywhere is OVERFLOW; else the peer's chunks fold in through
+the same probe path, so cross-host duplicates are detected exactly while
+the combined rows fit the budget.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+import numpy as np
+
+UNIQUE = "unique"       # no duplicate among all rows seen so far (exact)
+DUP = "dup"             # at least one duplicate seen (exact)
+OVERFLOW = "overflow"   # gave up within budget — distinct is approximate
+
+
+class UniqueTracker:
+    """Tracks, per column, whether any value hash occurred twice."""
+
+    def __init__(self, names: Iterable[str], budget_rows: int,
+                 total_budget_rows: int):
+        self.budget = int(budget_rows)
+        self.total_budget = int(total_budget_rows)
+        names = list(names)
+        self.status: Dict[str, str] = {}
+        self._chunks: Dict[str, List[np.ndarray]] = {}
+        self._rows: Dict[str, int] = {}
+        self._kind: Dict[str, str] = {}   # hash implementation per column
+        self._live = 0          # rows held across all still-UNIQUE columns
+        disabled = self.budget <= 0 or self.total_budget <= 0
+        for n in names:
+            self.status[n] = OVERFLOW if disabled else UNIQUE
+            self._chunks[n] = []
+            self._rows[n] = 0
+            self._kind[n] = ""
+
+    def active(self, name: str) -> bool:
+        return self.status.get(name) == UNIQUE
+
+    def deactivate(self, name: str, status: str = OVERFLOW) -> None:
+        """Give up exact tracking for a column (e.g. a batch arrived
+        without hashes, so coverage can no longer be guaranteed)."""
+        self._demote(name, status)
+
+    def _demote(self, name: str, status: str) -> None:
+        self._live -= self._rows[name]
+        self._rows[name] = 0
+        self._chunks[name] = []
+        self.status[name] = status
+
+    def update(self, name: str, hashes: np.ndarray,
+               hash_kind: str = "") -> None:
+        """Fold one batch's valid-row hashes (duplicates included) in.
+
+        ``hash_kind`` names the implementation that produced the hashes
+        ("native" | "pandas"); the same value hashes DIFFERENTLY under
+        the two, so a column whose stream switches implementations can
+        no longer be compared exactly and demotes to OVERFLOW."""
+        if self.status.get(name) != UNIQUE:
+            return
+        h = np.asarray(hashes, dtype=np.uint64)
+        if not h.size:
+            return
+        if hash_kind:
+            if self._kind[name] and self._kind[name] != hash_kind:
+                self._demote(name, OVERFLOW)
+                return
+            self._kind[name] = hash_kind
+        sh = np.sort(h)
+        if sh.size > 1 and (sh[1:] == sh[:-1]).any():
+            self._demote(name, DUP)
+            return
+        for c in self._chunks[name]:
+            pos = np.searchsorted(c, sh)
+            inb = pos < c.size
+            if inb.any() and (c[pos[inb]] == sh[inb]).any():
+                self._demote(name, DUP)
+                return
+        self._chunks[name].append(sh)
+        self._rows[name] += sh.size
+        self._live += sh.size
+        if self._rows[name] > self.budget or self._live > self.total_budget:
+            self._demote(name, OVERFLOW)
+            return
+        if len(self._chunks[name]) > 8:
+            # keep the probe loop short: fold the chunk list back into
+            # one sorted array (amortized O(n log n) per column)
+            self._chunks[name] = [np.sort(np.concatenate(
+                self._chunks[name]))]
+
+    def merge(self, other: "UniqueTracker") -> None:
+        for name, ost in other.status.items():
+            if name not in self.status:
+                continue
+            if DUP in (self.status[name], ost):
+                self._demote(name, DUP)
+            elif OVERFLOW in (self.status[name], ost):
+                self._demote(name, OVERFLOW)
+            else:
+                # a cross-host duplicate is only detectable when both
+                # hosts hashed with the same implementation; otherwise an
+                # exact "no duplicate" claim would be unsound
+                okind = other._kind.get(name, "")
+                for c in other._chunks[name]:
+                    self.update(name, c, hash_kind=okind)

@@ -26,7 +26,11 @@ import numpy as np
 # the host blob); the pass-A device state lost its "qs" and "step"
 # leaves.  v2 and earlier checkpoints neither restore nor merge
 # correctly, so they are rejected at load time.
-FORMAT_VERSION = 3
+# v4: the host blob changed shape (hash-keyed Misra-Gries stores, the
+# HostAgg uniqueness tracker) and the file layout became header-first —
+# a small version header pickled BEFORE the payload, so a mismatched
+# version is rejected without unpickling a possibly-incompatible blob.
+FORMAT_VERSION = 4
 
 
 def _flatten(tree: Any) -> Dict[str, np.ndarray]:
@@ -60,7 +64,6 @@ def save(path: str, state: Any, host_blob: Any, cursor: int,
     buf = io.BytesIO()
     np.savez(buf, **flat)
     payload = {
-        "format_version": FORMAT_VERSION,
         "arrays_npz": buf.getvalue(),
         "host_blob": host_blob,
         "cursor": int(cursor),
@@ -68,6 +71,8 @@ def save(path: str, state: Any, host_blob: Any, cursor: int,
     }
     tmp = path + ".tmp"
     with open(tmp, "wb") as fh:
+        pickle.dump({"format_version": FORMAT_VERSION}, fh,
+                    protocol=pickle.HIGHEST_PROTOCOL)
         pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
     import os
     os.replace(tmp, path)
@@ -75,12 +80,20 @@ def save(path: str, state: Any, host_blob: Any, cursor: int,
 
 def load_payload(path: str) -> Dict[str, Any]:
     """Read and version-check the raw checkpoint payload (one disk read;
-    materialize the device state separately with :func:`materialize`)."""
+    materialize the device state separately with :func:`materialize`).
+
+    The version header is a separate leading pickle so a mismatched
+    format is rejected BEFORE the host blob (whose classes may have
+    changed incompatibly) is ever unpickled.  Pre-v4 files were one
+    single pickle whose dict carried format_version inline — the first
+    load then yields that whole dict and the check still rejects it."""
     with open(path, "rb") as fh:
+        header = pickle.load(fh)
+        version = header.get("format_version") \
+            if isinstance(header, dict) else None
+        if version != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint format {version}")
         payload = pickle.load(fh)
-    if payload.get("format_version") != FORMAT_VERSION:
-        raise ValueError(
-            f"unsupported checkpoint format {payload.get('format_version')}")
     return payload
 
 

@@ -141,6 +141,7 @@ def _merge_pair(a, b):
         a.col_dict_nbytes[name] = a.col_dict_nbytes.get(name, 0) + nb
     for name, mg in b.mg.items():
         a.mg[name].merge(mg)
+    a.unique.merge(b.unique)
     for name, cnt in b.cat_null.items():
         a.cat_null[name] += cnt
     for name, cnt in b.date_null.items():

@@ -55,6 +55,9 @@ MSG_SKEWED = "SKEWED"
 MSG_CONST = "CONST"
 MSG_UNIQUE = "UNIQUE"
 MSG_CORR = "CORR"
+# a CAT column's distinct count fell back to the HLL estimate (its
+# Misra-Gries summary and exact duplicate tracker both overflowed)
+MSG_APPROX_DISTINCT = "APPROX_DISTINCT"
 
 
 @dataclasses.dataclass
@@ -77,7 +80,7 @@ class Message:
 
 COMMON_FIELDS = [
     "type", "count", "n_missing", "p_missing", "distinct_count", "p_unique",
-    "is_unique", "memorysize",
+    "is_unique", "distinct_approx", "memorysize",
 ]
 
 NUM_FIELDS = COMMON_FIELDS + [
@@ -178,6 +181,11 @@ def derive_messages(
         elif kind == CAT:
             if v.get("distinct_count", 0) > config.high_cardinality_threshold:
                 msgs.append(Message(MSG_HIGH_CARDINALITY, name,
+                                    v["distinct_count"]))
+            if v.get("distinct_approx"):
+                # only CAT warns: approximate distincts can change the
+                # UNIQUE/CAT call there, and only past both exact tiers
+                msgs.append(Message(MSG_APPROX_DISTINCT, name,
                                     v["distinct_count"]))
         elif kind == NUM:
             skew = v.get("skewness")
