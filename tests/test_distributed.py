@@ -140,3 +140,45 @@ def test_scan_a_matches_sequential_steps():
     np.testing.assert_allclose(f1["variance"], f2["variance"], rtol=1e-5)
     np.testing.assert_array_equal(np.asarray(r1["hll"]),
                                   np.asarray(r2["hll"]))
+
+
+def test_scan_b_matches_sequential_steps():
+    """The multi-batch scan_b dispatch must fold histograms+MAD exactly
+    like repeated step_b calls, on a full 8-device mesh."""
+    import jax
+    from tpuprof.config import ProfilerConfig
+    from tpuprof.ingest.arrow import HostBatch
+    from tpuprof.runtime.mesh import MeshRunner
+
+    rng = np.random.default_rng(1)
+    config = ProfilerConfig(batch_rows=64, bins=7)
+    runner = MeshRunner(config, n_num=5, n_hash=0,
+                        devices=jax.devices()[:8])
+    hbs = []
+    for _ in range(3):
+        x = np.asfortranarray(
+            rng.normal(3.0, 2.0, (runner.rows, 5)).astype(np.float32))
+        x[rng.random((runner.rows, 5)) < 0.1] = np.nan
+        rv = np.ones(runner.rows, dtype=bool)
+        rv[-5:] = False
+        hbs.append(HostBatch(nrows=runner.rows - 5, x=x, row_valid=rv,
+                             hll=np.zeros((runner.rows, 0), np.uint16),
+                             cat_codes={}, date_ints={}))
+
+    lo = np.full(5, -4.0, dtype=np.float32)
+    hi = np.full(5, 10.0, dtype=np.float32)
+    mean = np.full(5, 3.0, dtype=np.float32)
+    s1 = runner.init_pass_b()
+    for hb in hbs:
+        s1 = runner.step_b(s1, hb, lo, hi, mean)
+    r1 = runner.finalize_b(s1)
+
+    s2 = runner.init_pass_b()
+    s2 = runner.scan_b(s2, runner.stage_batches(hbs, with_hll=False),
+                       lo, hi, mean)
+    r2 = runner.finalize_b(s2)
+
+    np.testing.assert_array_equal(np.asarray(r1["counts"]),
+                                  np.asarray(r2["counts"]))
+    np.testing.assert_allclose(np.asarray(r1["abs_dev"]),
+                               np.asarray(r2["abs_dev"]), rtol=1e-6)

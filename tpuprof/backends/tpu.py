@@ -362,10 +362,7 @@ class TPUStatsBackend:
                 and hostagg.n_rows > 0:
             recounter = Recounter(hostagg)
             state_b = runner.init_pass_b()
-            lo, hi, mean = momf["fmin"], momf["fmax"], momf["mean"]
-            lo = np.where(np.isfinite(lo), lo, 0.0)
-            hi = np.where(np.isfinite(hi), hi, 0.0)
-            mean_c = np.where(np.isfinite(mean), mean, 0.0)
+            lo, hi, mean_c = khistogram.pass_b_bounds(momf)
             lo_d = runner.put_replicated(lo, dtype=np.float32)
             hi_d = runner.put_replicated(hi, dtype=np.float32)
             mean_d = runner.put_replicated(mean_c, dtype=np.float32)

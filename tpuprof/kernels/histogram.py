@@ -55,6 +55,20 @@ def merge(a: HistState, b: HistState) -> HistState:
             "abs_dev": a["abs_dev"] + b["abs_dev"]}
 
 
+def pass_b_bounds(momf):
+    """(lo, hi, mean) for the pass-B binning/MAD kernels from finalized
+    pass-A moments, with non-finite entries (all-NaN columns) clamped to
+    0 so the kernel's bin math stays well-defined.  Single source of
+    truth for the backend (backends/tpu.py) and the benchmark — the two
+    must time the same recipe."""
+    import numpy as np
+
+    lo = np.where(np.isfinite(momf["fmin"]), momf["fmin"], 0.0)
+    hi = np.where(np.isfinite(momf["fmax"]), momf["fmax"], 0.0)
+    mean = np.where(np.isfinite(momf["mean"]), momf["mean"], 0.0)
+    return lo, hi, mean
+
+
 def finalize(state, lo, hi, n, bins: int) -> Tuple["object", "object"]:
     """Host-side: (per-column (counts, edges) histograms, MAD array)."""
     import numpy as np

@@ -242,16 +242,27 @@ class MeshRunner:
 
         use_pallas = self.use_pallas
 
-        def local_step_b(state, xt, row_valid, lo, hi, mean):
-            s = _unstack(state)
+        def step_b_core(s, xt, row_valid, lo, hi, mean):
+            """One batch folded into an UNSTACKED per-device pass-B state —
+            shared by the single-batch program and the multi-batch
+            lax.scan program (same latency-amortization as scan_a)."""
             if use_pallas:
                 from tpuprof.kernels import pallas_hist
                 counts, abs_dev = pallas_hist.histogram_batch(
                     xt, row_valid, lo, hi, mean, s["counts"].shape[1])
-                out = {"counts": s["counts"] + counts,
-                       "abs_dev": s["abs_dev"] + abs_dev}
-            else:
-                out = histogram.update(s, xt.T, row_valid, lo, hi, mean)
+                return {"counts": s["counts"] + counts,
+                        "abs_dev": s["abs_dev"] + abs_dev}
+            return histogram.update(s, xt.T, row_valid, lo, hi, mean)
+
+        def local_step_b(state, xt, row_valid, lo, hi, mean):
+            return _restack(step_b_core(_unstack(state), xt, row_valid,
+                                        lo, hi, mean))
+
+        def local_scan_b(state, xts, row_valids, lo, hi, mean):
+            def body(carry, inp):
+                xt, rv = inp
+                return step_b_core(carry, xt, rv, lo, hi, mean), None
+            out, _ = jax.lax.scan(body, _unstack(state), (xts, row_valids))
             return _restack(out)
 
         def merge_corr_local(co, common_shift):
@@ -361,6 +372,12 @@ class MeshRunner:
             in_specs=(state_spec, cols_rows_spec, rows_spec, rep, rep, rep),
             out_specs=state_spec, check_vma=False),
             donate_argnums=(0,))
+        self._scan_b = jax.jit(shard_map(
+            local_scan_b, mesh=mesh,
+            in_specs=(state_spec, P(None, None, "data"), P(None, "data"),
+                      rep, rep, rep),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
         self._merge_a = jax.jit(shard_map(
             local_merge_a, mesh=mesh, in_specs=(state_spec,),
             out_specs=state_spec, check_vma=False))
@@ -408,6 +425,16 @@ class MeshRunner:
     def step_b(self, state: Pytree, hb, lo, hi, mean) -> Pytree:
         db = self._as_device(hb)
         return self._step_b(state, db.xt, db.row_valid,
+                            self.put_replicated(lo, dtype=jnp.float32),
+                            self.put_replicated(hi, dtype=jnp.float32),
+                            self.put_replicated(mean, dtype=jnp.float32))
+
+    def scan_b(self, state: Pytree, sb: "StackedBatch", lo, hi,
+               mean) -> Pytree:
+        """Fold ``sb.n_batches`` staged batches into the pass-B state in
+        one compiled dispatch (stage with ``with_hll=False`` — pass B
+        never reads the packed plane)."""
+        return self._scan_b(state, sb.xts, sb.row_valids,
                             self.put_replicated(lo, dtype=jnp.float32),
                             self.put_replicated(hi, dtype=jnp.float32),
                             self.put_replicated(mean, dtype=jnp.float32))
