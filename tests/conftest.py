@@ -1,14 +1,23 @@
 """Test env: force JAX onto the host CPU with 8 fake devices BEFORE any jax
 import (SURVEY.md §4.3 — the standard way to test multi-device pjit/shard_map
-programs without a pod).  Must run before any test module imports jax."""
+programs without a pod).  Must run before any test module imports jax.
+
+Real-TPU lane: ``TPUPROF_TPU_TESTS=1 python -m pytest -m tpu`` keeps the
+real accelerator platform instead, so ``@pytest.mark.tpu`` tests compile
+the pallas kernels with Mosaic on hardware (interpreter mode — the CPU
+default here — cannot catch Mosaic layout/VMEM regressions; see PERF.md
+"Mosaic scoped-VMEM rules").  The marked tests skip themselves on CPU."""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+_TPU_LANE = os.environ.get("TPUPROF_TPU_TESTS") == "1"
+
+if not _TPU_LANE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 # A site hook (e.g. a TPU-tunnel plugin) may have force-registered an
 # accelerator platform at interpreter start and overridden jax_platforms;
@@ -16,11 +25,22 @@ if "xla_force_host_platform_device_count" not in _flags:
 # never depends on (or hangs on) accelerator availability.
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_LANE:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pandas as pd
 import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    if _TPU_LANE:
+        return
+    skip_tpu = pytest.mark.skip(
+        reason="real-TPU lane: run with TPUPROF_TPU_TESTS=1 -m tpu")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip_tpu)
 
 
 @pytest.fixture

@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpuprof.kernels import corr, histogram, hll, moments, quantiles, topk
+from tpuprof.kernels import corr, histogram, hll, moments, topk
 
 
 def _np_batches(x, nb):
@@ -99,47 +99,6 @@ class TestCorr:
             jnp.ones(100, dtype=bool))
         rho = corr.finalize(jax.device_get(state))
         assert np.isnan(rho[0, 1]) and np.isnan(rho[0, 0])
-
-
-class TestQuantiles:
-    def test_exact_when_small(self):
-        rng = np.random.default_rng(2)
-        x = rng.normal(0, 10, (300, 2))
-        state = quantiles.init(2, k=512)           # n < K: sample == column
-        upd = jax.jit(quantiles.update)
-        for i, xb in enumerate(_np_batches(x, 4)):
-            state = upd(state, jnp.asarray(xb, dtype=jnp.float32),
-                        jnp.ones(xb.shape[0], dtype=bool),
-                        jax.random.key(i))
-        probes = (0.05, 0.25, 0.5, 0.75, 0.95)
-        q = quantiles.finalize(jax.device_get(state), probes)
-        for c in range(2):
-            np.testing.assert_allclose(
-                q[:, c], np.quantile(x[:, c], probes), rtol=1e-6)
-
-    def test_error_bound_large(self):
-        rng = np.random.default_rng(3)
-        n, k = 200_000, 4096
-        x = rng.gamma(2.0, 5.0, (n, 1))
-        state = quantiles.init(1, k=k)
-        upd = jax.jit(quantiles.update)
-        for i, xb in enumerate(_np_batches(x, 10)):
-            state = upd(state, jnp.asarray(xb, dtype=jnp.float32),
-                        jnp.ones(xb.shape[0], dtype=bool), jax.random.key(i))
-        q = quantiles.finalize(jax.device_get(state), (0.5,))
-        # rank error ~1/sqrt(K): the median estimate must sit within ±4
-        # sigma_rank of the true rank
-        sorted_x = np.sort(x[:, 0])
-        rank = np.searchsorted(sorted_x, q[0, 0]) / n
-        assert abs(rank - 0.5) < 4.0 / np.sqrt(k)
-
-    def test_nan_inf_excluded(self):
-        x = np.array([[1.0], [np.nan], [np.inf], [2.0], [3.0]])
-        state = jax.jit(quantiles.update)(
-            quantiles.init(1, 16), jnp.asarray(x, dtype=jnp.float32),
-            jnp.ones(5, dtype=bool), jax.random.key(0))
-        q = quantiles.finalize(jax.device_get(state), (0.0, 1.0))
-        assert q[0, 0] == 1.0 and q[1, 0] == 3.0
 
 
 class TestHLL:

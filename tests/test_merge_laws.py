@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpuprof.kernels import corr, hll, moments, quantiles
+from tpuprof.kernels import corr, hll, moments
 
 DISTS = ["normal", "lognormal", "constant", "allnan", "infmix", "bigmean"]
 
@@ -97,30 +97,6 @@ def test_corr_merge_law(dist):
         jax.jit(corr.merge)(_corr_state(a), _corr_state(b))))
     direct = corr.finalize(jax.device_get(_corr_state(np.vstack([a, b]))))
     np.testing.assert_allclose(merged, direct, atol=5e-3, equal_nan=True)
-
-
-def test_quantile_sketch_merge_is_topk_sample():
-    """The merged sketch must equal the sketch of the union stream: keep
-    the global top-K priorities."""
-    rng = np.random.default_rng(9)
-    xa, xb = rng.normal(0, 1, (500, 2)), rng.normal(5, 1, (300, 2))
-    k = 64
-
-    def sk(x, key):
-        return jax.jit(quantiles.update)(
-            quantiles.init(2, k), jnp.asarray(x, dtype=jnp.float32),
-            jnp.ones(x.shape[0], dtype=bool), jax.random.key(key))
-
-    sa, sb = sk(xa, 1), sk(xb, 2)
-    merged = jax.device_get(jax.jit(quantiles.merge)(sa, sb))
-    cat_p = np.concatenate([np.asarray(sa["prio"]), np.asarray(sb["prio"])],
-                           axis=1)
-    cat_v = np.concatenate([np.asarray(sa["values"]), np.asarray(sb["values"])],
-                           axis=1)
-    for c in range(2):
-        order = np.argsort(-cat_p[c], kind="stable")[:k]
-        np.testing.assert_allclose(np.sort(merged["values"][c]),
-                                   np.sort(cat_v[c][order]))
 
 
 def test_hll_merge_law_exact():

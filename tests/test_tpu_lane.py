@@ -1,0 +1,151 @@
+"""Real-TPU regression lane (@pytest.mark.tpu — VERDICT r1 Missing #5).
+
+The CPU suite exercises the pallas kernels only in interpreter mode,
+which cannot catch Mosaic-specific regressions (layout constraints,
+scoped-VMEM overflow — the exact failure classes PERF.md catalogues).
+These tests compile the kernels with Mosaic on the actual chip at small
+shapes and check them against the XLA twins / numpy.
+
+Run: ``TPUPROF_TPU_TESTS=1 python -m pytest -m tpu -q``
+(~3-4 min: each kernel pays one hardware compile).  Skipped by the
+normal CPU suite via conftest.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+@pytest.fixture(scope="module")
+def tpu_backend():
+    import jax
+    if jax.default_backend() == "cpu":
+        pytest.skip("no TPU backend visible")
+    return jax.default_backend()
+
+
+def _batch(rng, cols, rows):
+    xt = rng.normal(7.0, 3.0, (cols, rows)).astype(np.float32)
+    xt[rng.random((cols, rows)) < 0.07] = np.nan
+    rv = np.ones(rows, dtype=bool)
+    rv[-9:] = False
+    return xt, rv
+
+
+def _assert_fused_matches_xla(cols, rows):
+    import jax.numpy as jnp
+    from tpuprof.kernels import corr, fused, moments
+
+    rng = np.random.default_rng(cols)
+    xt, rv = _batch(rng, cols, rows)
+    shift = np.nanmean(xt, axis=1).astype(np.float32)
+
+    def init():
+        mom = moments.init(cols)
+        mom["shift"] = jnp.asarray(shift)
+        co = corr.init(cols)
+        co["shift"] = jnp.asarray(shift)
+        co["set"] = jnp.ones((), dtype=jnp.int32)
+        return mom, co
+
+    mom_p, co_p = fused.update(*init(), jnp.asarray(xt), jnp.asarray(rv))
+    mom_x, co_x = fused.update_xla(*init(), jnp.asarray(xt),
+                                   jnp.asarray(rv))
+    fp, fx = moments.finalize(mom_p), moments.finalize(mom_x)
+    np.testing.assert_array_equal(fp["n"], fx["n"])
+    np.testing.assert_allclose(fp["mean"], fx["mean"], rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(fp["variance"], fx["variance"], rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_array_equal(fp["min"], fx["min"])
+    np.testing.assert_array_equal(fp["max"], fx["max"])
+    rho_p, rho_x = corr.finalize(co_p), corr.finalize(co_x)
+    mask = np.isfinite(rho_x)
+    np.testing.assert_allclose(rho_p[mask], rho_x[mask], atol=5e-3)
+
+
+def test_fused_narrow_kernel_on_hardware(tpu_backend):
+    _assert_fused_matches_xla(cols=24, rows=2048)
+
+
+def test_fused_wide_column_tiled_kernel_on_hardware(tpu_backend):
+    from tpuprof.kernels import fused
+    cols = fused.MAX_FUSED_COLS + 64          # forces the wide tier
+    _assert_fused_matches_xla(cols=cols, rows=1024)
+
+
+def test_pallas_histogram_on_hardware(tpu_backend):
+    import jax.numpy as jnp
+    from tpuprof.kernels import histogram, pallas_hist
+
+    rng = np.random.default_rng(5)
+    cols, rows, bins = 12, 2048, 10
+    xt, rv = _batch(rng, cols, rows)
+    lo = np.nanmin(np.where(rv, xt, np.nan), axis=1).astype(np.float32)
+    hi = np.nanmax(np.where(rv, xt, np.nan), axis=1).astype(np.float32)
+    mean = np.nanmean(np.where(rv, xt, np.nan), axis=1).astype(np.float32)
+
+    counts, abs_dev = pallas_hist.histogram_batch(
+        jnp.asarray(xt), jnp.asarray(rv), jnp.asarray(lo),
+        jnp.asarray(hi), jnp.asarray(mean), bins)
+    state = histogram.update(histogram.init(cols, bins), jnp.asarray(xt.T),
+                             jnp.asarray(rv), jnp.asarray(lo),
+                             jnp.asarray(hi), jnp.asarray(mean))
+    np.testing.assert_array_equal(np.asarray(counts),
+                                  np.asarray(state["counts"]))
+    np.testing.assert_allclose(np.asarray(abs_dev),
+                               np.asarray(state["abs_dev"]), rtol=1e-4)
+
+
+def _grid_rank_reference(xt, rv, grid):
+    """Numpy mirror of fused._grid_ranks + the corr Gram contract."""
+    finite = rv[None, :] & np.isfinite(xt)
+    lt = (grid[:, :, None] < xt[:, None, :]).sum(axis=1)
+    le = (grid[:, :, None] <= xt[:, None, :]).sum(axis=1)
+    rank = (lt + le) * (0.5 / grid.shape[1])
+    return np.where(finite, rank, np.nan)
+
+
+def test_spearman_grid_narrow_on_hardware(tpu_backend):
+    import jax.numpy as jnp
+    from tpuprof.kernels import corr, fused
+
+    rng = np.random.default_rng(9)
+    cols, rows, G = 16, 2048, 64
+    xt, rv = _batch(rng, cols, rows)
+    grid = np.sort(rng.normal(7.0, 3.0, (cols, G)).astype(np.float32),
+                   axis=1)
+
+    co = corr.init(cols)
+    co["shift"] = jnp.full((cols,), 0.5, dtype=jnp.float32)
+    co["set"] = jnp.ones((), dtype=jnp.int32)
+    co = fused.spearman_update(co, jnp.asarray(xt), jnp.asarray(rv),
+                               jnp.asarray(grid))
+    rho = corr.finalize(co)
+
+    ranks = _grid_rank_reference(xt, rv, grid)      # (cols, rows)
+    co2 = corr.init(cols)
+    co2["shift"] = jnp.full((cols,), 0.5, dtype=jnp.float32)
+    co2["set"] = jnp.ones((), dtype=jnp.int32)
+    ref = corr.finalize(corr.update(co2, jnp.asarray(ranks.T),
+                                    jnp.asarray(rv)))
+    mask = np.isfinite(ref)
+    np.testing.assert_allclose(rho[mask], ref[mask], atol=5e-3)
+
+
+def test_spearman_rank_transform_wide_on_hardware(tpu_backend):
+    import jax.numpy as jnp
+    from tpuprof.kernels import fused
+
+    rng = np.random.default_rng(11)
+    cols, rows, G = fused.MAX_FUSED_COLS + 32, 512, 32
+    xt, rv = _batch(rng, cols, rows)
+    grid = np.sort(rng.normal(7.0, 3.0, (cols, G)).astype(np.float32),
+                   axis=1)
+    ranks = np.asarray(fused.rank_transform(
+        jnp.asarray(xt), jnp.asarray(rv), jnp.asarray(grid)))
+    ref = _grid_rank_reference(xt, rv, grid)
+    both = np.isfinite(ref) & np.isfinite(ranks)
+    np.testing.assert_array_equal(np.isfinite(ranks), np.isfinite(ref))
+    np.testing.assert_allclose(ranks[both], ref[both], atol=1e-5)

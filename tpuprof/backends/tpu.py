@@ -209,18 +209,23 @@ class _CollectCheckpoint:
                 "topk_capacity": self.config.topk_capacity,
                 "seed": self.config.seed}
 
-    def save(self, state, sampler, hostagg, host_hll, cursor) -> None:
+    def save(self, state, sampler, hostagg, host_hll, cursor,
+             frag_pos=None) -> None:
         from tpuprof.runtime import checkpoint as ckpt
         ckpt.save(self.path, state,
                   {"sampler": sampler, "hostagg": hostagg,
-                   "host_hll": host_hll}, cursor, meta=self._meta())
+                   "host_hll": host_hll, "frag_pos": frag_pos},
+                  cursor, meta=self._meta())
         self.last_saved = cursor
-        log_event("collect_checkpoint", cursor=cursor, path=self.path)
+        log_event("collect_checkpoint", cursor=cursor, path=self.path,
+                  frag_pos=frag_pos)
 
     def load(self):
-        """(state, sampler, hostagg, host_hll, cursor) from the artifact,
-        after refusing any config/source divergence from the saved
-        prefix."""
+        """(state, sampler, hostagg, host_hll, cursor, frag_pos) from the
+        artifact, after refusing any config/source divergence from the
+        saved prefix.  ``frag_pos`` is the (fragment, batch) position of
+        the last folded batch — resume skips whole fragments' I/O when
+        it is present."""
         from tpuprof.runtime import checkpoint as ckpt
         payload = ckpt.load_payload(self.path)
         meta = payload["meta"]
@@ -237,7 +242,8 @@ class _CollectCheckpoint:
         log_event("collect_resume", cursor=payload["cursor"],
                   path=self.path)
         return (state, blob["sampler"], blob["hostagg"],
-                blob["host_hll"], payload["cursor"])
+                blob["host_hll"], payload["cursor"],
+                blob.get("frag_pos"))
 
     def clear(self) -> None:
         import os
@@ -293,11 +299,22 @@ class TPUStatsBackend:
                                     ingest.fingerprint()) \
             if config.checkpoint_path else None
         skip = 0
+        resume_frag = None
         if resume is not None and resume.exists():
-            state, sampler, hostagg, host_hll, skip = resume.load()
+            (state, sampler, hostagg, host_hll, skip,
+             resume_frag) = resume.load()
         else:
             state = None
         cursor = skip
+        # fragment-positioned streaming whenever checkpointing is on, so
+        # saved cursors carry (fragment, batch) and resume skips whole
+        # fragments' I/O instead of re-decoding the prefix.  A resume
+        # cursor without a position (in-memory source) falls back to the
+        # decode-and-skip batch counter.
+        use_positions = resume is not None and ingest.supports_positions() \
+            and (skip == 0 or resume_frag is not None)
+        resume_pos = (resume_frag[0], resume_frag[1] + 1) \
+            if use_positions and resume_frag is not None else None
 
         with phase_timer("scan_a"):
             # centering shift from the first batch's prefix — any value
@@ -306,15 +323,17 @@ class TPUStatsBackend:
             # a host with an empty fragment stripe) so every device in
             # the global mesh carries the same shift and the collective
             # merge's rebase is exactly the identity.
-            batches = prefetch_prepared(ingest, plan, pad,
-                                        config.hll_precision,
-                                        skip_batches=skip)
+            batches = prefetch_prepared(
+                ingest, plan, pad, config.hll_precision,
+                skip_batches=0 if use_positions else skip,
+                positions=use_positions, resume_pos=resume_pos)
             first_hb = next(batches, None)
             if state is None:
                 shift = merge_shift_estimates(
                     estimate_shift(first_hb)
                     if first_hb is not None else None)
                 state = runner.init_pass_a(shift)
+            last_frag = resume_frag
             if first_hb is not None:
                 for hb in itertools.chain((first_hb,), batches):
                     db = runner.put_batch(hb, with_hll=host_hll is None)
@@ -325,14 +344,16 @@ class TPUStatsBackend:
                         host_hll.update(hb.hll, hb.nrows)
                     hostagg.update(hb)
                     cursor += 1
+                    last_frag = hb.frag_pos or last_frag
                     if resume is not None and resume.due(cursor):
                         resume.save(state, sampler, hostagg, host_hll,
-                                    cursor)
+                                    cursor, frag_pos=last_frag)
         if resume is not None and resume.last_saved != cursor:
             # pass A complete: keep the final state on disk so a crash
             # during merge/pass-B resumes with the whole stream skipped
             # instead of rescanning; cleared only after assembly
-            resume.save(state, sampler, hostagg, host_hll, cursor)
+            resume.save(state, sampler, hostagg, host_hll, cursor,
+                        frag_pos=last_frag)
         with phase_timer("merge"):
             res_a = runner.finalize_a(state)
             # cross-host: device sketches already merged by the mesh
@@ -387,6 +408,16 @@ class TPUStatsBackend:
                 else:
                     # exact tier: rank transform through the pass-A sample
                     # CDF (+inf pads unkept slots past every real value)
+                    if hostagg.n_rows > 1_000_000:
+                        # searchsorted serializes its gathers off-TPU too
+                        # (measured ~4 s/64k-row batch on hardware —
+                        # PERF.md); say so instead of silently crawling
+                        from tpuprof.utils.trace import logger
+                        logger.warning(
+                            "spearman exact tier on a non-pallas mesh at "
+                            "%d rows: expect minutes — the grid tier "
+                            "(real TPU, use_fused) is ~100x faster with "
+                            "~1/(2G) rank error", hostagg.n_rows)
                     srt, kept_n = sampler.sorted_padded()
                     kept_counts = runner.put_replicated(kept_n,
                                                         dtype=np.int32)

@@ -110,6 +110,10 @@ class HostBatch:
     # exact-uniqueness tracker refuses to compare across implementations.
     cat_hashes: Optional[Dict[str, np.ndarray]] = None
     cat_hash_kind: Optional[Dict[str, str]] = None
+    # (fragment ordinal, batch ordinal within fragment) when the batch
+    # came from the positioned per-fragment stream — the checkpoint
+    # records it so resume can skip whole fragments' I/O
+    frag_pos: Optional[Tuple[int, int]] = None
     # precision the hll column was packed with — MeshRunner refuses a
     # batch whose packing disagrees with its register width (a mismatched
     # idx would silently scatter into NEIGHBORING columns' registers)
@@ -168,7 +172,8 @@ def _hash64_dictionary(dictionary, dvals: np.ndarray
 
 def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                   pad_rows: int, hll_precision: int = 11,
-                  hashes: bool = True) -> HostBatch:
+                  hashes: bool = True,
+                  frag_pos: Optional[Tuple[int, int]] = None) -> HostBatch:
     """Decode one Arrow record batch into a fixed-shape HostBatch.
 
     ``hashes=False`` skips hashing + HLL packing (the host hot loop) and
@@ -282,19 +287,34 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                      cat_hashes=cat_hashes if hashes else None,
                      cat_hash_kind=cat_hash_kind if hashes else None,
                      hll_precision=hll_precision, col_nbytes=col_nbytes,
-                     col_dict_nbytes=col_dict_nbytes)
+                     col_dict_nbytes=col_dict_nbytes, frag_pos=frag_pos)
 
 
 def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                       hll_precision: int, depth: int = 2,
-                      hashes: bool = True, skip_batches: int = 0):
+                      hashes: bool = True, skip_batches: int = 0,
+                      positions: bool = False,
+                      resume_pos: Optional[Tuple[int, int]] = None):
     """Yield prepared HostBatches with a background thread running
     ``depth`` batches ahead, so Arrow decode + hashing + buffer layout
     overlap the device scan instead of serializing with it.  Exceptions
     from the reader (including the fragment-retry path) re-raise in the
-    consumer.  ``skip_batches`` drops the stream's first N raw batches
-    without preparing them (checkpoint resume — the batch order of a
-    rescannable source is deterministic)."""
+    consumer.
+
+    Resume modes (checkpointing — the batch order of a rescannable
+    source is deterministic):
+
+    * ``positions=True`` (file-backed sources): stream per-fragment with
+      (frag, batch) positions stamped on each HostBatch; with
+      ``resume_pos=(fi, done)`` the first ``fi`` fragments are never
+      opened and the partial fragment's first ``done`` batches are
+      decoded-but-skipped — resume I/O is one fragment, not the prefix.
+      Deliberate tradeoff: per-fragment iteration gives up the dataset
+      Scanner's cross-fragment readahead (within-fragment column reads
+      stay parallel), so checkpointed runs trade a little ingest overlap
+      for fragment-granular resumability.
+    * ``skip_batches=N``: drop the stream's first N raw batches without
+      preparing them (in-memory tables, which have no fragments)."""
     import queue
     import threading
 
@@ -318,12 +338,23 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
 
     def worker():
         try:
-            for k, rb in enumerate(ingest.raw_batches()):
-                if k < skip_batches:
-                    continue
-                if not _put(prepare_batch(rb, plan, pad, hll_precision,
-                                          hashes=hashes)):
-                    return
+            if positions and ingest.supports_positions():
+                start_frag, done = resume_pos if resume_pos else (0, 0)
+                for fi, bi, rb in ingest.raw_batches_positioned(
+                        skip_fragments=start_frag):
+                    if fi == start_frag and bi < done:
+                        continue
+                    if not _put(prepare_batch(rb, plan, pad,
+                                              hll_precision, hashes=hashes,
+                                              frag_pos=(fi, bi))):
+                        return
+            else:
+                for k, rb in enumerate(ingest.raw_batches()):
+                    if k < skip_batches:
+                        continue
+                    if not _put(prepare_batch(rb, plan, pad, hll_precision,
+                                              hashes=hashes)):
+                        return
         except BaseException as exc:          # re-raised consumer-side
             failure.append(exc)
         finally:
@@ -384,6 +415,8 @@ class ArrowIngest:
                         else self._dataset.schema)
         self.plan = ColumnPlan.from_schema(arrow_schema)
         self.rescannable = True
+        self.fragments_opened = 0   # observability: I/O units touched
+                                    # (checkpoint-resume tests assert it)
 
     def fingerprint(self) -> str:
         """Stable identity of the source's content — column names/types,
@@ -450,27 +483,59 @@ class ArrowIngest:
                 return
             except OSError:
                 pass  # fall through to the resilient path
+        # resilient path: the positioned per-fragment stream already
+        # retries each fragment and deduplicates within it; here we only
+        # skip the prefix the failed scanner stream already yielded
+        # (batch boundaries at fragment edges are identical between the
+        # scanner and per-fragment iteration)
         seen = 0
-        for fragment in self._my_fragments():
-            frag_start = seen
-            for attempt in range(self.max_retries + 1):
-                try:
-                    seen = frag_start
-                    for rb in fragment.to_batches(batch_size=self.batch_rows):
-                        seen += 1
-                        if seen <= delivered:
-                            continue        # already yielded pre-failure
-                        yield rb
-                        delivered = seen
-                    break
-                except OSError:
-                    if attempt == self.max_retries:
-                        raise
+        for _fi, _bi, rb in self.raw_batches_positioned():
+            seen += 1
+            if seen <= delivered:
+                continue
+            yield rb
+            delivered = seen
 
     def _my_fragments(self):
         from tpuprof.runtime.distributed import assign_fragments
         pidx, pcount = self.process_shard
         return assign_fragments(self._dataset.get_fragments(), pidx, pcount)
+
+    def supports_positions(self) -> bool:
+        """True when the source can stream (frag, batch) positioned
+        batches — i.e. it is file-backed (fragments exist)."""
+        return self._dataset is not None
+
+    def raw_batches_positioned(self, skip_fragments: int = 0
+                               ) -> Iterator[Tuple[int, int, pa.RecordBatch]]:
+        """Per-fragment stream yielding (frag_idx, batch_idx, batch).
+
+        The first ``skip_fragments`` fragments are never opened — no
+        file I/O, no Arrow decode — which is what makes a checkpoint
+        resume cheap: only the one partially-folded fragment re-reads.
+        Batch boundaries within a fragment are deterministic for a fixed
+        batch size, so positions are stable across runs.  Same
+        fragment-granular retry contract as ``raw_batches``."""
+        if self._dataset is None:
+            raise ValueError("positioned batches require a file-backed "
+                             "dataset source")
+        for fi, fragment in enumerate(self._my_fragments()):
+            if fi < skip_fragments:
+                continue
+            self.fragments_opened += 1
+            delivered = 0
+            for attempt in range(self.max_retries + 1):
+                try:
+                    for bi, rb in enumerate(
+                            fragment.to_batches(batch_size=self.batch_rows)):
+                        if bi < delivered:
+                            continue        # already yielded pre-failure
+                        yield fi, bi, rb
+                        delivered = bi + 1
+                    break
+                except OSError:
+                    if attempt == self.max_retries:
+                        raise
 
     def batches(self, hll_precision: int = 11) -> Iterator[HostBatch]:
         for rb in self.raw_batches():

@@ -1,17 +1,17 @@
 """Host-side mergeable uniform row sample (bottom-k priority sampling).
 
-This is the quantile/mode/sample-MAD sketch of the profile.  It used to
-live on device (kernels/quantiles.py — still available and tested), but
-the selection is driven ONLY by i.i.d. uniform priorities, never by the
-data, so it can run wherever the rows already are.  During ingestion the
-rows are in host RAM on their way to the device; sampling them there
-costs one vectorized RNG draw + a rare row gather per batch and removes
-the single most expensive op (a (cols, K+rows) top_k) from the device
-scan entirely.
+This is the quantile/mode/sample-MAD sketch of the profile.  It began
+life as a device sketch (kernels/quantiles.py, removed once this module
+superseded it), but the selection is driven ONLY by i.i.d. uniform
+priorities, never by the data, so it can run wherever the rows already
+are.  During ingestion the rows are in host RAM on their way to the
+device; sampling them there costs one vectorized RNG draw + a rare row
+gather per batch and removes the single most expensive op (a
+(cols, K+rows) top_k) from the device scan entirely.
 
-Semantics and bounds are the device sketch's (see kernels/quantiles.py):
-keeping the global top-K priorities over any partition of the stream is
-a uniform random sample without replacement, so
+Semantics and bounds: keeping the global top-K priorities over any
+partition of the stream is a uniform random sample without replacement,
+so
 
     merge(sample(A), sample(B)) = top-K(concat)  ≡  sample(A ∪ B)
 
