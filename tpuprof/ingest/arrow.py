@@ -155,6 +155,20 @@ def _num_keys(values: np.ndarray) -> np.ndarray:
     return values.astype(np.int64, copy=False).view(np.uint64)
 
 
+def _packed_obs(keys: np.ndarray, valid: np.ndarray,
+                precision: int) -> np.ndarray:
+    """Packed HLL observations from canonical uint64 keys: one fused
+    native hash+pack pass when available, else hash then numpy pack —
+    bit-identical outputs (tests/test_native.py)."""
+    from tpuprof import native
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    packed = native.hash_pack_u64(keys, valid, precision)
+    if packed is not None:
+        return packed
+    from tpuprof.kernels import hll as khll
+    return khll.pack(_hash64(keys), valid, precision)
+
+
 def _hash64_dictionary(dictionary, dvals: np.ndarray
                        ) -> Tuple[np.ndarray, str]:
     """Hash a batch's string dictionary: native buffer path when possible,
@@ -179,6 +193,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     ``hashes=False`` skips hashing + HLL packing (the host hot loop) and
     leaves the packed plane zeros — pass B only needs values and
     categorical codes."""
+    from tpuprof import native
     from tpuprof.kernels import hll as khll
     n = batch.num_rows
     g = pad_rows
@@ -232,18 +247,16 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                     xf = np.where(valid, xf, np.nan)
                 x[:n, spec.num_lane] = xf
             if hashes:
-                h64 = _hash64(_num_keys(vals))
-                hll_packed[:n, spec.hash_lane] = khll.pack(
-                    h64, valid, hll_precision)
+                hll_packed[:n, spec.hash_lane] = _packed_obs(
+                    _num_keys(vals), valid, hll_precision)
         elif spec.role == "date":
             valid = arr.is_valid().to_numpy(zero_copy_only=False)
             ints = arr.cast(pa.timestamp("ns"), safe=False) \
                       .cast(pa.int64(), safe=False) \
                       .fill_null(0).to_numpy(zero_copy_only=False)
             if hashes:
-                h64 = _hash64(_num_keys(ints))
-                hll_packed[:n, spec.hash_lane] = khll.pack(
-                    h64, valid, hll_precision)
+                hll_packed[:n, spec.hash_lane] = _packed_obs(
+                    _num_keys(ints), valid, hll_precision)
             date_ints[spec.name] = (ints, valid)
         else:  # cat
             if not isinstance(arr.type, pa.DictionaryType):
@@ -258,15 +271,19 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                 if dvals.size:
                     dh, hkind = _hash64_dictionary(combined.dictionary,
                                                    dvals)
-                    h64 = dh[codes]
+                    # fused gather+pack (one C pass); numpy twin below
+                    packed = native.pack_gather(dh, codes, valid,
+                                                hll_precision)
+                    if packed is None:
+                        packed = khll.pack(dh[codes], valid,
+                                           hll_precision)
                 else:
                     dh = np.zeros(0, dtype=np.uint64)
                     hkind = ""
-                    h64 = np.zeros(n, dtype=np.uint64)
+                    packed = np.zeros(n, dtype=np.uint16)
                 cat_hashes[spec.name] = dh
                 cat_hash_kind[spec.name] = hkind
-                hll_packed[:n, spec.hash_lane] = khll.pack(
-                    h64, valid, hll_precision)
+                hll_packed[:n, spec.hash_lane] = packed
             cat_codes[spec.name] = (np.where(valid, codes, -1), dvals)
 
     # Column decode is embarrassingly parallel (disjoint output columns)

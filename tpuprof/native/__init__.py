@@ -52,6 +52,12 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
         ctypes.c_ssize_t, ctypes.c_ssize_t, ctypes.c_void_p,
         ctypes.c_size_t]
+    lib.tpuprof_hash_pack_u64.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_size_t, ctypes.c_int]
+    lib.tpuprof_pack_gather.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int]
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -116,6 +122,59 @@ def hll_update(regs: np.ndarray, packed: np.ndarray) -> bool:
     lib.tpuprof_hll_update(packed.ctypes.data, n_rows, n_cols, rs, cs,
                            regs.ctypes.data, regs.shape[1])
     return True
+
+
+def _check_pack_precision(precision: int) -> None:
+    # same guard kernels/hll.pack enforces — a larger idx would truncate
+    # in the uint16 and silently alias registers (and precision > 32
+    # would shift negatively in the C code)
+    from tpuprof.kernels.hll import MAX_PRECISION
+    if not 1 <= precision <= MAX_PRECISION:
+        raise ValueError(f"hll precision {precision} cannot pack into "
+                         f"uint16 (max {MAX_PRECISION})")
+
+
+def hash_pack_u64(keys: np.ndarray, valid: Optional[np.ndarray],
+                  precision: int) -> Optional[np.ndarray]:
+    """Fused splitmix64 + HLL pack of raw 64-bit keys (numeric/date
+    columns): one C pass, no intermediate hash array.  Bit-identical to
+    hash_u64_array + kernels/hll.pack; None if native is unavailable."""
+    _check_pack_precision(precision)
+    lib = _load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    out = np.empty(keys.shape, dtype=np.uint16)
+    vptr = 0
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, dtype=np.uint8)
+        vptr = valid.ctypes.data
+    lib.tpuprof_hash_pack_u64(keys.ctypes.data, vptr, out.ctypes.data,
+                              keys.size, precision)
+    return out
+
+
+def pack_gather(dict_hashes: np.ndarray, codes: np.ndarray,
+                valid: Optional[np.ndarray],
+                precision: int) -> Optional[np.ndarray]:
+    """Fused gather + HLL pack for dictionary columns: observations are
+    dict_hashes[codes] packed in one C pass; rows with code < 0 /
+    out-of-range / !valid pack to 0.  None if native is unavailable."""
+    _check_pack_precision(precision)
+    lib = _load()
+    if lib is None:
+        return None
+    dict_hashes = np.ascontiguousarray(dict_hashes, dtype=np.uint64)
+    codes = np.ascontiguousarray(codes, dtype=np.int64)
+    out = np.empty(codes.shape, dtype=np.uint16)
+    vptr = 0
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, dtype=np.uint8)
+        vptr = valid.ctypes.data
+    lib.tpuprof_pack_gather(dict_hashes.ctypes.data, dict_hashes.size,
+                            codes.ctypes.data, vptr, out.ctypes.data,
+                            codes.size, precision)
+    return out
 
 
 def hash_string_dictionary(arr) -> Optional[np.ndarray]:

@@ -66,6 +66,16 @@ inline uint64_t avalanche(uint64_t h) {
   return h;
 }
 
+// splitmix64 finalizer — the ONE definition both tpuprof_hash_u64 and
+// the fused hash+pack path use (they must stay bit-identical for HLL
+// registers from the two paths to merge).
+inline uint64_t splitmix(uint64_t z) {
+  z += 0x9E3779B97F4A7C15ULL;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
 // Full xxHash64 of one byte run.
 uint64_t xxh64(const uint8_t* p, size_t len, uint64_t seed) {
   const uint8_t* end = p + len;
@@ -113,10 +123,7 @@ extern "C" {
 // out[i] = splitmix64-style avalanche of in[i] (raw 64-bit patterns).
 void tpuprof_hash_u64(const uint64_t* in, uint64_t* out, size_t n) {
   for (size_t i = 0; i < n; ++i) {
-    uint64_t z = in[i] + 0x9E3779B97F4A7C15ULL;
-    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
-    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
-    out[i] = z ^ (z >> 31);
+    out[i] = splitmix(in[i]);
   }
 }
 
@@ -128,6 +135,53 @@ void tpuprof_hash_bytes(const uint8_t* data, const int64_t* offsets,
     const int64_t beg = offsets[i];
     const int64_t len = offsets[i + 1] - beg;
     out[i] = xxh64(data + beg, static_cast<size_t>(len), 0);
+  }
+}
+
+namespace {
+
+// (idx << 5) | rho from one 64-bit hash — bit-identical to
+// kernels/hll.pack: idx = top `precision` bits, rho = clz of the next
+// 32 bits + 1, capped at 31, floored at 1 (so packed == 0 iff invalid).
+inline uint16_t pack_one(uint64_t h, int precision) {
+  const int shift_idx = 64 - precision;
+  const uint32_t idx = static_cast<uint32_t>(h >> shift_idx);
+  const uint32_t b =
+      static_cast<uint32_t>((h >> (shift_idx - 32)) & 0xFFFFFFFFULL);
+  const uint32_t bb = b | 1u;
+  const int fl = 31 - __builtin_clz(bb);   // floor(log2(bb))
+  int rho = 32 - fl;
+  if (rho > 31) rho = 31;
+  if (rho < 1) rho = 1;
+  return static_cast<uint16_t>((idx << 5) | static_cast<uint32_t>(rho));
+}
+
+}  // namespace
+
+// Fused hash+pack for numeric/date columns: splitmix64 the raw key and
+// pack the HLL observation in ONE pass (the separate hash_u64 + numpy
+// pack formulation costs two full passes plus an intermediate array —
+// measured as the second-largest share of host batch prep).
+void tpuprof_hash_pack_u64(const uint64_t* keys, const uint8_t* valid,
+                           uint16_t* out, size_t n, int precision) {
+  for (size_t i = 0; i < n; ++i) {
+    out[i] = (valid && !valid[i]) ? 0 : pack_one(splitmix(keys[i]),
+                                                 precision);
+  }
+}
+
+// Fused gather+pack for dictionary-encoded columns: observations come
+// from the per-dictionary-value hashes (dict_hashes, length n_dict)
+// gathered through int64 codes; invalid rows (code < 0 / out of range /
+// !valid) pack to 0.
+void tpuprof_pack_gather(const uint64_t* dict_hashes, size_t n_dict,
+                         const int64_t* codes, const uint8_t* valid,
+                         uint16_t* out, size_t n, int precision) {
+  for (size_t i = 0; i < n; ++i) {
+    const int64_t c = codes[i];
+    const bool ok = (!valid || valid[i]) && c >= 0 &&
+                    static_cast<uint64_t>(c) < n_dict;
+    out[i] = ok ? pack_one(dict_hashes[c], precision) : 0;
   }
 }
 
