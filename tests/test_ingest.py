@@ -98,3 +98,73 @@ def test_fragment_retry_exhaustion_raises():
         get_fragments=lambda: [DeadFragment()], schema=_table(1).schema)
     with pytest.raises(OSError):
         list(ingest.raw_batches())
+
+
+def test_parquet_path_reads_string_dictionaries(tmp_path):
+    """Path sources ask the parquet reader for dictionary-encoded string
+    columns (skipping the per-batch dictionary_encode hash-table build);
+    results are identical either way."""
+    import pyarrow.parquet as pq
+
+    from tpuprof.ingest.arrow import ArrowIngest
+
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({
+        "s": rng.choice(["alpha", "beta", "gamma"], 5000),
+        "v": rng.normal(size=5000).astype(np.float32),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+    ing = ArrowIngest(path, 2048)
+    field = ing._dataset.schema.field("s")
+    assert pa.types.is_dictionary(field.type)
+    assert ing.plan.by_role("cat")[0].name == "s"
+    hb = next(ing.batches())
+    codes, dvals = hb.cat_codes["s"]
+    assert set(dvals) == {"alpha", "beta", "gamma"}
+    assert codes.max() < len(dvals) and (codes >= 0).all()
+
+
+def test_compile_cache_dir_populates(tmp_path):
+    import os
+
+    from tpuprof import ProfilerConfig
+    from tpuprof.backends.tpu import TPUStatsBackend
+
+    cache = str(tmp_path / "xla_cache")
+    df = pd.DataFrame({"x": np.arange(500, dtype=np.float32)})
+    stats = TPUStatsBackend().collect(
+        df, ProfilerConfig(batch_rows=256, compile_cache_dir=cache))
+    assert stats["table"]["n"] == 500
+    assert os.path.isdir(cache) and len(os.listdir(cache)) > 0
+
+
+def test_shared_dictionary_hashed_once(tmp_path, monkeypatch):
+    """Batches sharing one parquet row-group dictionary must pay the
+    O(cardinality) materialize+hash once, not per batch."""
+    import pyarrow.parquet as pq
+
+    from tpuprof.ingest import arrow as ia
+
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({"s": [f"k{i}" for i in rng.integers(0, 5000, 40_000)]})
+    path = str(tmp_path / "h.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+
+    calls = {"n": 0}
+    real = ia._hash64_dictionary
+
+    def counting(dictionary, dvals):
+        calls["n"] += 1
+        return real(dictionary, dvals)
+
+    monkeypatch.setattr(ia, "_hash64_dictionary", counting)
+    ia._DICT_CACHE.clear()
+    ing = ia.ArrowIngest(path, 2048)
+    hbs = list(ing.batches())
+    assert len(hbs) == 20
+    # one hash pass per distinct dictionary (row group), not per batch
+    assert calls["n"] < len(hbs) / 2, calls["n"]
+    # and the shared dvals object is literally the same array across
+    # batches of a row group (what the recounter's identity cache needs)
+    assert hbs[0].cat_codes["s"][1] is hbs[1].cat_codes["s"][1]

@@ -142,6 +142,12 @@ class Recounter:
     def __init__(self, hostagg: HostAgg):
         self.indexes: Dict[str, pd.Index] = {}
         self.counts: Dict[str, np.ndarray] = {}
+        # dictionary->candidate indexers memoized on the dvals OBJECT:
+        # dictionary-page batches share one dvals array per row group
+        # (ingest's _DICT_CACHE), so the O(cardinality) get_indexer probe
+        # runs once per dictionary, not once per batch.  Holding the
+        # array reference makes the identity check safe.
+        self._dv_cache: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
         for name, mg in hostagg.mg.items():
             cands = pd.Index(list(mg.candidates()))
             self.indexes[name] = cands
@@ -154,7 +160,11 @@ class Recounter:
             if not valid.any() or not len(dvals):
                 continue
             cnt = np.bincount(codes[valid], minlength=len(dvals))
-            cand_idx = self.indexes[name].get_indexer(dvals)
+            ent = self._dv_cache.get(name)
+            if ent is None or ent[0] is not dvals:
+                ent = (dvals, self.indexes[name].get_indexer(dvals))
+                self._dv_cache[name] = ent
+            cand_idx = ent[1]
             hit = cand_idx >= 0
             np.add.at(self.counts[name], cand_idx[hit], cnt[hit])
 
@@ -253,6 +263,29 @@ class _CollectCheckpoint:
             pass
 
 
+def _enable_compile_cache(cache_dir: str) -> None:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (all
+    thresholds zeroed so the profile's small programs qualify).  Safe to
+    call repeatedly; older jaxlibs without the knobs are a no-op —
+    compiles then simply happen per process, which is correct, just
+    slower."""
+    import os
+
+    import jax
+    os.makedirs(cache_dir, exist_ok=True)
+    # each knob independently: a jax that knows the cache dir but not a
+    # threshold should still get the thresholds it does support (one
+    # shared try would silently leave defaults that filter out the
+    # profile's sub-second compiles)
+    for knob, value in (("jax_compilation_cache_dir", cache_dir),
+                        ("jax_persistent_cache_min_entry_size_bytes", 0),
+                        ("jax_persistent_cache_min_compile_time_secs", 0)):
+        try:
+            jax.config.update(knob, value)
+        except Exception:
+            pass
+
+
 class TPUStatsBackend:
     """Profile Arrow-readable sources with the fused sharded scan."""
 
@@ -264,6 +297,8 @@ class TPUStatsBackend:
     def collect(self, source: Any, config: ProfilerConfig) -> Dict[str, Any]:
         import jax
 
+        if config.compile_cache_dir:
+            _enable_compile_cache(config.compile_cache_dir)
         from tpuprof.runtime.distributed import (merge_host_aggs,
                                                  merge_recount_arrays,
                                                  merge_samplers,

@@ -57,6 +57,15 @@ class ProfilerConfig:
                                     # False => single-pass streaming mode with
                                     # sample-derived histograms.
     mesh_devices: Optional[int] = None  # None => all available devices
+    compile_cache_dir: Optional[str] = None  # persist XLA executables
+                                             # here so a fresh process
+                                             # skips the one-time
+                                             # ~15-35s compile (each
+                                             # ProfileReport builds new
+                                             # jit wrappers, so the
+                                             # in-memory cache alone
+                                             # never carries across
+                                             # runs/processes)
     checkpoint_path: Optional[str] = None   # batch-profile resumability:
                                             # persist the pass-A scan here
                                             # every checkpoint_every_batches

@@ -169,6 +169,40 @@ def _packed_obs(keys: np.ndarray, valid: np.ndarray,
     return khll.pack(_hash64(keys), valid, precision)
 
 
+# Last-seen dictionary views per column: parquet dictionary-page reads
+# share ONE dictionary object across every batch of a row group, so
+# re-materializing (to_pandas) and re-hashing it per batch would cost
+# O(cardinality) per batch — measured 6.3x slower than the pre-dict-read
+# path on a 150k-distinct column.  Entries hold a reference to the
+# dictionary, so the buffer addresses in the key cannot be recycled
+# while the entry lives (address match => same live content).  One entry
+# per column name; replaced when the dictionary changes (row-group
+# boundary).
+_DICT_CACHE: Dict[str, Dict[str, object]] = {}
+
+
+def _dictionary_views(name: str, dictionary,
+                      want_hashes: bool) -> Tuple[np.ndarray,
+                                                  Optional[np.ndarray], str]:
+    """(values, hashes, hash_kind) for a batch's dictionary, memoized on
+    the dictionary's buffer identity.  ``hashes`` is None when not
+    requested (pass-B scans)."""
+    bufs = dictionary.buffers()
+    key = (len(dictionary),
+           tuple((b.address, b.size) if b is not None else None
+                 for b in bufs))
+    ent = _DICT_CACHE.get(name)
+    if ent is None or ent["key"] != key:
+        ent = {"key": key, "ref": dictionary,
+               "dvals": np.asarray(dictionary.to_pandas(), dtype=object),
+               "dh": None, "kind": ""}
+        _DICT_CACHE[name] = ent
+    if want_hashes and ent["dh"] is None and len(ent["dvals"]):
+        ent["dh"], ent["kind"] = _hash64_dictionary(ent["ref"],
+                                                    ent["dvals"])
+    return ent["dvals"], ent["dh"], ent["kind"]
+
+
 def _hash64_dictionary(dictionary, dvals: np.ndarray
                        ) -> Tuple[np.ndarray, str]:
     """Hash a batch's string dictionary: native buffer path when possible,
@@ -266,11 +300,10 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
             valid = combined.is_valid().to_numpy(zero_copy_only=False)
             codes = combined.indices.fill_null(0).to_numpy(
                 zero_copy_only=False).astype(np.int64)
-            dvals = np.asarray(combined.dictionary.to_pandas(), dtype=object)
+            dvals, dh, hkind = _dictionary_views(
+                spec.name, combined.dictionary, want_hashes=hashes)
             if hashes:
                 if dvals.size:
-                    dh, hkind = _hash64_dictionary(combined.dictionary,
-                                                   dvals)
                     # fused gather+pack (one C pass); numpy twin below
                     packed = native.pack_gather(dh, codes, valid,
                                                 hll_precision)
@@ -279,7 +312,6 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                                            hll_precision)
                 else:
                     dh = np.zeros(0, dtype=np.uint64)
-                    hkind = ""
                     packed = np.zeros(n, dtype=np.uint16)
                 cat_hashes[spec.name] = dh
                 cat_hash_kind[spec.name] = hkind
@@ -390,6 +422,30 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
         cancelled.set()
 
 
+def _open_path_dataset(path: str) -> pads.Dataset:
+    """Open a file path as a dataset, asking the parquet reader to ship
+    string columns dictionary-encoded straight from their dictionary
+    pages.  Without this every batch pays a per-column
+    ``dictionary_encode`` hash-table build during decode — measured as
+    ~70% of host prep at Criteo shape (25 string cols); with it the
+    cat path consumes parquet's own dictionaries (1.7x faster serial
+    prepare).  Non-parquet formats and pre-built Dataset objects are
+    left untouched."""
+    ds = pads.dataset(path)
+    fmt = getattr(ds, "format", None)
+    if not isinstance(fmt, pads.ParquetFileFormat):
+        return ds
+    str_cols = [f.name for f in ds.schema
+                if pa.types.is_string(f.type)
+                or pa.types.is_large_string(f.type)]
+    if not str_cols:
+        return ds
+    read_opts = pads.ParquetReadOptions(dictionary_columns=str_cols)
+    return pads.dataset(path,
+                        format=pads.ParquetFileFormat(
+                            read_options=read_opts))
+
+
 def _decode_threads() -> int:
     import os
     env = os.environ.get("TPUPROF_DECODE_THREADS")
@@ -423,7 +479,7 @@ class ArrowIngest:
         elif isinstance(source, pads.Dataset):
             self._dataset = source
         elif isinstance(source, str):
-            self._dataset = pads.dataset(source)
+            self._dataset = _open_path_dataset(source)
         else:
             raise TypeError(
                 f"cannot ingest {type(source)!r}; expected DataFrame, "
@@ -447,7 +503,13 @@ class ArrowIngest:
         schema = (self._table.schema if self._table is not None
                   else self._dataset.schema)
         for field in schema:
-            h.update(f"{field.name}:{field.type}".encode())
+            t = field.type
+            if isinstance(t, pa.DictionaryType):
+                # dictionary encoding is a READER choice (e.g. the
+                # parquet dictionary_columns option), not content —
+                # normalizing keeps checkpoints valid across it
+                t = t.value_type
+            h.update(f"{field.name}:{t}".encode())
         if self._table is not None:
             h.update(f"rows={self._table.num_rows}".encode())
             # IPC-serialize the head slice: pyarrow slices are zero-copy
