@@ -159,7 +159,6 @@ def test_shared_dictionary_hashed_once(tmp_path, monkeypatch):
         return real(dictionary, dvals)
 
     monkeypatch.setattr(ia, "_hash64_dictionary", counting)
-    ia._DICT_CACHE.clear()
     ing = ia.ArrowIngest(path, 2048)
     hbs = list(ing.batches())
     assert len(hbs) == 20
@@ -168,3 +167,17 @@ def test_shared_dictionary_hashed_once(tmp_path, monkeypatch):
     # and the shared dvals object is literally the same array across
     # batches of a row group (what the recounter's identity cache needs)
     assert hbs[0].cat_codes["s"][1] is hbs[1].cat_codes["s"][1]
+
+
+def test_dictionary_cache_distinguishes_slices():
+    """Two equal-length slices of one parent dictionary share buffer
+    addresses but hold different values — the memo key must include the
+    offset or the second slice silently reuses the first's values."""
+    from tpuprof.ingest.arrow import _dictionary_views
+
+    parent = pa.array(["a", "b", "c", "d", "e", "f"])
+    cache = {}
+    v1, _, _ = _dictionary_views(cache, "col", parent.slice(0, 3), False)
+    v2, _, _ = _dictionary_views(cache, "col", parent.slice(3, 3), False)
+    assert list(v1) == ["a", "b", "c"]
+    assert list(v2) == ["d", "e", "f"]

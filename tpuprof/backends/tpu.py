@@ -299,7 +299,10 @@ class TPUStatsBackend:
 
         if config.compile_cache_dir:
             _enable_compile_cache(config.compile_cache_dir)
-        from tpuprof.runtime.distributed import (merge_host_aggs,
+        from tpuprof.runtime.distributed import (merge_corr_states,
+                                                 merge_host_aggs,
+                                                 merge_pass_a_states,
+                                                 merge_pass_b_states,
                                                  merge_recount_arrays,
                                                  merge_samplers,
                                                  merge_shift_estimates)
@@ -308,8 +311,17 @@ class TPUStatsBackend:
         plan = ingest.plan
         if not plan.specs:
             return _empty_stats(config)
+        devices = self._devices
+        if devices is None and pshard[1] > 1:
+            # multi-process: a LOCAL mesh per host — each host scans its
+            # own fragment stripe on its own chips (ICI merge), and the
+            # finalized states merge across hosts over DCN
+            # (runtime/distributed.merge_pass_a_states; a global mesh
+            # would demand identical inputs and dispatch counts on every
+            # process, which striped ingest cannot provide)
+            devices = jax.local_devices()
         runner = MeshRunner(config, plan.n_num, plan.n_hash,
-                            devices=self._devices)
+                            devices=devices)
         # host batches are padded to the runner's device-divisible row
         # count (chunks are <= batch_rows <= runner.rows by construction)
         pad = runner.rows
@@ -391,8 +403,10 @@ class TPUStatsBackend:
                         frag_pos=last_frag)
         with phase_timer("merge"):
             res_a = runner.finalize_a(state)
-            # cross-host: device sketches already merged by the mesh
-            # collectives; host-side aggregates ride one DCN gather
+            # cross-host: each host's device sketches merged over ICI by
+            # the mesh collectives; the finalized states and host-side
+            # aggregates ride DCN gathers
+            res_a = merge_pass_a_states(res_a)
             hostagg = merge_host_aggs(hostagg)
             sampler = merge_samplers(sampler)
         log_event("pass_a", rows=hostagg.n_rows, devices=runner.n_dev,
@@ -474,11 +488,11 @@ class TPUStatsBackend:
                             spear_state = runner.step_spearman(
                                 spear_state, db, sorted_sample, kept_counts)
                     recounter.update(hb)
-                res_b = runner.finalize_b(state_b)
+                res_b = merge_pass_b_states(runner.finalize_b(state_b))
                 recounter.counts = merge_recount_arrays(recounter.counts)
             if spear_state is not None:
-                rho_spear = kcorr.finalize(
-                    runner.finalize_spearman(spear_state))
+                rho_spear = kcorr.finalize(merge_corr_states(
+                    runner.finalize_spearman(spear_state)))
             hists, mad = khistogram.finalize(
                 res_b, momf["fmin"], momf["fmax"], momf["n"], config.bins)
         elif config.spearman and hostagg.n_rows > 0 and plan.n_num > 1:

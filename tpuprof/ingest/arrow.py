@@ -169,34 +169,30 @@ def _packed_obs(keys: np.ndarray, valid: np.ndarray,
     return khll.pack(_hash64(keys), valid, precision)
 
 
-# Last-seen dictionary views per column: parquet dictionary-page reads
-# share ONE dictionary object across every batch of a row group, so
-# re-materializing (to_pandas) and re-hashing it per batch would cost
-# O(cardinality) per batch — measured 6.3x slower than the pre-dict-read
-# path on a 150k-distinct column.  Entries hold a reference to the
-# dictionary, so the buffer addresses in the key cannot be recycled
-# while the entry lives (address match => same live content).  One entry
-# per column name; replaced when the dictionary changes (row-group
-# boundary).
-_DICT_CACHE: Dict[str, Dict[str, object]] = {}
-
-
-def _dictionary_views(name: str, dictionary,
-                      want_hashes: bool) -> Tuple[np.ndarray,
-                                                  Optional[np.ndarray], str]:
-    """(values, hashes, hash_kind) for a batch's dictionary, memoized on
-    the dictionary's buffer identity.  ``hashes`` is None when not
-    requested (pass-B scans)."""
+def _dictionary_views(cache: Dict[str, Dict[str, object]], name: str,
+                      dictionary, want_hashes: bool
+                      ) -> Tuple[np.ndarray, Optional[np.ndarray], str]:
+    """(values, hashes, hash_kind) for a batch's dictionary, memoized in
+    ``cache`` (one entry per column, owned by the ArrowIngest so it dies
+    with the scan): parquet dictionary-page reads share ONE dictionary
+    object across every batch of a row group, and re-materializing
+    (to_pandas) + re-hashing it per batch would cost O(cardinality) per
+    batch — measured 6.3x slower on a 150k-distinct column.  The key is
+    the dictionary's (length, OFFSET, buffer identity) — offset matters
+    because two slices of one parent share buffer addresses with
+    different content — and the entry holds a reference to the
+    dictionary so the addresses cannot be recycled while it lives.
+    ``hashes`` is None when not requested (pass-B scans)."""
     bufs = dictionary.buffers()
-    key = (len(dictionary),
+    key = (len(dictionary), dictionary.offset,
            tuple((b.address, b.size) if b is not None else None
                  for b in bufs))
-    ent = _DICT_CACHE.get(name)
+    ent = cache.get(name)
     if ent is None or ent["key"] != key:
         ent = {"key": key, "ref": dictionary,
                "dvals": np.asarray(dictionary.to_pandas(), dtype=object),
                "dh": None, "kind": ""}
-        _DICT_CACHE[name] = ent
+        cache[name] = ent
     if want_hashes and ent["dh"] is None and len(ent["dvals"]):
         ent["dh"], ent["kind"] = _hash64_dictionary(ent["ref"],
                                                     ent["dvals"])
@@ -221,7 +217,9 @@ def _hash64_dictionary(dictionary, dvals: np.ndarray
 def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                   pad_rows: int, hll_precision: int = 11,
                   hashes: bool = True,
-                  frag_pos: Optional[Tuple[int, int]] = None) -> HostBatch:
+                  frag_pos: Optional[Tuple[int, int]] = None,
+                  dict_cache: Optional[Dict[str, Dict[str, object]]] = None
+                  ) -> HostBatch:
     """Decode one Arrow record batch into a fixed-shape HostBatch.
 
     ``hashes=False`` skips hashing + HLL packing (the host hot loop) and
@@ -229,6 +227,8 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     categorical codes."""
     from tpuprof import native
     from tpuprof.kernels import hll as khll
+    if dict_cache is None:
+        dict_cache = {}             # per-call: correct, just unmemoized
     n = batch.num_rows
     g = pad_rows
     n_num, n_hash = plan.n_num, plan.n_hash
@@ -301,7 +301,8 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
             codes = combined.indices.fill_null(0).to_numpy(
                 zero_copy_only=False).astype(np.int64)
             dvals, dh, hkind = _dictionary_views(
-                spec.name, combined.dictionary, want_hashes=hashes)
+                dict_cache, spec.name, combined.dictionary,
+                want_hashes=hashes)
             if hashes:
                 if dvals.size:
                     # fused gather+pack (one C pass); numpy twin below
@@ -395,14 +396,16 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                         continue
                     if not _put(prepare_batch(rb, plan, pad,
                                               hll_precision, hashes=hashes,
-                                              frag_pos=(fi, bi))):
+                                              frag_pos=(fi, bi),
+                                              dict_cache=ingest._dict_cache)):
                         return
             else:
                 for k, rb in enumerate(ingest.raw_batches()):
                     if k < skip_batches:
                         continue
                     if not _put(prepare_batch(rb, plan, pad, hll_precision,
-                                              hashes=hashes)):
+                                              hashes=hashes,
+                                              dict_cache=ingest._dict_cache)):
                         return
         except BaseException as exc:          # re-raised consumer-side
             failure.append(exc)
@@ -440,10 +443,23 @@ def _open_path_dataset(path: str) -> pads.Dataset:
                 or pa.types.is_large_string(f.type)]
     if not str_cols:
         return ds
-    read_opts = pads.ParquetReadOptions(dictionary_columns=str_cols)
-    return pads.dataset(path,
-                        format=pads.ParquetFileFormat(
-                            read_options=read_opts))
+    new_fmt = pads.ParquetFileFormat(
+        read_options=pads.ParquetReadOptions(dictionary_columns=str_cols))
+    # reuse the first discovery's file list instead of re-listing the
+    # path (a directory on object storage pays the listing twice
+    # otherwise); fall back to re-discovery when the rebuilt schema
+    # loses columns (e.g. hive-partition fields live in the paths)
+    files = getattr(ds, "files", None)
+    fs = getattr(ds, "filesystem", None)
+    if files and fs is not None:
+        try:
+            ds2 = pads.dataset(files, filesystem=fs, format=new_fmt)
+            if [f.name for f in ds2.schema] == \
+                    [f.name for f in ds.schema]:
+                return ds2
+        except (pa.ArrowInvalid, OSError):
+            pass
+    return pads.dataset(path, format=new_fmt)
 
 
 def _decode_threads() -> int:
@@ -490,6 +506,10 @@ class ArrowIngest:
         self.rescannable = True
         self.fragments_opened = 0   # observability: I/O units touched
                                     # (checkpoint-resume tests assert it)
+        # per-column dictionary views (see _dictionary_views) — owned
+        # here so the memo dies with the scan instead of pinning the
+        # last dictionary per column name for the process lifetime
+        self._dict_cache: Dict[str, Dict[str, object]] = {}
 
     def fingerprint(self) -> str:
         """Stable identity of the source's content — column names/types,
@@ -619,7 +639,8 @@ class ArrowIngest:
     def batches(self, hll_precision: int = 11) -> Iterator[HostBatch]:
         for rb in self.raw_batches():
             yield prepare_batch(rb, self.plan, self.batch_rows,
-                                hll_precision)
+                                hll_precision,
+                                dict_cache=self._dict_cache)
 
     def sample(self, n_rows: int) -> pd.DataFrame:
         if self._table is not None:

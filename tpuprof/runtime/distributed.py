@@ -1,16 +1,26 @@
 """Multi-host execution (SURVEY.md §5 'Distributed communication backend').
 
 The reference's multi-node story is Spark's driver→executor RPC + Netty
-shuffle.  tpuprof's: ``jax.distributed`` + a global device mesh.  The
-division of traffic follows the survey's prescription —
+shuffle.  tpuprof's: ``jax.distributed`` with a LOCAL device mesh per
+host.  The division of traffic follows the survey's prescription —
 
-* **ICI** carries the collective sketch merge (the psum/pmax/all_gather
-  program in runtime/mesh.py, unchanged: with a global mesh the same
-  collectives span the slice);
-* **DCN** carries only ingestion fan-out (each host reads its own
-  striped subset of Arrow fragments) and the final host-side aggregate
-  gather (Misra-Gries summaries, date min/max, null tallies — all
-  mergeable, all tiny).
+* **ICI** carries the collective sketch merge (the psum/pmax program in
+  runtime/mesh.py) across each host's OWN chips;
+* **DCN** carries ingestion fan-out (each host reads its own striped
+  subset of Arrow fragments), the cross-host merge of the finalized
+  per-host device states (a few KB of mergeable sums — see
+  merge_pass_a_states), and the host-side aggregate gather
+  (Misra-Gries summaries, date min/max, null tallies).
+
+Why local meshes rather than one global mesh: every host streams a
+DIFFERENT batch stream (its fragment stripe), and a global-mesh SPMD
+dispatch both requires identical host inputs (``device_put`` asserts
+value equality across processes) and identical dispatch COUNTS (hosts
+with uneven stripes would deadlock the collective).  Local scans over
+local data need neither; the states they produce are the same mergeable
+monoids the device collectives already merge, so the cross-host leg is
+a tiny allgather + numpy fold (verified end-to-end by the two-process
+integration test, tests/test_multiprocess.py).
 
 Everything here degrades to a no-op at ``process_count() == 1``, which is
 how the single-host test suite exercises the code paths.
@@ -114,6 +124,61 @@ def merge_hll_registers(host_hll):
     merged = parts[0]
     for other in parts[1:]:
         merged = merged.merge(other)
+    return merged
+
+
+def merge_pass_a_states(res_a):
+    """Cross-host merge of the per-host finalized pass-A device states
+    (runtime/mesh.finalize_a output: host numpy dicts) — the DCN leg of
+    the sketch merge.  Folds with the kernels' own commutative merges
+    (moments/corr rebase onto a common shift exactly; HLL registers
+    max), so the result is what one host scanning everything would have
+    produced — the same laws tests/test_merge_laws.py pins.  No-op
+    single-process."""
+    import jax
+    if jax.process_count() == 1:
+        return res_a
+    from tpuprof.kernels import corr as kcorr
+    from tpuprof.kernels import moments as kmoments
+    parts = allgather_objects(res_a)
+    merged = parts[0]
+    for other in parts[1:]:
+        merged = {
+            "mom": jax.device_get(kmoments.merge(merged["mom"],
+                                                 other["mom"])),
+            "corr": jax.device_get(kcorr.merge(merged["corr"],
+                                               other["corr"])),
+            "hll": np.maximum(merged["hll"], other["hll"]),
+        }
+    return merged
+
+
+def merge_corr_states(state):
+    """Cross-host merge of a finalized corr/Spearman Gram state (the
+    kernel's own rebasing merge — hosts on the adaptive-shift XLA path
+    legitimately carry different shifts)."""
+    import jax
+    if jax.process_count() == 1:
+        return state
+    from tpuprof.kernels import corr as kcorr
+    parts = allgather_objects(state)
+    merged = parts[0]
+    for other in parts[1:]:
+        merged = jax.device_get(kcorr.merge(merged, other))
+    return merged
+
+
+def merge_pass_b_states(res_b):
+    """Cross-host merge of finalized pass-B histogram/MAD states (pure
+    sums).  No-op single-process."""
+    import jax
+    if jax.process_count() == 1:
+        return res_b
+    parts = allgather_objects(res_b)
+    merged = parts[0]
+    for other in parts[1:]:
+        merged["counts"] = merged["counts"] + other["counts"]
+        merged["abs_dev"] = merged["abs_dev"] + other["abs_dev"]
     return merged
 
 
