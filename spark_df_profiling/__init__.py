@@ -1,0 +1,25 @@
+"""Drop-in import surface for users migrating from spark-df-profiling.
+
+The reference library's whole public API (SURVEY.md §1: ``ProfileReport``
+with ``bins``/``corr_reject`` kwargs, ``.to_file``/``.html``/
+``.get_rejected_variables``/``_repr_html_``, ``base.describe``, and the
+``formatters`` helpers) is re-exported from tpuprof, so
+
+    import spark_df_profiling
+    report = spark_df_profiling.ProfileReport(df, bins=10, corr_reject=0.9)
+    report.to_file("report.html")
+
+keeps working verbatim — now backed by the fused TPU scan instead of
+per-column Spark jobs.  Accepts pandas DataFrames, pyarrow Tables, and
+Parquet paths (there is no SparkSession here to accept Spark DataFrames;
+convert with ``df.toPandas()`` or point at the Parquet the Spark job
+wrote).
+"""
+
+from tpuprof import ProfileReport, ProfilerConfig, describe
+from tpuprof.report import formatters
+
+from spark_df_profiling import base
+
+__all__ = ["ProfileReport", "ProfilerConfig", "describe", "formatters",
+           "base"]

@@ -1,0 +1,7 @@
+"""Reference-layout alias: ``spark_df_profiling.base.describe`` was the
+stats entry point in the upstream package (SURVEY.md §1 L2); tpuprof's
+``describe`` has the same contract (stats dict out, renderer-ready)."""
+
+from tpuprof.api import describe
+
+__all__ = ["describe"]

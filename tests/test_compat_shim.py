@@ -1,0 +1,33 @@
+"""The spark_df_profiling import surface (migration shim): the
+reference's public API (SURVEY §1) must work verbatim on tpuprof."""
+
+import numpy as np
+import pandas as pd
+
+
+def test_reference_usage_verbatim(tmp_path):
+    import spark_df_profiling
+
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({
+        "fare": rng.gamma(2.0, 7.5, 500),
+        "tip": rng.gamma(1.0, 2.0, 500),
+        "vendor": rng.choice(["CMT", "VTS"], 500),
+    })
+    df["tip2"] = df["tip"] * 1.0000001          # CORR-rejected
+    report = spark_df_profiling.ProfileReport(df, bins=10, corr_reject=0.9)
+    out = tmp_path / "report.html"
+    report.to_file(str(out))
+    html = out.read_text()
+    assert "vendor" in html and "fare" in html
+    assert report.get_rejected_variables(0.9) == ["tip2"]
+    assert report._repr_html_() == report.html
+
+
+def test_base_and_formatters_layout():
+    from spark_df_profiling import base, formatters
+
+    stats = base.describe(pd.DataFrame({"x": [1.0, 2.0, 3.0]}))
+    assert stats["table"]["n"] == 3
+    assert formatters.fmt_percent(0.125) == "12.5%"
+    assert formatters.fmt_bytesize(2048).startswith("2.0")

@@ -82,3 +82,63 @@ def test_batch_rows_larger_than_table():
     stats = _collect(df, batch_rows=1 << 14)
     assert stats["variables"]["x"]["count"] == 20
     assert stats["variables"]["x"]["p50"] == pytest.approx(9.5)
+
+
+def test_nested_types_profile_as_stringified_cat():
+    """list/struct columns (nested parquet data) must not crash the
+    profile: both backends degrade them to their string form (CAT),
+    with matching distincts and value counts."""
+    import pyarrow as pa
+
+    from tpuprof import ProfileReport
+
+    tbl = pa.table({"a": [1.0, 2.0, 3.0],
+                    "l": pa.array([[1, 2], [3], [1, 2]]),
+                    "s": pa.array([{"x": 1}, {"x": 2}, {"x": 1}])})
+    r = ProfileReport(tbl, backend="tpu")
+    v = r.description["variables"]
+    assert v["l"]["type"] == "CAT" and v["l"]["distinct_count"] == 2
+    assert v["s"]["type"] == "CAT" and v["s"]["distinct_count"] == 2
+    assert dict(r.description["freq"]["l"]) == {"[1, 2]": 2, "[3]": 1}
+
+    import pandas as pd
+    df = pd.DataFrame({"a": [1.0, 2.0, 3.0],
+                       "l": [[1, 2], [3], [1, 2]],
+                       "s": [{"x": 1}, {"x": 2}, {"x": 1}]})
+    r2 = ProfileReport(df, backend="cpu")
+    v2 = r2.description["variables"]
+    assert v2["l"]["type"] == "CAT" and v2["l"]["distinct_count"] == 2
+    assert dict(r2.description["freq"]["l"]) == {"[1, 2]": 2, "[3]": 1}
+
+
+def test_nested_edge_cases_cpu():
+    """NaN stays missing (not the string "nan"), mixed hashable/
+    unhashable columns stringify wholesale, and ndarray cells produce
+    the same strings as the TPU path's python containers."""
+    import numpy as np
+    import pandas as pd
+
+    from tpuprof import ProfileReport
+
+    df = pd.DataFrame({
+        "nanlist": pd.Series([[1, 2], np.nan, [3], [1, 2]], dtype=object),
+        "mixed": pd.Series(["a", [1, 2], "a", "a"], dtype=object),
+        "arr": pd.Series([np.array([1, 2]), np.array([3]),
+                          np.array([1, 2]), np.array([3])], dtype=object),
+    })
+    r = ProfileReport(df, backend="cpu")
+    v = r.description["variables"]
+    assert v["nanlist"]["n_missing"] == 1
+    assert v["nanlist"]["distinct_count"] == 2
+    assert "nan" not in r.description["freq"]["nanlist"]
+    assert dict(r.description["freq"]["mixed"]) == {"a": 3, "[1, 2]": 1}
+    assert dict(r.description["freq"]["arr"]) == {"[1, 2]": 2, "[3]": 2}
+
+
+def test_shim_attribute_access_after_plain_import():
+    import spark_df_profiling
+
+    import pandas as pd
+    stats = spark_df_profiling.base.describe(
+        pd.DataFrame({"x": [1.0, 2.0]}))
+    assert stats["table"]["n"] == 2

@@ -141,6 +141,35 @@ def describe_unique_1d(series: pd.Series, common: Dict[str, Any]) -> Dict[str, A
     return stats
 
 
+_UNHASHABLE = (list, dict, set, bytearray, np.ndarray)
+
+
+def _nested_str(x):
+    # ndarray cells (Table.to_pandas turns arrow lists into arrays)
+    # print "[1 2]"; going through .tolist() matches the TPU ingest,
+    # whose to_pylist() yields python containers ("[1, 2]")
+    return str(x.tolist() if isinstance(x, np.ndarray) else x)
+
+
+def _stringify_unhashable(df: pd.DataFrame) -> pd.DataFrame:
+    """Columns holding unhashable values (lists/dicts/arrays — nested
+    parquet data lands here) profile as their string form: one exotic
+    column must not crash the whole profile, and a stringified
+    categorical is the useful degradation (distincts/top-k still mean
+    something).  Mirrored by the TPU ingest (ingest/arrow.py).  The
+    whole column is type-probed (a mixed column whose FIRST value is
+    hashable still crashes nunique otherwise); NaN/None stay missing
+    (na_action) instead of becoming the string "nan"."""
+    out = {}
+    for col in df.columns:
+        s = df[col]
+        if s.dtype == object and any(
+                issubclass(t, _UNHASHABLE) for t in set(s.map(type))):
+            s = s.map(_nested_str, na_action="ignore")
+        out[col] = s
+    return pd.DataFrame(out, index=df.index)
+
+
 def _common_fields(series: pd.Series, n: int) -> Dict[str, Any]:
     count = int(series.count())
     distinct = int(series.nunique(dropna=True))
@@ -175,7 +204,7 @@ class CPUStatsBackend:
     name = "cpu"
 
     def collect(self, source: Any, config: ProfilerConfig) -> Dict[str, Any]:
-        df = _as_pandas(source)
+        df = _stringify_unhashable(_as_pandas(source))
         n = len(df)
 
         base_kinds: Dict[str, str] = {}

@@ -293,6 +293,14 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                     _num_keys(ints), valid, hll_precision)
             date_ints[spec.name] = (ints, valid)
         else:  # cat
+            if pa.types.is_nested(arr.type):
+                # nested values (list/struct/map) have no
+                # dictionary_encode kernel and no string cast; profile
+                # their string form instead of crashing the scan (the
+                # CPU oracle applies the same degradation)
+                arr = pa.array(
+                    [None if v is None else str(v)
+                     for v in arr.to_pylist()], type=pa.string())
             if not isinstance(arr.type, pa.DictionaryType):
                 arr = pc.dictionary_encode(arr)
             combined = arr.combine_chunks() if isinstance(
