@@ -31,7 +31,8 @@ def test_cli_profile_end_to_end(parquet_path, tmp_path, capsys):
     out = str(tmp_path / "r.html")
     stats_json = str(tmp_path / "s.json")
     rc = main(["profile", parquet_path, "-o", out, "--backend", "tpu",
-               "--batch-rows", "1024", "--stats-json", stats_json])
+               "--batch-rows", "1024", "--stats-json", stats_json,
+               "--compile-cache", str(tmp_path / "xla")])
     assert rc == 0
     page = open(out).read()
     assert page.startswith("<!DOCTYPE html>") and 'id="var-a"' in page
@@ -44,7 +45,8 @@ def test_cli_profile_end_to_end(parquet_path, tmp_path, capsys):
 def test_cli_single_pass(parquet_path, tmp_path):
     out = str(tmp_path / "r.html")
     rc = main(["profile", parquet_path, "-o", out, "--single-pass",
-               "--backend", "tpu", "--batch-rows", "1024"])
+               "--backend", "tpu", "--batch-rows", "1024",
+               "--no-compile-cache"])
     assert rc == 0 and "Overview" in open(out).read()
 
 

@@ -132,10 +132,14 @@ def test_compile_cache_dir_populates(tmp_path):
     from tpuprof.backends.tpu import TPUStatsBackend
 
     cache = str(tmp_path / "xla_cache")
-    df = pd.DataFrame({"x": np.arange(500, dtype=np.float32)})
+    # unusual shape => novel HLO: earlier tests in this process may have
+    # compiled (and in-memory-cached) the common shapes, which would
+    # skip the persistent-cache write this test asserts on
+    df = pd.DataFrame({f"x{i}": np.arange(700, dtype=np.float32) * i
+                       for i in range(7)})
     stats = TPUStatsBackend().collect(
-        df, ProfilerConfig(batch_rows=256, compile_cache_dir=cache))
-    assert stats["table"]["n"] == 500
+        df, ProfilerConfig(batch_rows=332, compile_cache_dir=cache))
+    assert stats["table"]["n"] == 700
     assert os.path.isdir(cache) and len(os.listdir(cache)) > 0
 
 

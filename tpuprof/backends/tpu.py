@@ -272,7 +272,16 @@ def _enable_compile_cache(cache_dir: str) -> None:
     import os
 
     import jax
-    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        # unwritable cache location (read-only HOME, locked-down service
+        # account): degrade to uncached compiles, never fail the profile
+        from tpuprof.utils.trace import logger
+        logger.warning("compile cache dir %r is not writable; compiling "
+                       "without a persistent cache", cache_dir)
+        return
+    prev = getattr(jax.config, "jax_compilation_cache_dir", None)
     # each knob independently: a jax that knows the cache dir but not a
     # threshold should still get the thresholds it does support (one
     # shared try would silently leave defaults that filter out the
@@ -282,6 +291,16 @@ def _enable_compile_cache(cache_dir: str) -> None:
                         ("jax_persistent_cache_min_compile_time_secs", 0)):
         try:
             jax.config.update(knob, value)
+        except Exception:
+            pass
+    if prev not in (None, "", cache_dir):
+        # jax pins its cache singleton to the directory active at first
+        # use; switching dirs mid-process needs an explicit reset or the
+        # new dir silently never receives entries
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as cc)
+            cc.reset_cache()
         except Exception:
             pass
 

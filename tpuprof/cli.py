@@ -42,6 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "from PATH after a crash")
     p.add_argument("--checkpoint-every", type=int, default=64,
                    metavar="N", help="batches between checkpoints")
+    p.add_argument("--compile-cache", metavar="DIR", default=None,
+                   help="persist XLA executables here (default: "
+                        "~/.cache/tpuprof/xla — repeat runs skip the "
+                        "one-time ~15-35s compile)")
+    p.add_argument("--no-compile-cache", action="store_true",
+                   help="disable the persistent compilation cache")
     return parser
 
 
@@ -54,12 +60,24 @@ def cmd_profile(args: argparse.Namespace) -> int:
               "(incompatible with --single-pass)", file=sys.stderr)
         return 2
 
+    if args.no_compile_cache:
+        cache_dir = None
+    elif args.compile_cache:
+        cache_dir = args.compile_cache
+    else:
+        import os
+        cache_dir = os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.expanduser("~/.cache")),
+            "tpuprof", "xla")
+
     config = ProfilerConfig(
         backend=args.backend, bins=args.bins, corr_reject=args.corr_reject,
         batch_rows=args.batch_rows, quantile_sketch_size=args.sketch_size,
         hll_precision=args.hll_precision, exact_passes=not args.single_pass,
         spearman=args.spearman, checkpoint_path=args.checkpoint,
-        checkpoint_every_batches=args.checkpoint_every)
+        checkpoint_every_batches=args.checkpoint_every,
+        compile_cache_dir=cache_dir)
 
     t0 = time.perf_counter()
     with trace_to(args.trace):
