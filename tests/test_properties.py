@@ -166,3 +166,97 @@ def test_fused_kernel_property_vs_xla(rows, cols, seed):
     np.testing.assert_allclose(
         corr.finalize(jax.device_get(cp)),
         corr.finalize(jax.device_get(cx)), atol=5e-3, equal_nan=True)
+
+
+@given(st.integers(0, 2**31 - 1), st.integers(1, 6), st.integers(2, 64),
+       st.integers(4, 2000))
+@settings(**SETTINGS)
+def test_unique_tracker_truth(seed, n_chunks, budget, universe):
+    """The tracker's verdict must match ground truth whenever it stays
+    within budget: UNIQUE iff the stream had no duplicate; and it must
+    NEVER claim UNIQUE for a stream that has one (OVERFLOW is the only
+    allowed degradation)."""
+    from tpuprof.kernels import unique as kunique
+
+    rng = np.random.default_rng(seed)
+    stream = rng.choice(universe, size=rng.integers(1, 120),
+                        replace=True).astype(np.uint64)
+    t = kunique.UniqueTracker(["c"], budget, budget)
+    for chunk in np.array_split(stream, n_chunks):
+        t.update("c", chunk)
+    has_dup = len(np.unique(stream)) < stream.size
+    if t.status["c"] == kunique.UNIQUE:
+        assert not has_dup
+    elif t.status["c"] == kunique.DUP:
+        assert has_dup
+    # OVERFLOW claims nothing — but it is only allowed PAST budget; a
+    # stream that fits must get an exact verdict (an always-OVERFLOW
+    # implementation would otherwise pass vacuously)
+    if stream.size <= budget:
+        assert t.status["c"] != kunique.OVERFLOW
+
+
+@given(st.integers(0, 2**31 - 1), st.integers(1, 4), st.integers(1, 4))
+@settings(**SETTINGS)
+def test_unique_tracker_merge_law(seed, n_a, n_b):
+    """merge(t(A), t(B)) must agree with t(A ∪ B) on any exact verdict
+    (UNIQUE/DUP); OVERFLOW may appear earlier in the merged tracker but
+    an exact claim, once made, must match the union's truth."""
+    from tpuprof.kernels import unique as kunique
+
+    rng = np.random.default_rng(seed)
+    big = 1 << 20
+    sa = rng.choice(300, size=rng.integers(1, 80), replace=True
+                    ).astype(np.uint64)
+    sb = rng.choice(300, size=rng.integers(1, 80), replace=True
+                    ).astype(np.uint64)
+    ta = kunique.UniqueTracker(["c"], big, big)
+    tb = kunique.UniqueTracker(["c"], big, big)
+    for chunk in np.array_split(sa, n_a):
+        ta.update("c", chunk)
+    for chunk in np.array_split(sb, n_b):
+        tb.update("c", chunk)
+    ta.merge(tb)
+    union = np.concatenate([sa, sb])
+    has_dup = len(np.unique(union)) < union.size
+    if ta.status["c"] == kunique.UNIQUE:
+        assert not has_dup
+    elif ta.status["c"] == kunique.DUP:
+        assert has_dup
+
+
+@given(st.integers(0, 2**31 - 1), st.integers(2, 5))
+@settings(**SETTINGS)
+def test_misra_gries_hash_keyed_merge_law(seed, n_parts):
+    """Partition a value stream arbitrarily across MG summaries (with
+    ingest-style precomputed hashes), merge them all, and the result
+    must respect the Misra-Gries bounds vs exact counts."""
+    import pandas as pd
+
+    from tpuprof.kernels import topk
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(50, 600))
+    vals = np.array([f"v{z}" for z in rng.zipf(1.5, n) % 200],
+                    dtype=object)
+    cap = int(rng.integers(4, 64))
+    parts = np.array_split(vals, n_parts)
+    summaries = []
+    for p in parts:
+        mg = topk.MisraGries(cap)
+        if len(p):
+            u, c = np.unique(p, return_counts=True)
+            mg.update_batch(u, c,
+                            hashes=pd.util.hash_array(u).astype(np.uint64))
+        summaries.append(mg)
+    merged = summaries[0]
+    for other in summaries[1:]:
+        merged.merge(other)
+    true = pd.Series(vals).value_counts()
+    assert merged.offset <= n / (cap + 1) + 1e-9
+    for v, est in merged.counts.items():
+        assert est <= true[v]                      # underestimates only
+        assert true[v] - est <= merged.offset
+    for v, tc in true.items():                     # heavy hitters survive
+        if tc > n / (cap + 1):
+            assert v in merged.counts

@@ -263,6 +263,33 @@ class _CollectCheckpoint:
             pass
 
 
+_UNSET = object()
+_last_cache_dir = [_UNSET]      # last dir THIS function enabled
+
+
+def _reset_cache_singleton() -> None:
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as cc)
+        cc.reset_cache()
+    except Exception:
+        pass
+
+
+def disable_compile_cache() -> None:
+    """Explicitly stop persistent-cache writes for this process.  Both
+    steps matter: the config stops re-initialization, and the reset
+    drops the already-pinned singleton (which otherwise KEEPS writing to
+    its original directory regardless of the config — observed)."""
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+    _reset_cache_singleton()
+    _last_cache_dir[0] = None
+
+
 def _enable_compile_cache(cache_dir: str) -> None:
     """Point JAX's persistent compilation cache at ``cache_dir`` (all
     thresholds zeroed so the profile's small programs qualify).  Safe to
@@ -293,16 +320,17 @@ def _enable_compile_cache(cache_dir: str) -> None:
             jax.config.update(knob, value)
         except Exception:
             pass
-    if prev not in (None, "", cache_dir):
-        # jax pins its cache singleton to the directory active at first
-        # use; switching dirs mid-process needs an explicit reset or the
-        # new dir silently never receives entries
-        try:
-            from jax.experimental.compilation_cache import (
-                compilation_cache as cc)
-            cc.reset_cache()
-        except Exception:
-            pass
+    # jax pins its cache singleton to the directory active at first use;
+    # switching dirs mid-process needs an explicit reset or the new dir
+    # silently never receives entries.  The config value alone cannot
+    # detect this (a --no-compile-cache interlude sets it to None while
+    # the singleton stays pinned), so track the last dir we enabled too.
+    switched = (_last_cache_dir[0] is not _UNSET
+                and _last_cache_dir[0] != cache_dir) \
+        or prev not in (None, "", cache_dir)
+    if switched:
+        _reset_cache_singleton()
+    _last_cache_dir[0] = cache_dir
 
 
 class TPUStatsBackend:

@@ -42,12 +42,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "from PATH after a crash")
     p.add_argument("--checkpoint-every", type=int, default=64,
                    metavar="N", help="batches between checkpoints")
-    p.add_argument("--compile-cache", metavar="DIR", default=None,
-                   help="persist XLA executables here (default: "
-                        "~/.cache/tpuprof/xla — repeat runs skip the "
-                        "one-time ~15-35s compile)")
-    p.add_argument("--no-compile-cache", action="store_true",
-                   help="disable the persistent compilation cache")
+    cache_group = p.add_mutually_exclusive_group()
+    cache_group.add_argument(
+        "--compile-cache", metavar="DIR", default=None,
+        help="persist XLA executables here (default: "
+             "~/.cache/tpuprof/xla — repeat runs skip the one-time "
+             "~15-35s compile)")
+    cache_group.add_argument(
+        "--no-compile-cache", action="store_true",
+        help="disable the persistent compilation cache")
     return parser
 
 
@@ -62,13 +65,19 @@ def cmd_profile(args: argparse.Namespace) -> int:
 
     if args.no_compile_cache:
         cache_dir = None
+        # actively clear: a prior in-process run (or wrapper) may have
+        # pointed jax at a directory, and "disabled" must mean no writes
+        from tpuprof.backends.tpu import disable_compile_cache
+        disable_compile_cache()
     elif args.compile_cache:
         cache_dir = args.compile_cache
     else:
         import os
+        # `or` (not a .get default): the XDG spec treats an EMPTY
+        # XDG_CACHE_HOME as unset, and '' would yield a cwd-relative dir
         cache_dir = os.path.join(
-            os.environ.get("XDG_CACHE_HOME",
-                           os.path.expanduser("~/.cache")),
+            os.environ.get("XDG_CACHE_HOME")
+            or os.path.expanduser("~/.cache"),
             "tpuprof", "xla")
 
     config = ProfilerConfig(
