@@ -21,7 +21,8 @@ from typing import Any, Dict, List, Optional
 
 from tpuprof.backends.base import get_backend
 from tpuprof.config import ProfilerConfig
-from tpuprof.schema import rejected_variables, validate_stats
+from tpuprof.schema import (VariablesView, rejected_variables,
+                            validate_stats)
 
 
 def describe(source: Any, config: Optional[ProfilerConfig] = None,
@@ -39,6 +40,9 @@ def describe(source: Any, config: Optional[ProfilerConfig] = None,
     if problems:
         raise AssertionError(
             f"backend {backend.name!r} violated the stats contract: {problems}")
+    # serve the reference's DataFrame idioms (.loc[col, 'mean']) and the
+    # native dict contract from the same object (SURVEY §1 L2→L3 seam)
+    stats["variables"] = VariablesView(stats["variables"])
     return stats
 
 

@@ -94,6 +94,7 @@ def describe_numeric_1d(series: pd.Series, config: ProfilerConfig,
         stats["histogram"] = stats["mini_histogram"] = None
 
     stats["mode"] = vc.index[0] if len(vc) else np.nan
+    stats["mode_approx"] = False      # oracle mode is exact value-counts
     return stats
 
 
@@ -125,6 +126,7 @@ def describe_bool_1d(series: pd.Series, common: Dict[str, Any],
     stats = describe_categorical_1d(series, common, vc)
     values = series.dropna()
     stats["mean"] = float(values.astype("float64").mean()) if len(values) else np.nan
+    stats["mode_approx"] = False      # exact value-counts
     return stats
 
 
@@ -159,12 +161,25 @@ def _stringify_unhashable(df: pd.DataFrame) -> pd.DataFrame:
     something).  Mirrored by the TPU ingest (ingest/arrow.py).  The
     whole column is type-probed (a mixed column whose FIRST value is
     hashable still crashes nunique otherwise); NaN/None stay missing
-    (na_action) instead of becoming the string "nan"."""
+    (na_action) instead of becoming the string "nan".
+
+    Cost control: ``infer_dtype`` (one C pass) screens each object
+    column first — ordinary string/numeric object columns skip the
+    per-cell Python type map entirely; only columns pandas reports as
+    mixed/unknown pay the full probe."""
+    # inferred kinds that cannot contain list/dict/ndarray cells
+    hashable_kinds = frozenset((
+        "string", "unicode", "bytes", "empty", "boolean", "integer",
+        "floating", "mixed-integer-float", "decimal", "complex",
+        "categorical", "date", "datetime", "datetime64", "time",
+        "timedelta", "timedelta64", "period", "interval"))
     out = {}
     for col in df.columns:
         s = df[col]
-        if s.dtype == object and any(
-                issubclass(t, _UNHASHABLE) for t in set(s.map(type))):
+        if s.dtype == object \
+                and pd.api.types.infer_dtype(s, skipna=True) \
+                not in hashable_kinds \
+                and any(issubclass(t, _UNHASHABLE) for t in set(s.map(type))):
             s = s.map(_nested_str, na_action="ignore")
         out[col] = s
     return pd.DataFrame(out, index=df.index)

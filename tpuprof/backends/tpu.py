@@ -688,6 +688,7 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
                 freq[name] = vc
                 stats["mean"] = momf["mean"][lane]
                 stats["mode"] = bool(vc.index[0]) if common["count"] else np.nan
+                stats["mode_approx"] = False    # from exact true/false counts
                 stats["top"] = stats["mode"]
                 stats["freq"] = int(vc.iloc[0]) if common["count"] else 0
         elif kind == schema.CAT:
@@ -781,6 +782,12 @@ def _numeric_stats(lane, spec, momf, quants, sample_vals, sample_kept,
             out["histogram"] = None
     out["mini_histogram"] = out["histogram"]
     out["mode"] = _sample_mode(sample_vals[lane], sample_kept[lane])
+    # exact iff the sample holds EVERY finite value of the column (then
+    # _sample_mode is a full value-count); otherwise it is a sample
+    # estimate and says so — the reference's mode is exact value-counts,
+    # and a silent estimate would claim parity it does not have
+    out["mode_approx"] = \
+        int(sample_kept[lane].sum()) < int(momf["n"][lane])
     return out
 
 
@@ -802,7 +809,7 @@ def _const_mode(spec, momf, hostagg):
 def _empty_stats(config) -> Dict[str, Any]:
     return {
         "table": schema.make_table_stats(0, {}),
-        "variables": {},
+        "variables": schema.VariablesView(),
         "freq": {},
         "correlations": {"pearson": pd.DataFrame()},
         "messages": [],

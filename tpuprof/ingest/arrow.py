@@ -127,6 +127,11 @@ class HostBatch:
     col_dict_nbytes: Optional[Dict[str, int]] = None
 
 
+# nested-column degradation warned once per column name per process
+# (set.add is GIL-atomic, safe from the decode thread pool)
+_NESTED_WARNED: set = set()
+
+
 def _hash64(keys: np.ndarray) -> np.ndarray:
     """64-bit hashes of canonical uint64 keys.  Native C++ path when
     available (see tpuprof/native), pandas ``hash_array`` otherwise; the
@@ -297,7 +302,17 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                 # nested values (list/struct/map) have no
                 # dictionary_encode kernel and no string cast; profile
                 # their string form instead of crashing the scan (the
-                # CPU oracle applies the same degradation)
+                # CPU oracle applies the same degradation).  This is an
+                # O(rows) Python loop per batch per scan — warn once so
+                # a user whose ingest is slow knows which column it is.
+                if spec.name not in _NESTED_WARNED:
+                    _NESTED_WARNED.add(spec.name)
+                    from tpuprof.utils.trace import logger
+                    logger.warning(
+                        "column %r holds nested values (%s): profiling "
+                        "its str() form via a per-row Python loop — "
+                        "expect this column to dominate ingest time",
+                        spec.name, arr.type)
                 arr = pa.array(
                     [None if v is None else str(v)
                      for v in arr.to_pylist()], type=pa.string())

@@ -56,7 +56,7 @@ class MisraGries:
     """One column's frequent-values summary (value -> count)."""
 
     __slots__ = ("capacity", "_index", "_counts", "_values", "offset",
-                 "overflowed")
+                 "overflowed", "_merged")
 
     def __init__(self, capacity: int):
         self.capacity = int(capacity)
@@ -65,6 +65,9 @@ class MisraGries:
         self._values = np.zeros(0, dtype=object)      # aligned with _index
         self.offset = 0          # total decrement applied (error bound)
         self.overflowed = False  # True once any eviction happened
+        self._merged = False     # True once a value-keyed merge ran — the
+                                 # hash index may then hold foreign keys
+                                 # and update_batch must refuse to run
 
     def update_batch(self, values: np.ndarray, counts: np.ndarray,
                      hashes: Optional[np.ndarray] = None) -> None:
@@ -72,6 +75,15 @@ class MisraGries:
 
         ``hashes`` is the aligned uint64 key array from Arrow decode;
         computed from ``values`` when omitted."""
+        if self._merged:
+            # after a value-keyed merge the hash index may hold keys from
+            # a DIFFERENT hash implementation; a hash-keyed fold would
+            # silently split one value across two entries (corrupting both
+            # counts), so the misuse fails loudly instead
+            raise RuntimeError(
+                "MisraGries.update_batch called after merge(): the store's "
+                "hash index is no longer batch-keyable — fold batches "
+                "first, merge summaries last")
         counts = np.asarray(counts, dtype=np.int64)
         if hashes is None:
             hashes = _fallback_hashes(values)
@@ -148,9 +160,10 @@ class MisraGries:
         deployment the HLL host-fold gates on in backends/tpu.py), and a
         hash-keyed fold would then split one value across two entries.
         Cold path: runs once per profile over O(capacity) entries.  After
-        a cross-implementation merge the hash index may hold foreign
-        keys, so ``update_batch`` must not be called again — in
-        production merges happen only after the scan completes."""
+        a merge the hash index may hold foreign keys, so ``update_batch``
+        refuses to run (``_merged`` flag) — in production merges happen
+        only after the scan completes."""
+        self._merged = True
         if len(other._index):
             vidx = pd.Index(self._values)
             pos = vidx.get_indexer(other._values)
@@ -168,7 +181,7 @@ class MisraGries:
     def __getstate__(self) -> Dict[str, object]:
         """Stable pickle layout (checkpoints, cross-host gathers)."""
         return {"capacity": self.capacity, "offset": self.offset,
-                "overflowed": self.overflowed,
+                "overflowed": self.overflowed, "merged": self._merged,
                 "hashes": self._index.to_numpy(),
                 "count_arr": self._counts, "values": self._values}
 
@@ -178,6 +191,7 @@ class MisraGries:
         self.capacity = int(state["capacity"])
         self.offset = int(state["offset"])
         self.overflowed = bool(state["overflowed"])
+        self._merged = bool(state.get("merged", False))
         if "hashes" in state:
             self._index = pd.Index(
                 np.asarray(state["hashes"], dtype=np.uint64))

@@ -150,12 +150,15 @@ class StreamingProfiler:
         sample_vals, sample_kept = self.sampler.columns()
         hll_regs = self.host_hll.regs if self.host_hll is not None \
             else res["hll"]
-        return _assemble(
+        stats = _assemble(
             self.plan, self.config,
             self._sample if self._sample is not None else pd.DataFrame(),
             self.hostagg, momf, kcorr.finalize(res["corr"]),
             self.sampler.quantiles(probes), sample_vals, sample_kept,
             khll.finalize(hll_regs), None, None, None, probes)
+        from tpuprof.schema import VariablesView
+        stats["variables"] = VariablesView(stats["variables"])
+        return stats
 
     def report_html(self) -> str:
         from tpuprof.report.render import to_standalone_html

@@ -87,11 +87,11 @@ NUM_FIELDS = COMMON_FIELDS + [
     "mean", "std", "variance", "min", "max", "range", "sum",
     "p5", "p25", "p50", "p75", "p95", "iqr", "cv", "mad",
     "skewness", "kurtosis", "n_zeros", "p_zeros", "n_infinite", "p_infinite",
-    "mode", "histogram", "mini_histogram",
+    "mode", "mode_approx", "histogram", "mini_histogram",
 ]
 
 CAT_FIELDS = COMMON_FIELDS + ["mode", "top", "freq"]
-BOOL_FIELDS = COMMON_FIELDS + ["mean", "mode", "top", "freq"]
+BOOL_FIELDS = COMMON_FIELDS + ["mean", "mode", "mode_approx", "top", "freq"]
 DATE_FIELDS = COMMON_FIELDS + ["min", "max", "range"]
 CONST_FIELDS = COMMON_FIELDS + ["mode"]
 UNIQUE_FIELDS = COMMON_FIELDS + ["first_rows"]
@@ -207,6 +207,55 @@ def variables_frame(variables: Dict[str, Dict[str, Any]]) -> pd.DataFrame:
     frame = pd.DataFrame.from_dict(variables, orient="index")
     frame.index.name = "variable"
     return frame
+
+
+class VariablesView(Dict[str, Dict[str, Any]]):
+    """``description['variables']`` serving BOTH access idioms.
+
+    The reference kept per-column stats as a pandas DataFrame indexed by
+    column name (SURVEY §1 L2→L3 seam), so migrating code does
+    ``.loc[col, 'mean']`` / ``.index`` / ``.T``; tpuprof's native
+    contract is a dict of per-column dicts (``variables['col']['mean']``).
+    This dict subclass adds the DataFrame accessors, built lazily from
+    the dict and cached (the stats dict is frozen once assembled)."""
+
+    def _frame(self) -> pd.DataFrame:
+        cached = getattr(self, "_cached_frame", None)
+        if cached is None:
+            cached = variables_frame(self)
+            self._cached_frame = cached
+        return cached
+
+    @property
+    def loc(self):
+        return self._frame().loc
+
+    @property
+    def iloc(self):
+        return self._frame().iloc
+
+    @property
+    def at(self):
+        return self._frame().at
+
+    @property
+    def index(self):
+        return self._frame().index
+
+    @property
+    def columns(self):
+        return self._frame().columns
+
+    @property
+    def T(self):
+        return self._frame().T
+
+    def iterrows(self):
+        return self._frame().iterrows()
+
+    def to_frame(self) -> pd.DataFrame:
+        """Explicit DataFrame copy of the per-column stats."""
+        return self._frame().copy()
 
 
 def validate_stats(stats: Dict[str, Any]) -> List[str]:
