@@ -171,8 +171,10 @@ def main() -> None:
     print(json.dumps({
         "metric": "profile_e2e_rows_per_sec_per_chip",
         "value": round(rate_e2e, 1),
-        "unit": (f"rows/s/chip ({N_COLS} f32 cols, full profile: fused "
-                 f"pass A + merge + histogram/MAD pass B + finalize)"),
+        "unit": (f"rows/s/chip ({N_COLS} f32 cols; device profile "
+                 f"pipeline HBM-staged: fused pass A + merge + "
+                 f"histogram/MAD pass B + finalize; host ingest "
+                 f"measured separately in PERF.md)"),
         "vs_baseline": round(rate_e2e / TARGET_ROWS_PER_SEC_PER_CHIP, 3),
         "pass_a_only_rows_per_sec_per_chip": round(rate_a, 1),
     }))

@@ -69,7 +69,8 @@ def test_dict_contract_snapshot():
         "mean", "std", "variance", "min", "max", "range", "sum",
         "p5", "p25", "p50", "p75", "p95", "iqr", "cv", "mad",
         "skewness", "kurtosis", "n_zeros", "p_zeros", "n_infinite",
-        "p_infinite", "mode", "histogram", "mini_histogram"])
+        "p_infinite", "mode", "mode_approx", "histogram",
+        "mini_histogram"])
     assert sorted(schema.CAT_FIELDS) == sorted(
         schema.COMMON_FIELDS + ["mode", "top", "freq"])
     assert sorted(schema.DATE_FIELDS) == sorted(

@@ -28,9 +28,10 @@ def parquet_source(tmp_path):
 
 def _cfg(tmp_path, **kw):
     kw.setdefault("batch_rows", 256)
+    kw.setdefault("checkpoint_every_batches", 3)
     return ProfilerConfig(backend="tpu",
                           checkpoint_path=str(tmp_path / "scan.ckpt"),
-                          checkpoint_every_batches=3, **kw)
+                          **kw)
 
 
 def _key_stats(stats):
@@ -142,6 +143,44 @@ def test_resume_skips_completed_fragments_io(tmp_path, monkeypatch):
     ingest = captured[0]
     assert ingest.fragments_opened == 2, ingest.fragments_opened
 
+    ctrl, got = _key_stats(control), _key_stats(resumed)
+    for name in ctrl:
+        for field, expect in ctrl[name].items():
+            value = got[name][field]
+            if isinstance(expect, float) and np.isfinite(expect):
+                assert value == pytest.approx(expect, rel=1e-5), \
+                    (name, field)
+            else:
+                assert value == expect or (
+                    value != value and expect != expect), (name, field)
+
+
+def test_resume_with_staged_scan(tmp_path, parquet_source, monkeypatch):
+    """Checkpointing must compose with the staged multi-batch dispatch:
+    a due checkpoint forces a flush so the saved cursor equals the
+    device-folded count, and full groups still take the scan path
+    (checkpoint_every a multiple of scan_batches)."""
+    control = TPUStatsBackend().collect(
+        parquet_source, ProfilerConfig(backend="tpu", batch_rows=256))
+
+    cfg = _cfg(tmp_path, scan_batches=2, checkpoint_every_batches=4)
+    calls = {"n": 0}
+    real_update = HostAgg.update
+
+    def crashing_update(self, hb):
+        calls["n"] += 1
+        if calls["n"] == 10:
+            raise RuntimeError("injected crash mid-scan")
+        return real_update(self, hb)
+
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        TPUStatsBackend().collect(parquet_source, cfg)
+    monkeypatch.setattr(HostAgg, "update", real_update)
+    assert (tmp_path / "scan.ckpt").exists()
+
+    resumed = TPUStatsBackend().collect(parquet_source, cfg)
+    assert resumed["table"]["n"] == 4000
     ctrl, got = _key_stats(control), _key_stats(resumed)
     for name in ctrl:
         for field, expect in ctrl[name].items():

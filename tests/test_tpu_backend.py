@@ -197,6 +197,48 @@ def test_device_count_invariance(fixture_df):
                                           v8["histogram"][0])
 
 
+def test_staged_scan_matches_per_batch(fixture_df):
+    """The staged multi-batch scan_a/scan_b dispatch (VERDICT r2 #1:
+    the production path must take the benched path) must produce the
+    same stats as per-batch dispatch — same fold order, one program."""
+    per_batch = TPUStatsBackend().collect(fixture_df, _cfg(scan_batches=1))
+    staged = TPUStatsBackend().collect(
+        fixture_df, _cfg(scan_batches=2, spearman=True))
+    for name, pv in per_batch["variables"].items():
+        sv = staged["variables"][name]
+        assert sv["type"] == pv["type"], name
+        for fld in ("count", "n_missing", "distinct_count", "n_zeros",
+                    "freq"):
+            if fld in pv:
+                assert sv[fld] == pv[fld], (name, fld)
+        for fld in ("mean", "std", "skewness", "min", "max", "sum",
+                    "mad", "p50"):
+            if fld in pv and isinstance(pv[fld], float) \
+                    and np.isfinite(pv[fld]):
+                assert sv[fld] == pytest.approx(pv[fld], rel=1e-5), \
+                    (name, fld)
+    # histograms are exact counts — must match bin for bin
+    for name, pv in per_batch["variables"].items():
+        if pv["type"] == schema.NUM and pv["histogram"] is not None:
+            np.testing.assert_array_equal(
+                staged["variables"][name]["histogram"][0],
+                pv["histogram"][0], err_msg=name)
+    # spearman matrix computed through the staged fold is well-formed
+    sp = staged["correlations"]["spearman"]
+    assert (np.abs(np.asarray(sp, dtype=float)) <= 1.0 + 1e-6).all()
+
+
+def test_staged_scan_tail_group(fixture_df):
+    """A scan_batches that does not divide the batch count exercises the
+    full-group + per-batch-tail mixed path."""
+    stats = TPUStatsBackend().collect(fixture_df, _cfg(scan_batches=3))
+    # 2000 rows / 512 = 4 batches -> one full group of 3 + tail of 1
+    assert stats["table"]["n"] == 2000
+    control = TPUStatsBackend().collect(fixture_df, _cfg(scan_batches=1))
+    for name, cv in control["variables"].items():
+        assert stats["variables"][name]["count"] == cv["count"], name
+
+
 def test_parquet_path_source(fixture_df, tmp_path):
     import pyarrow as pa
     import pyarrow.parquet as pq

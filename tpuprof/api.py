@@ -76,9 +76,10 @@ class ProfileReport:
 
     def _perf_line(self) -> str:
         """Report-footer observability (SURVEY §5): per-phase wall-clock +
-        throughput for the scan that produced this report."""
-        from tpuprof.utils.trace import get_phase_report
-        phases = get_phase_report()
+        throughput for the scan that produced THIS report (snapshotted on
+        the stats dict by the backend — the process's global phase totals
+        may describe a later profile by render time)."""
+        phases = self.description.get("_phases") or {}
         scan = sum(v for k, v in phases.items() if k.startswith("scan"))
         if not scan:
             return ""

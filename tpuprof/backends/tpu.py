@@ -344,6 +344,11 @@ class TPUStatsBackend:
     def collect(self, source: Any, config: ProfilerConfig) -> Dict[str, Any]:
         import jax
 
+        from tpuprof.utils.trace import get_phase_report
+        get_phase_report(reset=True)    # drop earlier profiles' phases —
+        # this profile's timings are snapshotted onto ITS stats dict at
+        # the end of collect, so a report's footer can never describe a
+        # different profile's scan
         if config.compile_cache_dir:
             _enable_compile_cache(config.compile_cache_dir)
         from tpuprof.runtime.distributed import (merge_corr_states,
@@ -410,6 +415,40 @@ class TPUStatsBackend:
         resume_pos = (resume_frag[0], resume_frag[1] + 1) \
             if use_positions and resume_frag is not None else None
 
+        scan_s = max(int(config.scan_batches), 1)
+        if resume is not None and scan_s > 1 \
+                and resume.every % scan_s != 0:
+            # every due checkpoint forces a flush, so a cadence that is
+            # not a multiple of the group size keeps truncating groups —
+            # silently paying per-batch dispatch latency would defeat
+            # the staged path the user asked for
+            from tpuprof.utils.trace import logger
+            logger.warning(
+                "checkpoint_every_batches=%d is not a multiple of "
+                "scan_batches=%d: checkpoint flushes truncate staged "
+                "groups, so some (or all) dispatches fall back to the "
+                "per-batch path — align the cadence to keep the "
+                "multi-batch scan", resume.every, scan_s)
+        with_hll = host_hll is None
+
+        def flush_a(pending):
+            """Fold the buffered batches into the device state: a FULL
+            group ships as one stacked placement folded by a single
+            multi-batch scan_a dispatch (the benched fast path —
+            amortizes per-dispatch latency); partial groups (tails,
+            checkpoint boundaries) fold per-batch through step_a, which
+            reuses one fixed compiled signature instead of compiling a
+            scan program per group size."""
+            nonlocal state
+            if len(pending) == scan_s and scan_s > 1:
+                sb = runner.stage_batches(pending, with_hll=with_hll)
+                state = runner.scan_a(state, sb)
+            else:
+                for p in pending:
+                    state = runner.step_a(
+                        state, runner.put_batch(p, with_hll=with_hll))
+            pending.clear()
+
         with phase_timer("scan_a"):
             # centering shift from the first batch's prefix — any value
             # near the data scale conditions the f32 sums equally well.
@@ -419,6 +458,7 @@ class TPUStatsBackend:
             # merge's rebase is exactly the identity.
             batches = prefetch_prepared(
                 ingest, plan, pad, config.hll_precision,
+                depth=max(2, min(scan_s, 8)),
                 skip_batches=0 if use_positions else skip,
                 positions=use_positions, resume_pos=resume_pos)
             first_hb = next(batches, None)
@@ -428,20 +468,28 @@ class TPUStatsBackend:
                     if first_hb is not None else None)
                 state = runner.init_pass_a(shift)
             last_frag = resume_frag
+            pending: List[HostBatch] = []
             if first_hb is not None:
                 for hb in itertools.chain((first_hb,), batches):
-                    db = runner.put_batch(hb, with_hll=host_hll is None)
-                    state = runner.step_a(state, db)  # transfer is async —
-                    # the host-side folds below overlap the device step
+                    # host-side folds run as batches arrive (they overlap
+                    # the async device dispatches of earlier groups)
                     sampler.update(hb.x, hb.nrows)
                     if host_hll is not None:
                         host_hll.update(hb.hll, hb.nrows)
                     hostagg.update(hb)
+                    pending.append(hb)
                     cursor += 1
                     last_frag = hb.frag_pos or last_frag
-                    if resume is not None and resume.due(cursor):
-                        resume.save(state, sampler, hostagg, host_hll,
-                                    cursor, frag_pos=last_frag)
+                    # a due checkpoint forces a flush so the artifact's
+                    # cursor equals the device-folded batch count (host
+                    # and device views agree only at flush boundaries)
+                    ckpt_due = resume is not None and resume.due(cursor)
+                    if len(pending) >= scan_s or ckpt_due:
+                        flush_a(pending)
+                        if ckpt_due:
+                            resume.save(state, sampler, hostagg, host_hll,
+                                        cursor, frag_pos=last_frag)
+                flush_a(pending)
         if resume is not None and resume.last_saved != cursor:
             # pass A complete: keep the final state on disk so a crash
             # during merge/pass-B resumes with the whole stream skipped
@@ -519,22 +567,59 @@ class TPUStatsBackend:
                                                         dtype=np.int32)
                     sorted_sample = runner.put_replicated(srt,
                                                           dtype=np.float32)
+            def fold_spear(st, db_or_sb, staged):
+                if runner.spear_grid:
+                    if staged:
+                        return runner.scan_spearman_grid(st, db_or_sb,
+                                                         spear_grid)
+                    return runner.step_spearman_grid(st, db_or_sb,
+                                                     spear_grid)
+                if staged:
+                    # exact tier has no scan program (CPU meshes, where
+                    # dispatch latency is negligible) — re-read the
+                    # staged device slices per batch, no re-transfer
+                    for i in range(db_or_sb.n_batches):
+                        st = runner.step_spearman(
+                            st, runner.slice_staged(db_or_sb, i),
+                            sorted_sample, kept_counts)
+                    return st
+                return runner.step_spearman(st, db_or_sb, sorted_sample,
+                                            kept_counts)
+
+            def flush_b(pending):
+                """Pass-B twin of flush_a: full groups take the staged
+                scan_b dispatch (and the Spearman state folds from the
+                SAME staged placement — one transfer feeds both)."""
+                nonlocal state_b, spear_state
+                if len(pending) == scan_s and scan_s > 1:
+                    sb = runner.stage_batches(pending, with_hll=False)
+                    state_b = runner.scan_b(state_b, sb, lo_d, hi_d,
+                                            mean_d)
+                    if spear_state is not None:
+                        spear_state = fold_spear(spear_state, sb, True)
+                else:
+                    for p in pending:
+                        db = runner.put_batch(p, with_hll=False)
+                        state_b = runner.step_b(state_b, db, lo_d, hi_d,
+                                                mean_d)
+                        if spear_state is not None:
+                            spear_state = fold_spear(spear_state, db,
+                                                     False)
+                pending.clear()
+
             with phase_timer("scan_b"):
                 # hashes=False: pass B never reads the HLL plane, so the
                 # host hash loop is skipped on the second scan
+                pending_b: List[HostBatch] = []
                 for hb in prefetch_prepared(ingest, plan, pad,
                                             config.hll_precision,
+                                            depth=max(2, min(scan_s, 8)),
                                             hashes=False):
-                    db = runner.put_batch(hb, with_hll=False)
-                    state_b = runner.step_b(state_b, db, lo_d, hi_d, mean_d)
-                    if spear_state is not None:
-                        if runner.spear_grid:
-                            spear_state = runner.step_spearman_grid(
-                                spear_state, db, spear_grid)
-                        else:
-                            spear_state = runner.step_spearman(
-                                spear_state, db, sorted_sample, kept_counts)
                     recounter.update(hb)
+                    pending_b.append(hb)
+                    if len(pending_b) >= scan_s:
+                        flush_b(pending_b)
+                flush_b(pending_b)
                 res_b = merge_pass_b_states(runner.finalize_b(state_b))
                 recounter.counts = merge_recount_arrays(recounter.counts)
             if spear_state is not None:
@@ -568,6 +653,10 @@ class TPUStatsBackend:
                           probes, rho_spear=rho_spear)
         if resume is not None:
             resume.clear()           # profile assembled: artifact is stale
+        # this profile's phase timings ride the stats dict (the report
+        # footer reads them from there — global state would attribute
+        # another profile's scan to this report)
+        stats["_phases"] = get_phase_report(reset=True)
         return stats
 
 
@@ -782,12 +871,16 @@ def _numeric_stats(lane, spec, momf, quants, sample_vals, sample_kept,
             out["histogram"] = None
     out["mini_histogram"] = out["histogram"]
     out["mode"] = _sample_mode(sample_vals[lane], sample_kept[lane])
-    # exact iff the sample holds EVERY finite value of the column (then
+    # exact iff the sample holds EVERY value of the column (then
     # _sample_mode is a full value-count); otherwise it is a sample
     # estimate and says so — the reference's mode is exact value-counts,
-    # and a silent estimate would claim parity it does not have
+    # and a silent estimate would claim parity it does not have.  A
+    # column with infinities is never claimed exact: the sample keeps
+    # finite values only, while the reference's value-counts include inf
+    # (so inf could BE the true mode).
     out["mode_approx"] = \
-        int(sample_kept[lane].sum()) < int(momf["n"][lane])
+        int(sample_kept[lane].sum()) < int(momf["n"][lane]) \
+        or int(momf["n_inf"][lane]) > 0
     return out
 
 
@@ -809,7 +902,7 @@ def _const_mode(spec, momf, hostagg):
 def _empty_stats(config) -> Dict[str, Any]:
     return {
         "table": schema.make_table_stats(0, {}),
-        "variables": schema.VariablesView(),
+        "variables": {},
         "freq": {},
         "correlations": {"pearson": pd.DataFrame()},
         "messages": [],

@@ -26,6 +26,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bins", type=int, default=10)
     p.add_argument("--corr-reject", type=float, default=0.9)
     p.add_argument("--batch-rows", type=int, default=1 << 16)
+    p.add_argument("--scan-batches", type=int, default=8, metavar="S",
+                   help="prepared batches staged per device dispatch "
+                        "(multi-batch scan; 1 disables staging)")
     p.add_argument("--sketch-size", type=int, default=4096,
                    help="quantile sample-sketch size K")
     p.add_argument("--hll-precision", type=int, default=11)
@@ -82,7 +85,8 @@ def cmd_profile(args: argparse.Namespace) -> int:
 
     config = ProfilerConfig(
         backend=args.backend, bins=args.bins, corr_reject=args.corr_reject,
-        batch_rows=args.batch_rows, quantile_sketch_size=args.sketch_size,
+        batch_rows=args.batch_rows, scan_batches=args.scan_batches,
+        quantile_sketch_size=args.sketch_size,
         hll_precision=args.hll_precision, exact_passes=not args.single_pass,
         spearman=args.spearman, checkpoint_path=args.checkpoint,
         checkpoint_every_batches=args.checkpoint_every,

@@ -34,6 +34,16 @@ class ProfilerConfig:
 
     # ---- TPU runtime knobs ------------------------------------------------
     batch_rows: int = 1 << 16       # rows per Arrow batch fed to the device
+    scan_batches: int = 8           # S: prepared batches staged per device
+                                    # dispatch — full groups fold through
+                                    # ONE multi-batch scan_a/scan_b program
+                                    # (amortizing the ~15ms per-dispatch
+                                    # latency that otherwise dominates the
+                                    # ~2ms fused kernel); partial groups
+                                    # (tails, checkpoint boundaries) fold
+                                    # per-batch.  1 disables staging.
+                                    # Host+HBM hold S staged batches, so
+                                    # memory scales with S*batch_rows*cols.
     quantile_sketch_size: int = 4096  # K: uniform row-sample size shared by
                                       # all numeric columns (ingest/sample.py);
                                       # a column keeps ~K*(1-p_missing) finite
@@ -102,6 +112,8 @@ class ProfilerConfig:
     def __post_init__(self) -> None:
         if self.bins < 1:
             raise ValueError("bins must be >= 1")
+        if self.scan_batches < 1:
+            raise ValueError("scan_batches must be >= 1")
         if not 0.0 < self.corr_reject <= 1.0:
             raise ValueError("corr_reject must be in (0, 1]")
         if not 2 <= self.spearman_grid <= 4096:

@@ -308,6 +308,16 @@ class MeshRunner:
             s = _unstack(state)
             return _restack(fused.spearman_update(s, xt, row_valid, grid))
 
+        def local_scan_spear_grid(state, xts, row_valids, grid):
+            """Multi-batch Spearman grid fold (same latency amortization
+            as scan_a/scan_b — one dispatch for S staged batches)."""
+            def body(carry, inp):
+                xt, rv = inp
+                return fused.spearman_update(carry, xt, rv, grid), None
+            out, _ = jax.lax.scan(body, _unstack(state),
+                                  (xts, row_valids))
+            return _restack(out)
+
         def local_rank_grid(xt, row_valid, grid):
             return fused.rank_transform(xt, row_valid, grid)
 
@@ -394,6 +404,12 @@ class MeshRunner:
             in_specs=(state_spec, cols_rows_spec, rows_spec, rep),
             out_specs=state_spec, check_vma=False),
             donate_argnums=(0,))
+        self._scan_spear_grid = jax.jit(shard_map(
+            local_scan_spear_grid, mesh=mesh,
+            in_specs=(state_spec, P(None, None, "data"), P(None, "data"),
+                      rep),
+            out_specs=state_spec, check_vma=False),
+            donate_argnums=(0,))
         # wide tier: rank transform and rank Gram are SEPARATE dispatches
         # (two pallas calls in one module trip scoped-VMEM accounting)
         self._rank_grid = jax.jit(shard_map(
@@ -470,6 +486,27 @@ class MeshRunner:
                                          grid_d)
         ranks = self._rank_grid(db.xt, db.row_valid, grid_d)
         return self._step_spear_wide(state, ranks, db.row_valid)
+
+    def scan_spearman_grid(self, state: Pytree, sb: "StackedBatch",
+                           grid) -> Pytree:
+        """Fold ``sb.n_batches`` staged batches into the Spearman grid
+        state.  Narrow widths run one multi-batch program; the wide tier
+        keeps its two-program-per-batch structure (two pallas calls in
+        one module trip scoped-VMEM accounting — PERF.md) but re-reads
+        the already-staged device slices, so no host data re-ships."""
+        grid_d = self.put_replicated(grid, dtype=jnp.float32)
+        if self.n_num <= fused.MAX_FUSED_COLS:
+            return self._scan_spear_grid(state, sb.xts, sb.row_valids,
+                                         grid_d)
+        for i in range(sb.n_batches):
+            ranks = self._rank_grid(sb.xts[i], sb.row_valids[i], grid_d)
+            state = self._step_spear_wide(state, ranks, sb.row_valids[i])
+        return state
+
+    def slice_staged(self, sb: "StackedBatch", i: int) -> DeviceBatch:
+        """One staged batch as a DeviceBatch view (device-side slice — a
+        per-batch program can consume staged data without re-transfer)."""
+        return DeviceBatch(sb.xts[i], sb.row_valids[i], sb.hllts[i])
 
     def finalize_spearman(self, state: Pytree):
         return jax.device_get(
