@@ -162,8 +162,12 @@ def run_streaming(scale: float, workdir: str, backend: str) -> dict:
     n_batches = max(int(1000 * scale), 10)
     rng = np.random.default_rng(0)
     example = scenarios.taxi_batch(rng, 64)
+    # default batch_rows (64k): ~6 micro-batches coalesce per device
+    # dispatch (StreamingProfiler buffers to a full device batch) —
+    # round 2 pinned batch_rows=micro, which made every 10k micro-batch
+    # pay its own padded transfer + dispatch (62k rows/s, PERF.md)
     prof = StreamingProfiler.for_example(
-        example, config=ProfilerConfig(batch_rows=micro))
+        example, config=ProfilerConfig())
     t0 = time.perf_counter()
     for i in range(n_batches):
         prof.update(scenarios.taxi_batch(rng, micro))

@@ -28,6 +28,60 @@ def _micro_batches(n_batches=8, rows=250, seed=0):
     return out
 
 
+def test_micro_batches_coalesce_into_full_dispatches():
+    """10k-row micro-batches against a larger device batch must coalesce:
+    far fewer device dispatches than micro-batches (VERDICT r2 #5), with
+    stats unchanged."""
+    batches = _micro_batches(n_batches=16, rows=100)
+    prof = StreamingProfiler.for_example(batches[0],
+                                         config=_cfg(batch_rows=1024))
+    for b in batches:
+        prof.update(b)
+    # 1600 rows buffered at a 1024-row device batch: exactly ONE full
+    # dispatch has happened; the 576-row remainder is still buffered
+    assert prof.cursor == 1
+    assert prof._buf_rows == 1600 - 1024
+    stats = prof.stats()                   # snapshot force-drains
+    assert stats["table"]["n"] == 1600
+    assert prof._buf_rows == 0
+    full = pd.concat(batches, ignore_index=True)
+    oracle = CPUStatsBackend().collect(full, _cfg(backend="cpu"))
+    for col in ("x", "y"):
+        assert stats["variables"][col]["count"] == \
+            oracle["variables"][col]["count"]
+        assert stats["variables"][col]["mean"] == pytest.approx(
+            oracle["variables"][col]["mean"], rel=1e-4)
+    # streaming continues after the snapshot
+    prof.update(batches[0])
+    assert prof.stats()["table"]["n"] == 1700
+
+
+def test_snapshot_mid_buffer_is_complete():
+    """A snapshot taken while rows sit in the coalescing buffer must
+    still cover every row ever passed to update()."""
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame({"x": rng.normal(size=50)})
+    prof = StreamingProfiler.for_example(df, config=_cfg(batch_rows=4096))
+    prof.update(df)
+    assert prof.cursor == 0                # nothing dispatched yet
+    stats = prof.stats()
+    assert stats["table"]["n"] == 50
+    assert stats["variables"]["x"]["mean"] == pytest.approx(
+        float(df["x"].mean()), rel=1e-5)
+
+
+def test_stream_flush_rows_below_device_batch():
+    """stream_flush_rows smaller than the device batch trades padding
+    for freshness: each quantum dispatches immediately."""
+    batches = _micro_batches(n_batches=4, rows=100)
+    prof = StreamingProfiler.for_example(
+        batches[0], config=_cfg(batch_rows=4096, stream_flush_rows=100))
+    for b in batches:
+        prof.update(b)
+    assert prof.cursor == 4                # one dispatch per micro-batch
+    assert prof.stats()["table"]["n"] == 400
+
+
 def test_running_profile_matches_batch_oracle():
     batches = _micro_batches()
     prof = StreamingProfiler.for_example(batches[0], config=_cfg())

@@ -67,6 +67,17 @@ class ProfilerConfig:
                                     # False => single-pass streaming mode with
                                     # sample-derived histograms.
     mesh_devices: Optional[int] = None  # None => all available devices
+    stream_flush_rows: Optional[int] = None  # StreamingProfiler: rows to
+                                             # coalesce before a device
+                                             # dispatch (None = one full
+                                             # device batch).  Small
+                                             # micro-batches otherwise pay
+                                             # a padded transfer + ~15ms
+                                             # dispatch EACH; coalescing
+                                             # folds full batches.  Values
+                                             # below the device batch size
+                                             # trade throughput for
+                                             # snapshot freshness.
     compile_cache_dir: Optional[str] = None  # persist XLA executables
                                              # here so a fresh process
                                              # skips the one-time
@@ -114,6 +125,8 @@ class ProfilerConfig:
             raise ValueError("bins must be >= 1")
         if self.scan_batches < 1:
             raise ValueError("scan_batches must be >= 1")
+        if self.stream_flush_rows is not None and self.stream_flush_rows < 1:
+            raise ValueError("stream_flush_rows must be >= 1 (or None)")
         if not 0.0 < self.corr_reject <= 1.0:
             raise ValueError("corr_reject must be in (0, 1]")
         if not 2 <= self.spearman_grid <= 4096:

@@ -83,11 +83,23 @@ class StreamingProfiler:
         self.host_hll = khll.HostRegisters(
             self.plan.n_hash, self.config.hll_precision) \
             if self.plan.n_hash > 0 and native.available() else None
-        # device state is created on the first micro-batch so the fused
+        # device state is created on the first folded batch so the fused
         # kernel's centering shift can come from real data
         self.state = None
-        self.cursor = 0                      # micro-batches folded in
+        self.cursor = 0                      # device batches folded in
         self._sample: Optional[pd.DataFrame] = None
+        # micro-batch coalescing (BASELINE config 5 is 10k-row
+        # micro-batches against a 64k-row device batch): buffered rows
+        # fold only when a full device batch accumulates — otherwise
+        # every micro-batch pays a mostly-padding transfer plus one
+        # dispatch (measured dispatch-latency-bound at 62k rows/s,
+        # PERF.md).  Snapshots/checkpoints force-drain the buffer first,
+        # so mid-buffer stats are always complete.
+        self._flush_rows = self.config.stream_flush_rows \
+            if self.config.stream_flush_rows is not None \
+            else self.runner.rows
+        self._buf: list = []                 # pending pa.RecordBatches
+        self._buf_rows = 0
 
     @classmethod
     def for_example(cls, example: Any, **kwargs) -> "StreamingProfiler":
@@ -106,8 +118,9 @@ class StreamingProfiler:
     # -- ingestion ---------------------------------------------------------
 
     def update(self, batch: Any) -> None:
-        """Fold one micro-batch (pandas DataFrame / Arrow Table or
-        RecordBatch) into the running profile."""
+        """Buffer one micro-batch (pandas DataFrame / Arrow Table or
+        RecordBatch); folds into the device state whenever a full flush
+        quantum has accumulated."""
         for rb in _to_record_batches(batch, self.arrow_schema):
             if self._sample is None or len(self._sample) < \
                     self.config.sample_rows:
@@ -116,32 +129,69 @@ class StreamingProfiler:
                 self._sample = head if self._sample is None else pd.concat(
                     [self._sample, head], ignore_index=True).head(
                         self.config.sample_rows)
-            # micro-batches larger than the device batch are chunked
-            for start in range(0, rb.num_rows, self.runner.rows):
-                chunk = rb.slice(start, self.runner.rows)
-                hb = prepare_batch(chunk, self.plan, self.runner.rows,
-                                   self.config.hll_precision)
-                if self.state is None:
-                    from tpuprof.backends.tpu import estimate_shift
-                    self.state = self.runner.init_pass_a(estimate_shift(hb))
-                db = self.runner.put_batch(
-                    hb, with_hll=self.host_hll is None)
-                self.state = self.runner.step_a(self.state, db, self.cursor)
-                self.sampler.update(hb.x, hb.nrows)
-                if self.host_hll is not None:
-                    self.host_hll.update(hb.hll, hb.nrows)
-                self.hostagg.update(hb)
-                self.cursor += 1
+            if rb.schema != self.arrow_schema:
+                # names/types already validated; this normalizes
+                # nullability/metadata-only differences, which
+                # Table.from_batches in _drain would otherwise reject
+                # (schema equality there is strict) — zero-copy cast
+                rb = rb.cast(self.arrow_schema)
+            self._buf.append(rb)
+            self._buf_rows += rb.num_rows
+        if self._buf_rows >= self._flush_rows:
+            self._drain(force=False)
         log_event("stream_update", cursor=self.cursor,
-                  rows=self.hostagg.n_rows)
+                  rows=self.hostagg.n_rows + self._buf_rows,
+                  buffered=self._buf_rows)
+
+    def _fold(self, tbl: pa.Table) -> None:
+        """Fold one <=device-batch slice of buffered rows."""
+        combined = tbl.combine_chunks()
+        rbs = combined.to_batches()
+        if not rbs:
+            return
+        hb = prepare_batch(rbs[0], self.plan, self.runner.rows,
+                           self.config.hll_precision)
+        if self.state is None:
+            from tpuprof.backends.tpu import estimate_shift
+            self.state = self.runner.init_pass_a(estimate_shift(hb))
+        db = self.runner.put_batch(hb, with_hll=self.host_hll is None)
+        self.state = self.runner.step_a(self.state, db, self.cursor)
+        self.sampler.update(hb.x, hb.nrows)
+        if self.host_hll is not None:
+            self.host_hll.update(hb.hll, hb.nrows)
+        self.hostagg.update(hb)
+        self.cursor += 1
+
+    def _drain(self, force: bool) -> None:
+        """Fold buffered rows: full device batches always; the partial
+        remainder only when forced (snapshot/checkpoint) or when the
+        user chose a flush quantum below the device batch size."""
+        if not self._buf_rows:
+            return
+        rows = self.runner.rows
+        tbl = pa.Table.from_batches(self._buf)
+        n, pos = tbl.num_rows, 0
+        while n - pos >= rows:
+            self._fold(tbl.slice(pos, rows))
+            pos += rows
+        if pos < n and (force or self._flush_rows < rows):
+            self._fold(tbl.slice(pos))
+            pos = n
+        rem = tbl.slice(pos)
+        self._buf = rem.to_batches() if rem.num_rows else []
+        self._buf_rows = rem.num_rows
 
     # -- snapshots ---------------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
-        """Snapshot the stats dict (non-destructive; streaming continues)."""
+        """Snapshot the stats dict (non-destructive; streaming continues).
+        Buffered micro-batches are folded first, so a snapshot taken
+        mid-buffer is complete — it covers every row ever passed to
+        ``update``."""
         from tpuprof.backends.tpu import _assemble, _empty_stats
         if not self.plan.specs:
             return _empty_stats(self.config)
+        self._drain(force=True)
         state = self.state if self.state is not None \
             else self.runner.init_pass_a()
         res = self.runner.finalize_a(state)
@@ -167,7 +217,10 @@ class StreamingProfiler:
     # -- durability --------------------------------------------------------
 
     def checkpoint(self, path: str) -> None:
-        """Persist (device state, host aggregators, cursor) atomically."""
+        """Persist (device state, host aggregators, cursor) atomically.
+        Buffered rows fold first — the artifact must cover every row the
+        caller handed to ``update`` (the buffer itself is not saved)."""
+        self._drain(force=True)
         host_blob = {
             "hostagg": self.hostagg,
             "sampler": self.sampler,
