@@ -45,6 +45,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "from PATH after a crash")
     p.add_argument("--checkpoint-every", type=int, default=64,
                    metavar="N", help="batches between checkpoints")
+    dist = p.add_argument_group(
+        "multi-host", "launch the same command on every host (the "
+        "framework owns its launch — no spark-submit analogue needed); "
+        "each host scans its own fragment stripe and host 0 writes the "
+        "complete merged report")
+    dist.add_argument("--coordinator", metavar="HOST:PORT",
+                      help="jax.distributed coordinator address "
+                           "(e.g. 10.0.0.1:8476)")
+    dist.add_argument("--num-processes", type=int, metavar="N",
+                      help="total number of participating processes")
+    dist.add_argument("--process-id", type=int, metavar="I",
+                      help="this process's rank in [0, N)")
     cache_group = p.add_mutually_exclusive_group()
     cache_group.add_argument(
         "--compile-cache", metavar="DIR", default=None,
@@ -65,6 +77,34 @@ def cmd_profile(args: argparse.Namespace) -> int:
         print("tpuprof: error: --spearman needs the second scan "
               "(incompatible with --single-pass)", file=sys.stderr)
         return 2
+
+    multi_host = args.coordinator is not None \
+        or args.num_processes is not None or args.process_id is not None
+    if multi_host:
+        if args.coordinator is None or args.num_processes is None \
+                or args.process_id is None:
+            print("tpuprof: error: multi-host needs all three of "
+                  "--coordinator, --num-processes and --process-id",
+                  file=sys.stderr)
+            return 2
+        if args.checkpoint:
+            print("tpuprof: error: --checkpoint is single-process only "
+                  "(multi-host profiles restart from the beginning)",
+                  file=sys.stderr)
+            return 2
+        if args.backend == "cpu":
+            print("tpuprof: error: --backend cpu has no fragment "
+                  "striping — every process would profile the whole "
+                  "dataset; multi-host requires the tpu engine (which "
+                  "also runs on CPU devices)", file=sys.stderr)
+            return 2
+        # 'auto' could resolve to the pandas oracle on a CPU-only
+        # cluster, which ignores process striping — the tpu engine is
+        # the multi-host engine on every platform
+        args.backend = "tpu"
+        # must run before ANY other jax usage in this process
+        from tpuprof.runtime.distributed import initialize
+        initialize(args.coordinator, args.num_processes, args.process_id)
 
     if args.no_compile_cache:
         cache_dir = None
@@ -96,16 +136,25 @@ def cmd_profile(args: argparse.Namespace) -> int:
     with trace_to(args.trace):
         with phase_timer("profile"):
             report = ProfileReport(args.source, config=config)
-        with phase_timer("render"):
-            report.to_file(args.output)
+        # every host computes the complete merged stats (the cross-host
+        # merges are allgathers), but only host 0 renders + writes —
+        # N processes racing one output path helps nobody
+        write_output = True
+        if multi_host:
+            import jax
+            write_output = jax.process_index() == 0
+        if write_output:
+            with phase_timer("render"):
+                report.to_file(args.output)
     elapsed = time.perf_counter() - t0
 
     table = report.description["table"]
     rate = table["n"] / elapsed if elapsed > 0 else float("nan")
+    wrote = args.output if write_output else "(report written by host 0)"
     print(f"tpuprof: {table['n']:,} rows x {table['nvar']} cols -> "
-          f"{args.output} in {elapsed:.2f}s ({rate:,.0f} rows/s)",
+          f"{wrote} in {elapsed:.2f}s ({rate:,.0f} rows/s)",
           file=sys.stderr)
-    if args.stats_json:
+    if args.stats_json and write_output:
         from tpuprof.report.formatters import fmt_value
         payload = {
             name: {k: fmt_value(v) for k, v in var.items()
