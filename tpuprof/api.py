@@ -70,22 +70,8 @@ class ProfileReport:
     def html(self) -> str:
         if self._html is None:
             from tpuprof.report.render import to_html
-            self._html = to_html(self.description, self.config,
-                                 perf=self._perf_line())
+            self._html = to_html(self.description, self.config)
         return self._html
-
-    def _perf_line(self) -> str:
-        """Report-footer observability (SURVEY §5): per-phase wall-clock +
-        throughput for the scan that produced THIS report (snapshotted on
-        the stats dict by the backend — the process's global phase totals
-        may describe a later profile by render time)."""
-        phases = self.description.get("_phases") or {}
-        scan = sum(v for k, v in phases.items() if k.startswith("scan"))
-        if not scan:
-            return ""
-        n = self.description["table"]["n"]
-        parts = [f"{k} {v:.2f}s" for k, v in sorted(phases.items())]
-        return f"{n / scan:,.0f} rows/s · " + " · ".join(parts)
 
     def to_file(self, outputfile: str) -> None:
         """Reference: ProfileReport.to_file — wraps the fragment with the

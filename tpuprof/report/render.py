@@ -67,8 +67,21 @@ def _get_env() -> jinja2.Environment:
     return _ENV
 
 
-def to_html(stats: Dict[str, Any], config: ProfilerConfig,
-            perf: str = "") -> str:
+def _perf_line(stats: Dict[str, Any]) -> str:
+    """Report-footer observability (SURVEY §5): per-phase wall-clock +
+    throughput of the scan that produced this stats dict (the backend
+    snapshots its phase timings onto ``stats['_phases']``; absent — CPU
+    oracle, streaming snapshots — the footer is simply omitted)."""
+    phases = stats.get("_phases") or {}
+    scan = sum(v for k, v in phases.items() if k.startswith("scan"))
+    if not scan:
+        return ""
+    n = stats["table"]["n"]
+    parts = [f"{k} {v:.2f}s" for k, v in sorted(phases.items())]
+    return f"{n / scan:,.0f} rows/s · " + " · ".join(parts)
+
+
+def to_html(stats: Dict[str, Any], config: ProfilerConfig) -> str:
     """Render the report fragment (reference: ProfileReport.html)."""
     from tpuprof import __version__
     template = _get_env().get_template("report.html")
@@ -81,7 +94,7 @@ def to_html(stats: Dict[str, Any], config: ProfilerConfig,
         sample=stats.get("sample"),
         config=config,
         version=__version__,
-        perf=perf,
+        perf=_perf_line(stats),
     )
 
 
