@@ -185,3 +185,61 @@ def test_dictionary_cache_distinguishes_slices():
     v2, _, _ = _dictionary_views(cache, "col", parent.slice(3, 3), False)
     assert list(v1) == ["a", "b", "c"]
     assert list(v2) == ["d", "e", "f"]
+
+
+def test_plain_string_rowhash_path_matches_dictionary_path():
+    """The high-cardinality plain-string fast path (native row hash +
+    factorize, no dictionary_encode — VERDICT r2 #8) must produce the
+    SAME packed HLL plane as the dictionary path (bit-equal: both are
+    xxh64 of the value bytes) and the same (value, count) aggregation."""
+    from tpuprof import native
+    if not native.available():
+        pytest.skip("native extension unavailable")
+    rng = np.random.default_rng(5)
+    vals = np.array([f"k{z:06d}" for z in rng.integers(0, 4000, 8192)],
+                    dtype=object)
+    vals[rng.choice(8192, 300, replace=False)] = None
+    table = pa.Table.from_pandas(pd.DataFrame({"s": vals}),
+                                 preserve_index=False)
+    ing = ArrowIngest(table, 8192)
+    rb = next(iter(ing.raw_batches()))
+
+    hb_dict = prepare_batch(rb, ing.plan, 8192, 11)      # no col_stats
+    assert "s" in hb_dict.cat_codes and not hb_dict.cat_hashed
+
+    hb_hash = prepare_batch(rb, ing.plan, 8192, 11,
+                            col_stats={"s": 20000})      # primed past threshold
+    assert "s" in (hb_hash.cat_hashed or {})
+    assert "s" not in hb_hash.cat_codes
+    # identical packed HLL observations — the two paths hash the same
+    # bytes with the same function, so registers merge across them
+    np.testing.assert_array_equal(hb_hash.hll, hb_dict.hll)
+
+    # aggregation equivalence: (value -> count) maps match exactly
+    codes, dvals = hb_dict.cat_codes["s"]
+    valid_codes = codes[codes >= 0]
+    want = {}
+    for c in valid_codes:
+        want[dvals[c]] = want.get(dvals[c], 0) + 1
+    uniq, cnts, first_row, row_hashes, valid, arr = hb_hash.cat_hashed["s"]
+    assert int(cnts.sum()) == len(valid_codes)
+    assert len(uniq) == len(want)
+    got = {}
+    for h, c, fr in zip(uniq, cnts, first_row):
+        got[arr[int(fr)].as_py()] = int(c)
+    assert got == want
+    # the memo learned this batch's cardinality
+    cs = {"s": 20000}
+    prepare_batch(rb, ing.plan, 8192, 11, col_stats=cs)
+    assert cs["s"] == len(uniq)
+
+
+def test_low_cardinality_stays_on_dictionary_path():
+    """Below ROWHASH_MIN_DISTINCT the dictionary_encode path is faster
+    and must remain the choice even with a primed memo."""
+    table = _table(512)
+    ing = ArrowIngest(table, 512)
+    rb = next(iter(ing.raw_batches()))
+    hb = prepare_batch(rb, ing.plan, 512, 11, col_stats={"s": 3})
+    assert "s" in hb.cat_codes
+    assert not hb.cat_hashed

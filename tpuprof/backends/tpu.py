@@ -118,6 +118,28 @@ class HostAgg:
             if first:
                 self.first_values[name] = [
                     dvals[c] if c >= 0 else None for c in codes[:5]]
+        for name, payload in (hb.cat_hashed or {}).items():
+            # plain-string fast path: per-batch hash aggregation with NO
+            # dictionary (ingest/arrow.py) — values materialize only for
+            # Misra-Gries survivors and the first report rows
+            uniq, cnts, first_row, row_hashes, valid, arr = payload
+            self.cat_null[name] += 0 if valid is None \
+                else int(hb.nrows - valid.sum())
+            if uniq.size:
+                def resolver(src, arr=arr, first_row=first_row):
+                    import pyarrow as pa
+                    taken = arr.take(pa.array(first_row[src]))
+                    return np.asarray(taken.to_pandas(), dtype=object)
+                self.mg[name].update_hashed(uniq, cnts, resolver)
+                if self.unique.active(name):
+                    # same xxh64-of-bytes values as the dictionary path's
+                    # native hashes, so streams may mix representations
+                    self.unique.update(
+                        name,
+                        row_hashes if valid is None else row_hashes[valid],
+                        hash_kind="native")
+            if first:
+                self.first_values[name] = arr[:5].to_pylist()
         for name, (ints, valid) in hb.date_ints.items():
             ints, valid = ints[: hb.nrows], valid[: hb.nrows]
             self.date_null[name] += int((~valid).sum())

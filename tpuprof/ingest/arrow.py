@@ -110,6 +110,17 @@ class HostBatch:
     # exact-uniqueness tracker refuses to compare across implementations.
     cat_hashes: Optional[Dict[str, np.ndarray]] = None
     cat_hash_kind: Optional[Dict[str, str]] = None
+    # plain-string fast path (pass A, native available): per-batch
+    # aggregation WITHOUT dictionary_encode — rows are hashed straight
+    # from the Arrow string buffers and grouped by hash (pd.factorize, a
+    # C hash table; measured 1.5-1.7x the per-batch dictionary_encode at
+    # mid/high cardinality).  Values stay unmaterialized: the tuple
+    # carries (unique_hashes u64, counts i64, first_row i64 — a
+    # representative row per unique, row_hashes u64, valid bool, the
+    # Arrow array) and consumers materialize only what they keep
+    # (Misra-Gries survivors, first report rows).  Columns prepared this
+    # way have NO cat_codes entry for the batch.
+    cat_hashed: Optional[Dict[str, Tuple]] = None
     # (fragment ordinal, batch ordinal within fragment) when the batch
     # came from the positioned per-fragment stream — the checkpoint
     # records it so resume can skip whole fragments' I/O
@@ -130,6 +141,18 @@ class HostBatch:
 # nested-column degradation warned once per column name per process
 # (set.add is GIL-atomic, safe from the decode thread pool)
 _NESTED_WARNED: set = set()
+
+# plain-string columns switch from per-batch dictionary_encode to the
+# native row-hash + factorize path once a batch shows MORE distinct
+# values than this: the hash-table build dictionary_encode pays is
+# O(rows) either way, but materializing + hashing its dictionary is
+# O(distinct) python-object work.  Isolated per-column measurements
+# (64k-row batches): row-hash is 1.7x at 60k distinct, 1.5x at 20k,
+# ~1.2x at 7k, and LOSES below ~2k — the threshold sits where the win
+# is unambiguous (ID-like columns, which also skip materializing
+# O(distinct) python strings per batch via the deferred MG resolver).
+# The previous batch's distinct count is the estimate.
+ROWHASH_MIN_DISTINCT = 16384
 
 
 def _hash64(keys: np.ndarray) -> np.ndarray:
@@ -223,13 +246,17 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                   pad_rows: int, hll_precision: int = 11,
                   hashes: bool = True,
                   frag_pos: Optional[Tuple[int, int]] = None,
-                  dict_cache: Optional[Dict[str, Dict[str, object]]] = None
+                  dict_cache: Optional[Dict[str, Dict[str, object]]] = None,
+                  col_stats: Optional[Dict[str, int]] = None
                   ) -> HostBatch:
     """Decode one Arrow record batch into a fixed-shape HostBatch.
 
     ``hashes=False`` skips hashing + HLL packing (the host hot loop) and
     leaves the packed plane zeros — pass B only needs values and
-    categorical codes."""
+    categorical codes.  ``col_stats`` (owned by the ingest, like
+    ``dict_cache``) carries each column's last observed per-batch
+    distinct count, steering plain-string columns onto the row-hash
+    path once they prove high-cardinality."""
     from tpuprof import native
     from tpuprof.kernels import hll as khll
     if dict_cache is None:
@@ -252,6 +279,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     cat_codes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
     cat_hashes: Dict[str, np.ndarray] = {}
     cat_hash_kind: Dict[str, str] = {}
+    cat_hashed: Dict[str, Tuple] = {}   # payload valid=None ⇒ no nulls
     date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
 
     col_nbytes: Dict[str, int] = {}
@@ -316,10 +344,57 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                 arr = pa.array(
                     [None if v is None else str(v)
                      for v in arr.to_pylist()], type=pa.string())
+            high_card = col_stats is not None and \
+                col_stats.get(spec.name, 0) > ROWHASH_MIN_DISTINCT
+            if hashes and high_card \
+                    and not isinstance(arr.type, pa.DictionaryType):
+                plain = arr.combine_chunks() if isinstance(
+                    arr, pa.ChunkedArray) else arr
+                rh = native.hash_string_array(plain)
+                if rh is not None:      # string buffers hashed directly —
+                    # skip the per-batch dictionary_encode hash-table
+                    # build entirely (pass B, which needs codes for the
+                    # exact value-keyed recount, still dictionary-encodes)
+                    if plain.null_count == 0:   # metadata — O(1)
+                        valid = None            # sentinel: all rows valid
+                        hll_packed[:n, spec.hash_lane] = khll.pack(
+                            rh, None, hll_precision)
+                        codes_m, uniq = pd.factorize(rh)
+                        base = None
+                    else:
+                        valid = plain.is_valid().to_numpy(
+                            zero_copy_only=False)
+                        hll_packed[:n, spec.hash_lane] = khll.pack(
+                            rh, valid, hll_precision)
+                        vi = np.flatnonzero(valid)
+                        if vi.size:
+                            codes_m, uniq = pd.factorize(rh[vi])
+                            base = vi
+                        else:
+                            codes_m = np.zeros(0, dtype=np.int64)
+                            uniq = np.zeros(0, dtype=np.uint64)
+                            base = None
+                    cnts = np.bincount(
+                        codes_m, minlength=len(uniq)).astype(np.int64)
+                    first_row = np.full(len(uniq), n, dtype=np.int64)
+                    np.minimum.at(first_row, codes_m,
+                                  np.arange(codes_m.size))
+                    if base is not None:
+                        # masked positions -> absolute row numbers (every
+                        # unique occurred, so first_row < vi.size)
+                        first_row = base[first_row]
+                    cat_hashed[spec.name] = (np.asarray(uniq,
+                                                        dtype=np.uint64),
+                                             cnts, first_row, rh, valid,
+                                             plain)
+                    col_stats[spec.name] = len(uniq)
+                    return
             if not isinstance(arr.type, pa.DictionaryType):
                 arr = pc.dictionary_encode(arr)
             combined = arr.combine_chunks() if isinstance(
                 arr, pa.ChunkedArray) else arr
+            if col_stats is not None:
+                col_stats[spec.name] = len(combined.dictionary)
             valid = combined.is_valid().to_numpy(zero_copy_only=False)
             codes = combined.indices.fill_null(0).to_numpy(
                 zero_copy_only=False).astype(np.int64)
@@ -359,6 +434,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                      cat_codes=cat_codes, date_ints=date_ints,
                      cat_hashes=cat_hashes if hashes else None,
                      cat_hash_kind=cat_hash_kind if hashes else None,
+                     cat_hashed=cat_hashed if hashes else None,
                      hll_precision=hll_precision, col_nbytes=col_nbytes,
                      col_dict_nbytes=col_dict_nbytes, frag_pos=frag_pos)
 
@@ -420,7 +496,8 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                     if not _put(prepare_batch(rb, plan, pad,
                                               hll_precision, hashes=hashes,
                                               frag_pos=(fi, bi),
-                                              dict_cache=ingest._dict_cache)):
+                                              dict_cache=ingest._dict_cache,
+                                              col_stats=ingest._col_stats)):
                         return
             else:
                 for k, rb in enumerate(ingest.raw_batches()):
@@ -428,7 +505,8 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                         continue
                     if not _put(prepare_batch(rb, plan, pad, hll_precision,
                                               hashes=hashes,
-                                              dict_cache=ingest._dict_cache)):
+                                              dict_cache=ingest._dict_cache,
+                                              col_stats=ingest._col_stats)):
                         return
         except BaseException as exc:          # re-raised consumer-side
             failure.append(exc)
@@ -533,6 +611,9 @@ class ArrowIngest:
         # here so the memo dies with the scan instead of pinning the
         # last dictionary per column name for the process lifetime
         self._dict_cache: Dict[str, Dict[str, object]] = {}
+        # per-column last observed batch distinct count (steers the
+        # plain-string row-hash fast path, ROWHASH_MIN_DISTINCT)
+        self._col_stats: Dict[str, int] = {}
 
     def fingerprint(self) -> str:
         """Stable identity of the source's content — column names/types,
@@ -663,7 +744,8 @@ class ArrowIngest:
         for rb in self.raw_batches():
             yield prepare_batch(rb, self.plan, self.batch_rows,
                                 hll_precision,
-                                dict_cache=self._dict_cache)
+                                dict_cache=self._dict_cache,
+                                col_stats=self._col_stats)
 
     def sample(self, n_rows: int) -> pd.DataFrame:
         if self._table is not None:

@@ -24,6 +24,8 @@ classification stays reliable.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,11 +41,13 @@ def init(n_cols: int, precision: int) -> Array:
     return jnp.zeros((n_cols, 1 << precision), dtype=jnp.int32)
 
 
-def pack(h64: np.ndarray, valid: np.ndarray, precision: int) -> np.ndarray:
+def pack(h64: np.ndarray, valid: Optional[np.ndarray],
+         precision: int) -> np.ndarray:
     """Host-side: 64-bit hashes -> packed uint16 observations.
 
     idx = top ``precision`` bits; ρ = clz of the next 32 bits + 1
-    (capped at 31, floored at 1 so packed == 0 iff invalid)."""
+    (capped at 31, floored at 1 so packed == 0 iff invalid).
+    ``valid=None`` means every row is valid (skips the final mask)."""
     if precision > MAX_PRECISION:
         raise ValueError(f"hll precision > {MAX_PRECISION} cannot pack "
                          f"into uint16")
@@ -55,6 +59,8 @@ def pack(h64: np.ndarray, valid: np.ndarray, precision: int) -> np.ndarray:
         np.uint32) + 1
     rho = np.clip(33 - bl, 1, RHO_MAX).astype(np.uint32)
     packed = ((idx << RHO_BITS) | rho).astype(np.uint16)
+    if valid is None:
+        return packed
     return np.where(valid, packed, np.uint16(0))
 
 

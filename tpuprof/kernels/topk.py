@@ -101,6 +101,29 @@ class MisraGries:
                 np.add.at(agg, inv, counts)
                 values = np.asarray(values, dtype=object)[first]
                 hashes, counts = uh, agg
+        self._update_core(
+            hashes, counts,
+            lambda src: np.asarray(values, dtype=object)[src])
+
+    def update_hashed(self, hashes: np.ndarray, counts: np.ndarray,
+                      resolver) -> None:
+        """Fold pre-aggregated UNIQUE (hashes, counts) whose values are
+        materialized lazily: ``resolver(src)`` returns the object values
+        for positions ``src`` of the hash array, and is called only for
+        new entries that SURVIVE compaction — the ingest plain-string
+        path hashes rows without ever building a per-batch dictionary,
+        so touching O(capacity) values instead of O(distinct) is the
+        point (SURVEY §7.2 'Strings on TPU')."""
+        if self._merged:
+            raise RuntimeError(
+                "MisraGries.update_hashed called after merge(): the "
+                "store's hash index is no longer batch-keyable — fold "
+                "batches first, merge summaries last")
+        self._update_core(np.asarray(hashes, dtype=np.uint64),
+                          np.asarray(counts, dtype=np.int64), resolver)
+
+    def _update_core(self, hashes: np.ndarray, counts: np.ndarray,
+                     resolver) -> None:
         if len(self._index):
             pos = self._index.get_indexer(hashes)
             hit = np.flatnonzero(pos >= 0)
@@ -128,8 +151,7 @@ class MisraGries:
             src = miss                  # the tail of the store
         n_new = src.size
         if n_new:
-            self._values[len(self._values) - n_new:] = \
-                np.asarray(values, dtype=object)[src]
+            self._values[len(self._values) - n_new:] = resolver(src)
 
     def _append(self, hashes: np.ndarray, counts: np.ndarray,
                 values: np.ndarray) -> None:

@@ -188,17 +188,27 @@ def hash_string_dictionary(arr) -> Optional[np.ndarray]:
         arr = arr.cast(pa.large_string())
     except pa.ArrowInvalid:
         return None
-    if arr.null_count or arr.offset:
-        arr = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
+    if hasattr(arr, "combine_chunks"):
+        arr = arr.combine_chunks()
     buffers = arr.buffers()           # [validity, offsets(int64), data]
     if len(buffers) < 3 or buffers[2] is None:
         return None
+    # sliced arrays (batch streams slice one parent column) carry an
+    # offset: their int64 offsets remain ABSOLUTE into the shared data
+    # buffer, so hashing just starts the offset walk at arr.offset —
+    # no copy, no fallback (a fallback here silently turned the whole
+    # plain-string fast path off for every batch after the first)
     offsets = np.frombuffer(buffers[1], dtype=np.int64,
-                            count=len(arr) + 1 + arr.offset)
-    if arr.offset:
-        return None                   # sliced arrays: fall back
+                            count=len(arr) + 1 + arr.offset)[arr.offset:]
     data = np.frombuffer(buffers[2], dtype=np.uint8)
     out = np.empty(len(arr), dtype=np.uint64)
     lib.tpuprof_hash_bytes(data.ctypes.data, offsets.ctypes.data,
                            out.ctypes.data, len(arr))
     return out
+
+
+# the buffer walk above is value-level, not dictionary-specific: it
+# hashes ANY Arrow string array row by row (null slots hash the empty
+# range; callers mask them with the validity bitmap).  The ingest
+# plain-string fast path (no dictionary_encode) uses it under this name.
+hash_string_array = hash_string_dictionary

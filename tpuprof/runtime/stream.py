@@ -100,6 +100,9 @@ class StreamingProfiler:
             else self.runner.rows
         self._buf: list = []                 # pending pa.RecordBatches
         self._buf_rows = 0
+        # per-column last observed distinct count (plain-string row-hash
+        # path steering, ingest/arrow.ROWHASH_MIN_DISTINCT)
+        self._col_stats: Dict[str, int] = {}
 
     @classmethod
     def for_example(cls, example: Any, **kwargs) -> "StreamingProfiler":
@@ -150,7 +153,8 @@ class StreamingProfiler:
         if not rbs:
             return
         hb = prepare_batch(rbs[0], self.plan, self.runner.rows,
-                           self.config.hll_precision)
+                           self.config.hll_precision,
+                           col_stats=self._col_stats)
         if self.state is None:
             from tpuprof.backends.tpu import estimate_shift
             self.state = self.runner.init_pass_a(estimate_shift(hb))
