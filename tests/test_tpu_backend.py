@@ -239,7 +239,7 @@ def test_staged_scan_tail_group(fixture_df):
         assert stats["variables"][name]["count"] == cv["count"], name
 
 
-def test_high_cardinality_string_rowhash_path(tmp_path):
+def test_high_cardinality_string_rowhash_path():
     """A high-cardinality plain-string column (in-memory source, no
     parquet dictionaries) flows through the row-hash fast path after the
     first batch primes the cardinality memo — stats must still match the
