@@ -140,3 +140,123 @@ class TestUniqueClassification:
         assert schema.MSG_APPROX_DISTINCT in kinds
         assert "distinct\n      count is approximate" in r.html \
             or "count is approximate" in r.html
+
+
+class TestSpillTier:
+    """Disk-spilled exact UNIQUE tracking (unique_spill_dir): the exact
+    claim must survive any n, with duplicates across spill epochs found
+    at resolve() and honest degradation when runs vanish."""
+
+    def _tracker(self, tmp_path, budget=1000):
+        return kunique.UniqueTracker(["c"], budget, 1 << 30,
+                                     spill_dir=str(tmp_path / "spill"))
+
+    def test_spill_keeps_unique_exact(self, tmp_path):
+        t = self._tracker(tmp_path)
+        for start in range(0, 5000, 500):
+            t.update("c", np.arange(start, start + 500, dtype=np.uint64))
+        assert t.status["c"] == kunique.UNIQUE
+        assert len(t._runs["c"]) >= 2          # actually spilled
+        assert t.resolve()["c"] == kunique.UNIQUE
+        t.cleanup()
+        assert not any((tmp_path / "spill").glob("*"))
+
+    def test_duplicate_across_spill_epochs(self, tmp_path):
+        t = self._tracker(tmp_path)
+        for start in range(0, 3000, 500):
+            t.update("c", np.arange(start, start + 500, dtype=np.uint64))
+        assert len(t._runs["c"]) >= 1
+        # value 7 lives in a spilled run; in-stream probes cannot see it
+        t.update("c", np.array([7], dtype=np.uint64))
+        assert t.status["c"] == kunique.UNIQUE   # not yet resolved
+        assert t.resolve()["c"] == kunique.DUP   # exact at finalize
+
+    def test_duplicate_between_two_runs(self, tmp_path):
+        t = self._tracker(tmp_path, budget=400)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))      # spills
+        t.update("c", np.arange(1000, 1401, dtype=np.uint64))  # spills
+        t.update("c", np.array([200], dtype=np.uint64))        # dup of run 1
+        assert t.resolve()["c"] == kunique.DUP
+
+    def test_resolve_sliced_path(self, tmp_path, monkeypatch):
+        # force many hash-range slices to exercise the bounded-RAM walk
+        monkeypatch.setattr(kunique, "RESOLVE_SLICE_ROWS", 256)
+        rng = np.random.default_rng(0)
+        t = self._tracker(tmp_path, budget=500)
+        h = rng.choice(1 << 60, size=4000, replace=False).astype(np.uint64)
+        for i in range(0, 4000, 500):
+            t.update("c", h[i:i + 500])
+        assert t.resolve()["c"] == kunique.UNIQUE
+        t2 = self._tracker(tmp_path, budget=500)
+        for i in range(0, 4000, 500):
+            t2.update("c", h[i:i + 500])
+        t2.update("c", h[:1])                    # dup vs first epoch
+        assert t2.resolve()["c"] == kunique.DUP
+
+    def test_resolve_memoized_and_nondestructive(self, tmp_path):
+        t = self._tracker(tmp_path)
+        for start in range(0, 3000, 500):
+            t.update("c", np.arange(start, start + 500, dtype=np.uint64))
+        assert t.resolve()["c"] == kunique.UNIQUE
+        assert t.resolve()["c"] == kunique.UNIQUE   # memo path
+        # streaming continues after a snapshot resolve
+        t.update("c", np.arange(3000, 3500, dtype=np.uint64))
+        assert t.resolve()["c"] == kunique.UNIQUE
+        t.update("c", np.array([42], dtype=np.uint64))
+        assert t.resolve()["c"] == kunique.DUP
+
+    def test_pickle_roundtrip_validates_runs(self, tmp_path):
+        import pickle
+        t = self._tracker(tmp_path)
+        for start in range(0, 3000, 500):
+            t.update("c", np.arange(start, start + 500, dtype=np.uint64))
+        blob = pickle.dumps(t)
+        t2 = pickle.loads(blob)
+        assert t2.resolve()["c"] == kunique.UNIQUE
+        # GC of the unpickled copy must NOT delete the live run files
+        # (a failed checkpoint load would otherwise destroy them)
+        del t2
+        import gc
+        gc.collect()
+        import os
+        assert all(os.path.exists(p) for p, _ in t._runs["c"])
+        # runs gone -> honest OVERFLOW on a fresh unpickle
+        t.cleanup()
+        t3 = pickle.loads(blob)
+        assert t3.status["c"] == kunique.OVERFLOW
+
+    def test_merge_with_spilled_column_demotes(self, tmp_path):
+        t = self._tracker(tmp_path, budget=400)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))      # spilled
+        other = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+        other.update("c", np.arange(5000, 5100, dtype=np.uint64))
+        t.merge(other)
+        assert t.status["c"] == kunique.OVERFLOW
+
+    def test_backend_exact_unique_past_budget(self, tmp_path):
+        """End-to-end: an all-unique ID column past unique_track_rows
+        stays EXACT UNIQUE with a spill dir (the round-2 semantic gap),
+        and a single far-apart duplicate is still caught."""
+        from tpuprof import ProfilerConfig
+        from tpuprof.backends.tpu import TPUStatsBackend
+
+        n = 4096
+        ids = [f"id{i:07d}" for i in range(n)]
+        df = pd.DataFrame({"u": ids})
+        cfg = ProfilerConfig(backend="tpu", batch_rows=512,
+                             unique_track_rows=600,
+                             unique_spill_dir=str(tmp_path / "sp"),
+                             topk_capacity=64)      # MG overflows early
+        stats = TPUStatsBackend().collect(df, cfg)
+        v = stats["variables"]["u"]
+        assert v["type"] == schema.UNIQUE
+        assert v["is_unique"] is True and v["distinct_count"] == n
+        assert not any((tmp_path / "sp").glob("*"))  # cleaned up
+
+        dup = list(ids)
+        dup[-1] = dup[0]                     # duplicate across epochs
+        stats2 = TPUStatsBackend().collect(
+            pd.DataFrame({"u": dup}), cfg)
+        v2 = stats2["variables"]["u"]
+        assert v2["type"] == schema.CAT
+        assert v2["distinct_count"] <= n - 1

@@ -80,7 +80,8 @@ class HostAgg:
         # UNIQUE classification for columns whose MG summary overflows
         self.unique = UniqueTracker(
             (s.name for s in plan.by_role("cat")),
-            config.unique_track_rows, config.unique_track_total_rows)
+            config.unique_track_rows, config.unique_track_total_rows,
+            spill_dir=config.unique_spill_dir)
         self.cat_null: Dict[str, int] = {s.name: 0 for s in plan.by_role("cat")}
         self.date_min: Dict[str, int] = {}
         self.date_max: Dict[str, int] = {}
@@ -381,6 +382,19 @@ class TPUStatsBackend:
                                                  merge_samplers,
                                                  merge_shift_estimates)
         pshard = (jax.process_index(), jax.process_count())
+        if pshard[1] > 1 and config.unique_spill_dir:
+            # spilled runs live on each host's own disk and cannot fold
+            # across hosts (UniqueTracker.merge demotes them) — spilling
+            # would be guaranteed-wasted I/O, so disable it up front
+            import dataclasses
+
+            from tpuprof.utils.trace import logger
+            logger.warning(
+                "unique_spill_dir is single-process only (spilled runs "
+                "cannot merge across hosts); exact UNIQUE tracking "
+                "falls back to the in-memory budget for this "
+                "multi-host profile")
+            config = dataclasses.replace(config, unique_spill_dir=None)
         ingest = ArrowIngest(source, config.batch_rows, process_shard=pshard)
         plan = ingest.plan
         if not plan.specs:
@@ -675,6 +689,7 @@ class TPUStatsBackend:
                           probes, rho_spear=rho_spear)
         if resume is not None:
             resume.clear()           # profile assembled: artifact is stale
+        hostagg.unique.cleanup()     # spill runs are working space only
         # this profile's phase timings ride the stats dict (the report
         # footer reads them from there — global state would attribute
         # another profile's scan to this report)
@@ -704,6 +719,9 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
     freq: Dict[str, pd.Series] = {}
 
     # ---- first sweep: per-column counts/distincts + provisional kinds ----
+    # spilled unique-tracker columns are decided here (exact cross-epoch
+    # duplicate resolution over the disk runs — kernels/unique.resolve)
+    unique_status = hostagg.unique.resolve()
     kinds: Dict[str, str] = {}
     commons: Dict[str, Dict[str, Any]] = {}
     for spec in plan.specs:
@@ -740,7 +758,7 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
                 # estimate, and it says so in the report warnings
                 est = max(min(int(round(hll_est[spec.hash_lane])), count),
                           1 if count else 0)
-                status = hostagg.unique.status.get(spec.name)
+                status = unique_status.get(spec.name)
                 if status == kunique.UNIQUE:
                     distinct = count        # no duplicate in any row: exact
                 elif status == kunique.DUP:

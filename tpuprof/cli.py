@@ -40,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also dump the stats dict as JSON")
     p.add_argument("--trace", metavar="DIR",
                    help="capture a jax.profiler trace into DIR")
+    p.add_argument("--unique-spill-dir", metavar="DIR",
+                   help="spill sorted hash runs here so exact UNIQUE "
+                        "classification never falls back to an estimate "
+                        "(disk cost: 8 bytes/row per high-cardinality "
+                        "column)")
     p.add_argument("--checkpoint", metavar="PATH",
                    help="persist the scan every N batches and resume "
                         "from PATH after a crash")
@@ -128,7 +133,8 @@ def cmd_profile(args: argparse.Namespace) -> int:
         batch_rows=args.batch_rows, scan_batches=args.scan_batches,
         quantile_sketch_size=args.sketch_size,
         hll_precision=args.hll_precision, exact_passes=not args.single_pass,
-        spearman=args.spearman, checkpoint_path=args.checkpoint,
+        spearman=args.spearman, unique_spill_dir=args.unique_spill_dir,
+        checkpoint_path=args.checkpoint,
         checkpoint_every_batches=args.checkpoint_every,
         compile_cache_dir=cache_dir)
 

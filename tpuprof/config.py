@@ -61,6 +61,15 @@ class ProfilerConfig:
                                             # duplicate-free).  0 disables.
     unique_track_total_rows: int = 1 << 25  # global cap across all columns
                                             # (~256 MB worst case)
+    unique_spill_dir: Optional[str] = None  # when set, columns exceeding
+                                            # the budgets spill sorted
+                                            # hash runs here (8 B/row)
+                                            # and UNIQUE classification
+                                            # stays EXACT at any n
+                                            # (kernels/unique.py resolve);
+                                            # None keeps the bounded
+                                            # in-memory tier with the
+                                            # HLL-estimate fallback
     exact_passes: bool = True       # second scan: exact histograms + exact
                                     # recount of top-k candidates (parity with
                                     # Spark's exact groupBy().count()).

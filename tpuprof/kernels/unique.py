@@ -15,24 +15,37 @@ chunks; each batch is sorted (exposing within-batch duplicates) and
 probed against the chunks with ``searchsorted``.  The first duplicate
 DEMOTES the column to ``DUP`` and frees its storage — for non-unique
 columns (the common case) that happens within the first batch or two, so
-memory concentrates on genuinely-unique columns only.  A per-column and
-a global row budget bound that worst case; columns past budget demote to
-``OVERFLOW`` and classification falls back to the HLL estimate with an
-explicit approximation warning in the report (schema.MSG_APPROX_DISTINCT).
+memory concentrates on genuinely-unique columns only.
+
+Past the in-memory budgets there are two tiers:
+
+* ``spill_dir`` set — the column's consolidated sorted (dup-free) chunk
+  spills to a disk RUN and tracking continues: the in-stream probes
+  cover the current epoch, and ``resolve()`` k-way-merges every run +
+  the live chunks at finalize (memmap range-slices of the uniform hash
+  space, so RAM stays bounded at ~128 MB however large n is).  This is
+  the Spark-shuffle analogue: EXACT ``UNIQUE``/``DUP`` at any n, with
+  disk as the working space (8 B/row/column).
+* no ``spill_dir`` — the column demotes to ``OVERFLOW`` and
+  classification falls back to the HLL estimate with an explicit
+  approximation warning (schema.MSG_APPROX_DISTINCT).
 
 A 64-bit hash collision can mask a truly-unique column as DUP with
 probability ~n²/2⁶⁵ (≈3e-8 at n=1e6) — the same collision contract the
 HLL plane and the top-k store already accept (ingest/arrow.py).
 
 Merge law (multi-host, SURVEY §4.2): DUP anywhere is definitive; else
-OVERFLOW anywhere is OVERFLOW; else the peer's chunks fold in through
-the same probe path, so cross-host duplicates are detected exactly while
-the combined rows fit the budget.
+OVERFLOW anywhere is OVERFLOW; else the peer's in-memory chunks fold in
+through the same probe path.  A SPILLED column cannot fold across hosts
+(its runs live on the other host's disk), so it demotes to OVERFLOW on
+merge — multi-host exactness is bounded by the in-memory budget;
+single-host exactness is unbounded with a spill dir.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -40,26 +53,46 @@ UNIQUE = "unique"       # no duplicate among all rows seen so far (exact)
 DUP = "dup"             # at least one duplicate seen (exact)
 OVERFLOW = "overflow"   # gave up within budget — distinct is approximate
 
+# resolve() merges spilled runs in hash-range slices of at most this
+# many rows (128 MB of uint64) — RAM stays bounded at any total n
+RESOLVE_SLICE_ROWS = 1 << 24
+
 
 class UniqueTracker:
     """Tracks, per column, whether any value hash occurred twice."""
 
     def __init__(self, names: Iterable[str], budget_rows: int,
-                 total_budget_rows: int):
+                 total_budget_rows: int,
+                 spill_dir: Optional[str] = None):
         self.budget = int(budget_rows)
         self.total_budget = int(total_budget_rows)
+        self.spill_dir = spill_dir
         names = list(names)
         self.status: Dict[str, str] = {}
         self._chunks: Dict[str, List[np.ndarray]] = {}
         self._rows: Dict[str, int] = {}
         self._kind: Dict[str, str] = {}   # hash implementation per column
         self._live = 0          # rows held across all still-UNIQUE columns
+        # disk runs per column: [(path, rows)] — each file is a sorted,
+        # internally dup-free uint64 array (one spilled epoch).  The
+        # filename token is unique per tracker so hosts sharing a spill
+        # dir (NFS) can never collide
+        import uuid
+        self._runs: Dict[str, List[Tuple[str, int]]] = {}
+        self._spill_token = uuid.uuid4().hex[:12]
+        self._spill_seq = 0
+        # run files THIS instance wrote: __del__ removes only these, so
+        # GC of a transient unpickled copy (e.g. a failed checkpoint
+        # load) can never destroy files a live artifact references
+        self._owned: List[str] = []
+        self._resolve_memo: Dict[str, Tuple[Tuple, str]] = {}
         disabled = self.budget <= 0 or self.total_budget <= 0
         for n in names:
             self.status[n] = OVERFLOW if disabled else UNIQUE
             self._chunks[n] = []
             self._rows[n] = 0
             self._kind[n] = ""
+            self._runs[n] = []
 
     def active(self, name: str) -> bool:
         return self.status.get(name) == UNIQUE
@@ -74,6 +107,46 @@ class UniqueTracker:
         self._rows[name] = 0
         self._chunks[name] = []
         self.status[name] = status
+        self._drop_runs(name)
+
+    def _drop_runs(self, name: str) -> None:
+        for path, _rows in self._runs.get(name, ()):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self._runs[name] = []
+
+    def _spill(self, name: str) -> bool:
+        """Write the column's consolidated in-memory chunk to a disk run
+        and free the memory; tracking continues in a fresh epoch."""
+        merged = np.sort(np.concatenate(self._chunks[name]))
+        path = os.path.join(
+            self.spill_dir,
+            f"tpuprof-uniq-{self._spill_token}-{self._spill_seq}.u64")
+        self._spill_seq += 1
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            merged.tofile(path)
+        except OSError as exc:
+            # the user explicitly asked for exactness — a full/unwritable
+            # spill disk must not demote silently; also reap the partial
+            # file so the spill dir stays clean
+            import logging
+            logging.getLogger("tpuprof").warning(
+                "unique spill to %s failed (%s): column %r falls back "
+                "to the HLL distinct estimate", path, exc, name)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return False
+        self._runs[name].append((path, int(merged.size)))
+        self._owned.append(path)
+        self._live -= self._rows[name]
+        self._rows[name] = 0
+        self._chunks[name] = []
+        return True
 
     def update(self, name: str, hashes: np.ndarray,
                hash_kind: str = "") -> None:
@@ -107,13 +180,122 @@ class UniqueTracker:
         self._rows[name] += sh.size
         self._live += sh.size
         if self._rows[name] > self.budget or self._live > self.total_budget:
-            self._demote(name, OVERFLOW)
+            if not (self.spill_dir and self._spill(name)):
+                if not self.spill_dir:
+                    import logging
+                    logging.getLogger("tpuprof").warning(
+                        "column %r exceeded the exact-UNIQUE tracking "
+                        "budget (unique_track_rows=%d): its distinct "
+                        "count falls back to the HLL estimate.  Set "
+                        "unique_spill_dir (CLI: --unique-spill-dir) to "
+                        "keep the classification exact at any size "
+                        "(disk cost: 8 bytes/row)", name, self.budget)
+                self._demote(name, OVERFLOW)
             return
         if len(self._chunks[name]) > 8:
             # keep the probe loop short: fold the chunk list back into
             # one sorted array (amortized O(n log n) per column)
             self._chunks[name] = [np.sort(np.concatenate(
                 self._chunks[name]))]
+
+    def resolve(self) -> Dict[str, str]:
+        """Final per-column statuses, with spilled columns decided
+        EXACTLY: each run is internally dup-free and so is the live
+        chunk set, so only cross-epoch duplicates remain — found by
+        merging all runs + live chunks.  Hashes are uniform, so the
+        merge walks fixed ranges of the hash space via memmap'd
+        ``searchsorted`` windows: RAM stays ≤ RESOLVE_SLICE_ROWS rows
+        however large the column.  Non-destructive (streaming snapshots
+        may call it repeatedly); per-column results are memoized on the
+        (runs, live-rows) state."""
+        out = {}
+        for name, st in self.status.items():
+            if st == UNIQUE and self._runs.get(name):
+                out[name] = self._resolve_spilled(name)
+            else:
+                out[name] = st
+        return out
+
+    def _resolve_spilled(self, name: str) -> str:
+        key = (tuple(self._runs[name]), self._rows[name])
+        memo = self._resolve_memo.get(name)
+        if memo is not None and memo[0] == key:
+            return memo[1]
+        arrays: List[np.ndarray] = []
+        for path, rows in self._runs[name]:
+            try:
+                arrays.append(np.memmap(path, dtype=np.uint64, mode="r",
+                                        shape=(rows,)))
+            except (OSError, ValueError):
+                # a run vanished (tmp cleaner, resume on another box):
+                # the exact claim is gone — honest fallback
+                self._resolve_memo[name] = (key, OVERFLOW)
+                return OVERFLOW
+        if self._chunks[name]:
+            arrays.append(np.sort(np.concatenate(self._chunks[name])))
+        total = sum(a.size for a in arrays)
+        n_slices = max(1, -(-total // RESOLVE_SLICE_ROWS))
+        step = (1 << 64) // n_slices
+        status = UNIQUE
+        for k in range(n_slices):
+            lo = np.uint64(k * step)
+            hi = np.uint64((k + 1) * step - 1) if k + 1 < n_slices \
+                else np.uint64((1 << 64) - 1)
+            parts = []
+            for a in arrays:
+                i = int(np.searchsorted(a, lo, side="left"))
+                j = int(np.searchsorted(a, hi, side="right"))
+                if j > i:
+                    parts.append(np.asarray(a[i:j]))
+            if len(parts) < 2:
+                continue            # one source can't cross-duplicate
+            s = np.sort(np.concatenate(parts))
+            if (s[1:] == s[:-1]).any():
+                status = DUP
+                break
+        self._resolve_memo[name] = (key, status)
+        return status
+
+    def cleanup(self) -> None:
+        """Delete every spill run (idempotent; call once the profile is
+        assembled — checkpoints reference the files until then)."""
+        for name in list(self._runs):
+            self._drop_runs(name)
+
+    def __del__(self):
+        # best-effort tmp hygiene for files THIS instance wrote only —
+        # unpickled copies (checkpoint loads, cross-host gathers) own
+        # nothing, so their GC cannot destroy a live artifact's runs
+        try:
+            for path in getattr(self, "_owned", ()):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        except Exception:
+            pass
+
+    def __getstate__(self) -> Dict[str, object]:
+        state = dict(self.__dict__)
+        state["_resolve_memo"] = {}
+        state["_owned"] = []
+        return state
+
+    def __setstate__(self, state) -> None:
+        self.__dict__.update(state)
+        self._resolve_memo = {}
+        self._owned = []
+        for name, runs in list(self._runs.items()):
+            for path, rows in runs:
+                try:
+                    ok = os.path.getsize(path) == rows * 8
+                except OSError:
+                    ok = False
+                if not ok:
+                    # checkpoint artifacts reference spill files by path;
+                    # a resume without them degrades honestly
+                    self._demote(name, OVERFLOW)
+                    break
 
     def merge(self, other: "UniqueTracker") -> None:
         for name, ost in other.status.items():
@@ -122,6 +304,11 @@ class UniqueTracker:
             if DUP in (self.status[name], ost):
                 self._demote(name, DUP)
             elif OVERFLOW in (self.status[name], ost):
+                self._demote(name, OVERFLOW)
+            elif self._runs.get(name) or other._runs.get(name):
+                # spilled runs live on their host's disk — a cross-host
+                # fold cannot probe them, so the exact claim is bounded
+                # by the in-memory budget in multi-host runs
                 self._demote(name, OVERFLOW)
             else:
                 # a cross-host duplicate is only detectable when both
