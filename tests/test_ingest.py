@@ -243,3 +243,40 @@ def test_low_cardinality_stays_on_dictionary_path():
     hb = prepare_batch(rb, ing.plan, 512, 11, col_stats={"s": 3})
     assert "s" in hb.cat_codes
     assert not hb.cat_hashed
+
+
+def test_low_card_dictionary_content_reuse(monkeypatch):
+    """Per-batch dictionary_encode builds a FRESH-but-identical
+    dictionary for stable low-cardinality columns; the content-keyed
+    memo must reuse the materialized values + hashes instead of paying
+    the rebuild each batch (and must NOT confuse different contents)."""
+    from tpuprof.ingest import arrow as ia
+
+    calls = {"n": 0}
+    real = ia._hash64_dictionary
+
+    def counting(dictionary, dvals):
+        calls["n"] += 1
+        return real(dictionary, dvals)
+
+    monkeypatch.setattr(ia, "_hash64_dictionary", counting)
+    # stable first-occurrence order -> per-batch dictionary_encode
+    # yields an identical (fresh) dictionary every batch; content
+    # equality is what the memo keys on (random order legitimately
+    # produces DIFFERENT dictionaries and must rebuild)
+    df = pd.DataFrame({"s": ["aa", "bb", "cc"] * 2728})   # 8184 rows
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    ing = ia.ArrowIngest(table, 1023)      # multiple of the 3-cycle ->
+    hbs = list(ing.batches())              # identical dictionary each batch
+    assert len(hbs) == 8
+    # same dictionary content every batch -> ONE materialize+hash total
+    assert calls["n"] == 1, calls["n"]
+    assert hbs[0].cat_codes["s"][1] is hbs[-1].cat_codes["s"][1]
+
+    # different content must rebuild, not falsely reuse
+    cache = {}
+    d1 = pa.array(["x", "y"]).dictionary_encode().dictionary
+    d2 = pa.array(["x", "z"]).dictionary_encode().dictionary
+    v1, _, _ = ia._dictionary_views(cache, "c", d1, False)
+    v2, _, _ = ia._dictionary_views(cache, "c", d2, False)
+    assert list(v1) == ["x", "y"] and list(v2) == ["x", "z"]

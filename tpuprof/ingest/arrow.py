@@ -217,14 +217,51 @@ def _dictionary_views(cache: Dict[str, Dict[str, object]], name: str,
                  for b in bufs))
     ent = cache.get(name)
     if ent is None or ent["key"] != key:
-        ent = {"key": key, "ref": dictionary,
-               "dvals": np.asarray(dictionary.to_pandas(), dtype=object),
-               "dh": None, "kind": ""}
-        cache[name] = ent
+        # identity miss.  Per-batch dictionary_encode (non-parquet
+        # sources) builds a FRESH-but-identical dictionary every batch
+        # for stable low-cardinality columns, so before rebuilding the
+        # views, compare small dictionaries by CONTENT: a blake2b over
+        # the exact buffer bytes costs ~µs where re-materializing +
+        # re-hashing the values costs ~ms per batch per column.
+        # gate on VALUE count and buffer BYTES: a 4096-entry dictionary
+        # of long strings (or a small window over a huge parent buffer)
+        # would make the digest costlier than the rebuild it avoids
+        digest = _dictionary_digest(dictionary, bufs) \
+            if len(dictionary) <= 4096 and sum(
+                b.size for b in bufs if b is not None) <= (1 << 19) \
+            else None
+        if ent is not None and digest is not None \
+                and ent.get("content") == digest:
+            ent["key"] = key
+            ent["ref"] = dictionary     # keep the addresses alive
+        else:
+            ent = {"key": key, "ref": dictionary, "content": digest,
+                   "dvals": np.asarray(dictionary.to_pandas(),
+                                       dtype=object),
+                   "dh": None, "kind": ""}
+            cache[name] = ent
     if want_hashes and ent["dh"] is None and len(ent["dvals"]):
         ent["dh"], ent["kind"] = _hash64_dictionary(ent["ref"],
                                                     ent["dvals"])
     return ent["dvals"], ent["dh"], ent["kind"]
+
+
+def _dictionary_digest(dictionary, bufs) -> bytes:
+    """Content identity of a (small) dictionary: blake2b over the exact
+    buffer bytes plus the logical window.  Collisions are cryptographic-
+    negligible, so equal digests mean equal values."""
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
+    h.update(f"{len(dictionary)}:{dictionary.offset}:".encode())
+    for b in bufs:
+        # length-prefix each buffer (None = -1): without it the byte
+        # stream is ambiguous across buffer boundaries and two different
+        # dictionaries could collide structurally
+        size = b.size if b is not None else -1
+        h.update(size.to_bytes(8, "little", signed=True))
+        if b is not None:
+            h.update(memoryview(b))
+    return h.digest()
 
 
 def _hash64_dictionary(dictionary, dvals: np.ndarray
