@@ -58,7 +58,6 @@ def _ensure_fixture(name: str, rows: int, workdir: str) -> str:
 def run_table_scenario(name: str, scale: float, workdir: str,
                        backend: str) -> dict:
     from tpuprof import ProfileReport, ProfilerConfig
-    from tpuprof.utils.trace import get_phase_report
 
     from benchmarks import scenarios
 
@@ -73,14 +72,15 @@ def run_table_scenario(name: str, scale: float, workdir: str,
     # second run in-process: XLA programs are compiled, so this is the
     # steady-state rate (the first run pays ~20-40s of compiles; a real
     # deployment pays them once per schema thanks to the jit cache)
-    get_phase_report(reset=True)        # drop the cold run's phase totals
     t0 = time.perf_counter()
     report = ProfileReport(path, config=ProfilerConfig(backend=backend))
     report.to_file(out)
     warm = time.perf_counter() - t0
     n = report.description["table"]["n"]
-    phases = {k: round(v, 2) for k, v in
-              sorted(get_phase_report().items())}
+    # each profile's phase timings ride its stats dict (backends reset
+    # the process-global totals per collect)
+    phases = {k: round(v, 2) for k, v in sorted(
+        (report.description.get("_phases") or {}).items())}
     return {"scenario": name, "rows": n,
             "cols": report.description["table"]["nvar"],
             "seconds": round(warm, 3),
