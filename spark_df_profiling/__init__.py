@@ -19,7 +19,7 @@ wrote).
 from tpuprof import ProfileReport, ProfilerConfig, describe
 from tpuprof.report import formatters
 
-from spark_df_profiling import base
+from spark_df_profiling import base, templates
 
 __all__ = ["ProfileReport", "ProfilerConfig", "describe", "formatters",
-           "base"]
+           "base", "templates"]

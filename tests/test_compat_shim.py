@@ -56,3 +56,28 @@ def test_base_and_formatters_layout():
     assert stats["table"]["n"] == 3
     assert formatters.fmt_percent(0.125) == "12.5%"
     assert formatters.fmt_bytesize(2048).startswith("2.0")
+
+
+def test_base_to_html_and_templates_layout():
+    """The upstream package exposed base.to_html(sample, stats) and
+    templates.template(name) (SURVEY §2.1); both must work from the
+    shim."""
+    from spark_df_profiling import base, templates
+
+    df = pd.DataFrame({"x": [1.0, 2.0, 3.0], "c": ["marker_one",
+                                                   "marker_two",
+                                                   "marker_three"]})
+    stats = base.describe(df)
+    html = base.to_html(df.head(2), stats)
+    assert "var-x" in html and "var-c" in html
+    # the caller-supplied sample must actually drive the sample section:
+    # marker_three appears in the freq table either way, but only the
+    # None-sample render (describe captured all 3 rows) shows it in the
+    # sample section too
+    assert "marker_one" in html and "marker_two" in html
+    assert base.to_html(None, stats).count("marker_three") > \
+        html.count("marker_three")
+    tpl = templates.template("row_num")
+    assert hasattr(tpl, "render")
+    assert templates.template("base.html").render(
+        title="t", version="v", content="BODY").find("BODY") >= 0
