@@ -467,23 +467,33 @@ class TPUStatsBackend:
                 "multi-batch scan", resume.every, scan_s)
         with_hll = host_hll is None
 
-        def flush_a(pending):
-            """Fold the buffered batches into the device state: a FULL
-            group ships as one stacked placement folded by a single
-            multi-batch scan_a dispatch (the benched fast path —
+        def flush_group(pending, fold_staged, fold_one):
+            """THE staged-vs-tail flush policy (shared by both passes):
+            a FULL group ships as one stacked placement folded by a
+            single multi-batch scan dispatch (the benched fast path —
             amortizes per-dispatch latency); partial groups (tails,
-            checkpoint boundaries) fold per-batch through step_a, which
-            reuses one fixed compiled signature instead of compiling a
-            scan program per group size."""
-            nonlocal state
+            checkpoint boundaries) fold per-batch through the step
+            program, which reuses one fixed compiled signature instead
+            of compiling a scan program per group size."""
             if len(pending) == scan_s and scan_s > 1:
-                sb = runner.stage_batches(pending, with_hll=with_hll)
-                state = runner.scan_a(state, sb)
+                fold_staged(pending)
             else:
                 for p in pending:
-                    state = runner.step_a(
-                        state, runner.put_batch(p, with_hll=with_hll))
+                    fold_one(p)
             pending.clear()
+
+        def _staged_a(group):
+            nonlocal state
+            state = runner.scan_a(
+                state, runner.stage_batches(group, with_hll=with_hll))
+
+        def _one_a(p):
+            nonlocal state
+            state = runner.step_a(
+                state, runner.put_batch(p, with_hll=with_hll))
+
+        def flush_a(pending):
+            flush_group(pending, _staged_a, _one_a)
 
         with phase_timer("scan_a"):
             # centering shift from the first batch's prefix — any value
@@ -622,26 +632,25 @@ class TPUStatsBackend:
                 return runner.step_spearman(st, db_or_sb, sorted_sample,
                                             kept_counts)
 
-            def flush_b(pending):
-                """Pass-B twin of flush_a: full groups take the staged
-                scan_b dispatch (and the Spearman state folds from the
-                SAME staged placement — one transfer feeds both)."""
+            def _staged_b(group):
+                """Full groups take the staged scan_b dispatch, and the
+                Spearman state folds from the SAME staged placement —
+                one transfer feeds both."""
                 nonlocal state_b, spear_state
-                if len(pending) == scan_s and scan_s > 1:
-                    sb = runner.stage_batches(pending, with_hll=False)
-                    state_b = runner.scan_b(state_b, sb, lo_d, hi_d,
-                                            mean_d)
-                    if spear_state is not None:
-                        spear_state = fold_spear(spear_state, sb, True)
-                else:
-                    for p in pending:
-                        db = runner.put_batch(p, with_hll=False)
-                        state_b = runner.step_b(state_b, db, lo_d, hi_d,
-                                                mean_d)
-                        if spear_state is not None:
-                            spear_state = fold_spear(spear_state, db,
-                                                     False)
-                pending.clear()
+                sb = runner.stage_batches(group, with_hll=False)
+                state_b = runner.scan_b(state_b, sb, lo_d, hi_d, mean_d)
+                if spear_state is not None:
+                    spear_state = fold_spear(spear_state, sb, True)
+
+            def _one_b(p):
+                nonlocal state_b, spear_state
+                db = runner.put_batch(p, with_hll=False)
+                state_b = runner.step_b(state_b, db, lo_d, hi_d, mean_d)
+                if spear_state is not None:
+                    spear_state = fold_spear(spear_state, db, False)
+
+            def flush_b(pending):
+                flush_group(pending, _staged_b, _one_b)
 
             with phase_timer("scan_b"):
                 # hashes=False: pass B never reads the HLL plane, so the

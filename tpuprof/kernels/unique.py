@@ -293,7 +293,12 @@ class UniqueTracker:
                     ok = False
                 if not ok:
                     # checkpoint artifacts reference spill files by path;
-                    # a resume without them degrades honestly
+                    # a resume without them degrades honestly.  Detach
+                    # the run list BEFORE demoting: an unpickled copy
+                    # owns none of these files, and _drop_runs deleting
+                    # the survivors would destroy state a still-live
+                    # writer references
+                    self._runs[name] = []
                     self._demote(name, OVERFLOW)
                     break
 

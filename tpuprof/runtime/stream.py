@@ -101,8 +101,10 @@ class StreamingProfiler:
         self._buf: list = []                 # pending pa.RecordBatches
         self._buf_rows = 0
         # per-column last observed distinct count (plain-string row-hash
-        # path steering, ingest/arrow.ROWHASH_MIN_DISTINCT)
+        # path steering) and dictionary-view memo (content/identity
+        # reuse) — the same per-scan caches ArrowIngest owns
         self._col_stats: Dict[str, int] = {}
+        self._dict_cache: Dict[str, Dict[str, object]] = {}
 
     @classmethod
     def for_example(cls, example: Any, **kwargs) -> "StreamingProfiler":
@@ -154,6 +156,7 @@ class StreamingProfiler:
             return
         hb = prepare_batch(rbs[0], self.plan, self.runner.rows,
                            self.config.hll_precision,
+                           dict_cache=self._dict_cache,
                            col_stats=self._col_stats)
         if self.state is None:
             from tpuprof.backends.tpu import estimate_shift
@@ -193,8 +196,11 @@ class StreamingProfiler:
         mid-buffer is complete — it covers every row ever passed to
         ``update``."""
         from tpuprof.backends.tpu import _assemble, _empty_stats
+        from tpuprof.schema import VariablesView
         if not self.plan.specs:
-            return _empty_stats(self.config)
+            stats = _empty_stats(self.config)
+            stats["variables"] = VariablesView(stats["variables"])
+            return stats
         self._drain(force=True)
         state = self.state if self.state is not None \
             else self.runner.init_pass_a()
