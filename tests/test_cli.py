@@ -55,6 +55,19 @@ def test_cli_rejects_unknown_backend(parquet_path):
         main(["profile", parquet_path, "--backend", "cuda"])
 
 
+def test_multi_host_flags_require_all_three(tmp_path, parquet_path):
+    """Partial multi-host flags must fail fast (before any jax.distributed
+    call that would hang waiting for peers)."""
+    assert main(["profile", parquet_path, "-o", str(tmp_path / "r.html"),
+                 "--coordinator", "localhost:1"]) == 2
+    assert main(["profile", parquet_path, "-o", str(tmp_path / "r.html"),
+                 "--num-processes", "2"]) == 2
+    # and the pandas oracle cannot stripe fragments: cpu backend rejected
+    assert main(["profile", parquet_path, "-o", str(tmp_path / "r.html"),
+                 "--backend", "cpu", "--coordinator", "localhost:1",
+                 "--num-processes", "1", "--process-id", "0"]) == 2
+
+
 SNAPSHOT_NUM_FIELDS = sorted(schema.NUM_FIELDS)
 
 
