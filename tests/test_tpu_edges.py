@@ -142,3 +142,44 @@ def test_shim_attribute_access_after_plain_import():
     stats = spark_df_profiling.base.describe(
         pd.DataFrame({"x": [1.0, 2.0]}))
     assert stats["table"]["n"] == 2
+
+
+def test_binary_decimal_and_empty_dir_edges(tmp_path):
+    """Binary (non-utf8) and decimal columns must profile gracefully on
+    every path tier, and an empty dataset directory yields the empty
+    profile rather than crashing."""
+    import pyarrow as pa
+
+    from tpuprof import describe
+
+    cfg = ProfilerConfig(backend="tpu", batch_rows=256)
+    t1 = pa.table({
+        "b": pa.array([b"\xff\xfe" + bytes([i % 7]) for i in range(1000)],
+                      type=pa.binary()),
+        "x": pa.array(np.random.default_rng(0).normal(size=1000)),
+    })
+    s1 = describe(t1, config=cfg)
+    assert s1["variables"]["b"]["type"] == schema.CAT
+    assert s1["variables"]["b"]["distinct_count"] == 7
+
+    from decimal import Decimal
+    t2 = pa.table({"d": pa.array([Decimal("1.25") * i for i in range(500)],
+                                 type=pa.decimal128(10, 2))})
+    s2 = describe(t2, config=cfg)
+    assert s2["variables"]["d"]["type"] == schema.NUM
+    assert s2["variables"]["d"]["mean"] == pytest.approx(311.875, rel=1e-4)
+
+    # high-cardinality binary exercises the row-hash gate (native may
+    # decline the non-utf8 cast per batch; either tier must stay exact)
+    t3 = pa.table({"hb": pa.array([b"\x80" + i.to_bytes(4, "big")
+                                   for i in range(40000)],
+                                  type=pa.binary())})
+    s3 = describe(t3, config=ProfilerConfig(backend="tpu",
+                                            batch_rows=20000))
+    assert s3["variables"]["hb"]["type"] == schema.UNIQUE
+    assert s3["variables"]["hb"]["distinct_count"] == 40000
+
+    empty = tmp_path / "empty_ds"
+    empty.mkdir()
+    s4 = describe(str(empty), config=cfg)
+    assert s4["table"]["n"] == 0
