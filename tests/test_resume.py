@@ -193,6 +193,46 @@ def test_resume_with_staged_scan(tmp_path, parquet_source, monkeypatch):
                     value != value and expect != expect), (name, field)
 
 
+def test_resume_preserves_unique_spill_exactness(tmp_path, monkeypatch):
+    """Checkpoint + unique_spill_dir: a crash after runs have spilled
+    must resume and still deliver the EXACT UNIQUE classification (the
+    artifact references the run files; __setstate__ validates them)."""
+    rng = np.random.default_rng(6)
+    n = 4000
+    df = pd.DataFrame({
+        "uid": [f"id{i:07d}" for i in range(n)],
+        "a": rng.normal(1.0, 0.5, n),
+    })
+    path = str(tmp_path / "u.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+
+    cfg = _cfg(tmp_path, unique_track_rows=600, topk_capacity=64,
+               unique_spill_dir=str(tmp_path / "spill"))
+    calls = {"n": 0}
+    real_update = HostAgg.update
+
+    def crashing_update(self, hb):
+        calls["n"] += 1
+        if calls["n"] == 12:           # several spills have happened
+            raise RuntimeError("injected crash mid-scan")
+        return real_update(self, hb)
+
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        TPUStatsBackend().collect(path, cfg)
+    monkeypatch.setattr(HostAgg, "update", real_update)
+    assert (tmp_path / "scan.ckpt").exists()
+    assert list((tmp_path / "spill").glob("*.u64"))   # runs on disk
+
+    resumed = TPUStatsBackend().collect(path, cfg)
+    v = resumed["variables"]["uid"]
+    assert v["type"] == "UNIQUE"
+    assert v["is_unique"] is True and v["distinct_count"] == n
+    assert v["distinct_approx"] is False
+    # working space cleaned up after assembly
+    assert not list((tmp_path / "spill").glob("*.u64"))
+
+
 def test_mismatched_checkpoint_rejected(tmp_path, parquet_source,
                                         monkeypatch):
     cfg = _cfg(tmp_path)

@@ -245,6 +245,12 @@ class _CollectCheckpoint:
     def save(self, state, sampler, hostagg, host_hll, cursor,
              frag_pos=None) -> None:
         from tpuprof.runtime import checkpoint as ckpt
+        # this artifact will reference the tracker's spill runs by path:
+        # from now on a crash must leave them on disk for resume (GC
+        # cleanup off — the flag pickles into the artifact too).  Before
+        # the FIRST save, __del__ may still reap them: nothing
+        # references the files yet
+        hostagg.unique.persistent = True
         ckpt.save(self.path, state,
                   {"sampler": sampler, "hostagg": hostagg,
                    "host_hll": host_hll, "frag_pos": frag_pos},
@@ -438,6 +444,10 @@ class TPUStatsBackend:
         if resume is not None and resume.exists():
             (state, sampler, hostagg, host_hll, skip,
              resume_frag) = resume.load()
+            # the artifact references the tracker's spill runs; assert
+            # crash protection on the resumed object too (artifacts
+            # pickled before the flag existed restore without it)
+            hostagg.unique.persistent = True
         else:
             state = None
         cursor = skip

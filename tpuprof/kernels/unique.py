@@ -85,6 +85,10 @@ class UniqueTracker:
         # GC of a transient unpickled copy (e.g. a failed checkpoint
         # load) can never destroy files a live artifact references
         self._owned: List[str] = []
+        # True while a checkpoint artifact references the runs: a CRASH
+        # must leave them on disk for resume, so GC cleanup is disabled
+        # and only explicit cleanup() (post-assembly) deletes them
+        self.persistent = False
         self._resolve_memo: Dict[str, Tuple[Tuple, str]] = {}
         disabled = self.budget <= 0 or self.total_budget <= 0
         for n in names:
@@ -258,15 +262,34 @@ class UniqueTracker:
 
     def cleanup(self) -> None:
         """Delete every spill run (idempotent; call once the profile is
-        assembled — checkpoints reference the files until then)."""
+        assembled — checkpoints reference the files until then).  Also
+        sweeps ORPHANS of this tracker's token lineage: a crash after
+        the last checkpoint leaves runs no artifact references, and a
+        resumed tracker inherits the crashed process's token, so the
+        sweep reclaims exactly its own litter — concurrent profiles
+        (different tokens) are untouched."""
         for name in list(self._runs):
             self._drop_runs(name)
+        if self.spill_dir:
+            import glob
+            pattern = os.path.join(
+                glob.escape(self.spill_dir),
+                f"tpuprof-uniq-{self._spill_token}-*.u64")
+            for path in glob.glob(pattern):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
     def __del__(self):
         # best-effort tmp hygiene for files THIS instance wrote only —
         # unpickled copies (checkpoint loads, cross-host gathers) own
-        # nothing, so their GC cannot destroy a live artifact's runs
+        # nothing, so their GC cannot destroy a live artifact's runs.
+        # Checkpointed trackers skip even that: a crash's GC must leave
+        # the runs for resume (the artifact references them by path).
         try:
+            if getattr(self, "persistent", False):
+                return
             for path in getattr(self, "_owned", ()):
                 try:
                     os.remove(path)

@@ -231,6 +231,9 @@ class StreamingProfiler:
         Buffered rows fold first — the artifact must cover every row the
         caller handed to ``update`` (the buffer itself is not saved)."""
         self._drain(force=True)
+        # the artifact references unique-spill runs by path: a crash
+        # must leave them for restore (kernels/unique.py persistence)
+        self.hostagg.unique.persistent = True
         host_blob = {
             "hostagg": self.hostagg,
             "sampler": self.sampler,
