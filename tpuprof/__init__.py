@@ -27,6 +27,6 @@ SURVEY.md §1):
 from tpuprof.api import ProfileReport, describe
 from tpuprof.config import ProfilerConfig
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 __all__ = ["ProfileReport", "describe", "ProfilerConfig", "__version__"]
