@@ -61,12 +61,37 @@ def test_fallback_when_native_absent(monkeypatch):
     from tpuprof.ingest import arrow as ia
     monkeypatch.setattr(native, "hash_u64_array", lambda bits: None)
     monkeypatch.setattr(native, "hash_string_dictionary", lambda arr: None)
-    out = ia._hash64(np.array([1.5, 2.5, np.nan]))
+    # _hash64's contract (ingest/arrow.py) takes CANONICAL uint64 keys;
+    # numeric values go through _num_keys first (bit patterns, so NaN is
+    # a legal value, not a cast hazard)
+    out = ia._hash64(ia._num_keys(np.array([1.5, 2.5, np.nan])))
     assert out.dtype == np.uint64 and out.shape == (3,)
     dvals = np.array(["a", "b"], dtype=object)
     out, kind = ia._hash64_dictionary(pa.array(["a", "b"]), dvals)
     assert out.dtype == np.uint64 and len(np.unique(out)) == 2
     assert kind == "pandas"
+
+
+def test_fallback_hashes_nan_floats_by_bit_pattern(monkeypatch):
+    """NaN-bearing float columns must hash via their bit patterns on the
+    pandas fallback path too — no float→int cast (which is platform-
+    dependent and raises RuntimeWarning), and -0.0 folds into +0.0."""
+    import warnings
+    from tpuprof.ingest import arrow as ia
+    monkeypatch.setattr(native, "hash_u64_array", lambda bits: None)
+    monkeypatch.setattr(native, "hash_pack_u64", lambda k, v, p: None)
+    vals = np.array([1.5, np.nan, -0.0, 0.0, 2.5])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")          # any warning fails
+        keys = ia._num_keys(vals)
+        h = ia._hash64(keys)
+        packed = ia._packed_obs(keys, ~np.isnan(vals), 11)
+    assert h.dtype == np.uint64 and h[2] == h[3]        # -0.0 == +0.0
+    np.testing.assert_array_equal(h, ia._hash64(ia._num_keys(vals.copy())))
+    assert packed.dtype == np.uint16 and packed[1] == 0  # NaN masked out
+    # f32 keys stay in the f32 bit-pattern domain (never widened)
+    k32 = ia._num_keys(np.array([1.5, np.nan], dtype=np.float32))
+    assert k32[0] == np.float32(1.5).view(np.uint32)
 
 
 @requires_native

@@ -260,3 +260,153 @@ class TestSpillTier:
         v2 = stats2["variables"]["u"]
         assert v2["type"] == schema.CAT
         assert v2["distinct_count"] <= n - 1
+
+
+class TestSpillLifecycle:
+    """Run-file lifecycle under checkpointing (ADVICE r3): demoted runs
+    a saved artifact still references defer deletion; restored trackers
+    mint fresh filename tokens; lineage sweeps reclaim ancestors."""
+
+    def _tracker(self, tmp_path, budget=400):
+        return kunique.UniqueTracker(["c", "d"], budget, 1 << 30,
+                                     spill_dir=str(tmp_path / "spill"))
+
+    def test_demote_defers_deletion_while_persistent(self, tmp_path):
+        import os
+        import pickle
+        t = self._tracker(tmp_path)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))       # spills
+        paths = [p for p, _ in t._runs["c"]]
+        assert paths and all(os.path.exists(p) for p in paths)
+        blob = pickle.dumps(t)          # "checkpoint" references the runs
+        t.persistent = True
+        # a later duplicate demotes the column — but the artifact still
+        # references the run files, so they must survive until the next
+        # save (reap_retired) or cleanup
+        t.update("c", np.array([7, 7], dtype=np.uint64))
+        assert t.status["c"] == kunique.DUP
+        assert all(os.path.exists(p) for p in paths), \
+            "demote deleted runs a saved checkpoint references"
+        # crash + resume from the old artifact: exact answer preserved
+        t2 = pickle.loads(blob)
+        assert t2.resolve()["c"] == kunique.UNIQUE
+        del t2
+        t.reap_retired()                # next save happened: now delete
+        assert not any(os.path.exists(p) for p in paths)
+
+    def test_nonpersistent_demote_deletes_immediately(self, tmp_path):
+        import os
+        t = self._tracker(tmp_path)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))
+        paths = [p for p, _ in t._runs["c"]]
+        t.update("c", np.array([7, 7], dtype=np.uint64))
+        assert not any(os.path.exists(p) for p in paths)
+
+    def test_restored_tracker_mints_fresh_token(self, tmp_path):
+        import pickle
+        t = self._tracker(tmp_path)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))
+        t.persistent = True
+        blob = pickle.dumps(t)
+        a = pickle.loads(blob)
+        b = pickle.loads(blob)
+        # two concurrent resumes (or resume + still-live writer) must
+        # never generate colliding run filenames
+        assert len({t._spill_token, a._spill_token, b._spill_token}) == 3
+        a.update("c", np.arange(1000, 1401, dtype=np.uint64))   # spills
+        b.update("c", np.arange(1000, 1401, dtype=np.uint64))   # spills
+        a_new = {p for p, _ in a._runs["c"]} - {p for p, _ in t._runs["c"]}
+        b_new = {p for p, _ in b._runs["c"]} - {p for p, _ in t._runs["c"]}
+        assert a_new and b_new and not (a_new & b_new)
+        # cleanup on a restored tracker deletes every run it REFERENCES
+        # (the inherited ancestor files + its own new ones) ...
+        a.cleanup()
+        import os
+        assert not any(os.path.exists(p) for p, _ in t._runs["c"])
+        assert not any(os.path.exists(p) for p in a_new)
+        # ... but a sibling's young same-artifact runs survive the sweep:
+        # b could be a still-live concurrent writer, and only age (not
+        # the filename) can prove abandonment (ORPHAN_SWEEP_AGE_S)
+        assert all(os.path.exists(p) for p in b_new)
+
+    def test_cleanup_age_gated_orphan_sweep(self, tmp_path):
+        import os
+        import time
+        t = self._tracker(tmp_path)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))       # spills
+        spill = tmp_path / "spill"
+        fresh = spill / "tpuprof-uniq-deadbeef0001-0.u64"
+        stale = spill / "tpuprof-uniq-deadbeef0002-0.u64"
+        for p in (fresh, stale):
+            np.arange(4, dtype=np.uint64).tofile(str(p))
+        old = time.time() - kunique.ORPHAN_SWEEP_AGE_S - 60
+        os.utime(str(stale), (old, old))
+        t.cleanup()
+        assert not any(spill.glob(f"*{t._spill_token}*"))
+        assert fresh.exists(), "young foreign run swept — could be live"
+        assert not stale.exists(), "aged-out orphan not reclaimed"
+
+    def test_streaming_close_reclaims_spill_runs(self, tmp_path):
+        import pyarrow as pa
+        from tpuprof import ProfilerConfig
+        from tpuprof.runtime.stream import StreamingProfiler
+        cfg = ProfilerConfig(batch_rows=512, unique_track_rows=600,
+                             topk_capacity=64,
+                             unique_spill_dir=str(tmp_path / "sp"))
+        schema_ = pa.schema([("u", pa.string())])
+        with StreamingProfiler(schema_, cfg) as prof:
+            for start in range(0, 4096, 512):
+                prof.update(pd.DataFrame(
+                    {"u": [f"id{i:07d}" for i in range(start, start + 512)]}))
+            prof.checkpoint(str(tmp_path / "s.ckpt"))   # runs persistent
+            v = prof.stats()["variables"]["u"]
+            assert v["type"] == schema.UNIQUE
+            assert list((tmp_path / "sp").glob("*.u64"))
+        # context exit -> close() -> spill working space reclaimed even
+        # though a checkpoint had marked the runs crash-persistent
+        assert not list((tmp_path / "sp").glob("*.u64"))
+
+    def test_streaming_exit_on_error_keeps_checkpointed_runs(self, tmp_path):
+        """An exception escaping the with-block is the crash a checkpoint
+        exists FOR: __exit__ must leave the referenced spill runs so
+        restore() keeps the exact claim (code-review r4 finding)."""
+        import pyarrow as pa
+        from tpuprof import ProfilerConfig
+        from tpuprof.runtime.stream import StreamingProfiler
+        cfg = ProfilerConfig(batch_rows=512, unique_track_rows=600,
+                             topk_capacity=64,
+                             unique_spill_dir=str(tmp_path / "sp"))
+        schema_ = pa.schema([("u", pa.string())])
+        with pytest.raises(RuntimeError, match="mid-stream"):
+            with StreamingProfiler(schema_, cfg) as prof:
+                for start in range(0, 4096, 512):
+                    prof.update(pd.DataFrame(
+                        {"u": [f"id{i:07d}"
+                               for i in range(start, start + 512)]}))
+                prof.checkpoint(str(tmp_path / "s.ckpt"))
+                raise RuntimeError("mid-stream failure")
+        assert list((tmp_path / "sp").glob("*.u64")), \
+            "error-path exit deleted runs the artifact references"
+        restored = StreamingProfiler.restore(str(tmp_path / "s.ckpt"), cfg)
+        v = restored.stats()["variables"]["u"]
+        assert v["type"] == schema.UNIQUE and v["distinct_count"] == 4096
+        restored.close()
+        assert not list((tmp_path / "sp").glob("*.u64"))
+
+    def test_streaming_exit_on_error_without_checkpoint_cleans(self,
+                                                               tmp_path):
+        import pyarrow as pa
+        from tpuprof import ProfilerConfig
+        from tpuprof.runtime.stream import StreamingProfiler
+        cfg = ProfilerConfig(batch_rows=512, unique_track_rows=600,
+                             topk_capacity=64,
+                             unique_spill_dir=str(tmp_path / "sp"))
+        with pytest.raises(RuntimeError):
+            with StreamingProfiler(pa.schema([("u", pa.string())]),
+                                   cfg) as prof:
+                for start in range(0, 4096, 512):
+                    prof.update(pd.DataFrame(
+                        {"u": [f"id{i:07d}"
+                               for i in range(start, start + 512)]}))
+                raise RuntimeError("no artifact references the runs")
+        assert not list((tmp_path / "sp").glob("*.u64"))

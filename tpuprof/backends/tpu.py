@@ -201,10 +201,12 @@ class _CollectCheckpoint:
     persist (device state, host sketches, batch cursor) every N batches;
     resume = load + skip the already-folded prefix of the deterministic
     batch stream.  Single-process only in v1 — each host would otherwise
-    need its own artifact and a coordinated cursor.  Known cost: the
-    skipped prefix is still read+Arrow-decoded on resume (the skip is
-    per-batch, not per-fragment); the folds and transfers it saves are
-    the dominant share of scan time."""
+    need its own artifact and a coordinated cursor.  Resume skips the
+    prefix without re-decoding it: file-backed sources skip whole
+    fragments' I/O via (fragment, batch) positions, and in-memory tables
+    skip zero-copy ``to_batches`` slices (positions on the single
+    pseudo-fragment).  Only artifacts saved without a position (older
+    layouts) fall back to decode-and-skip."""
 
     _META_KEYS = ("n_num", "n_hash", "batch_rows", "hll_precision",
                   "native_hash", "source_fp", "quantile_sketch_size",
@@ -255,6 +257,9 @@ class _CollectCheckpoint:
                   {"sampler": sampler, "hostagg": hostagg,
                    "host_hll": host_hll, "frag_pos": frag_pos},
                   cursor, meta=self._meta())
+        # the new artifact no longer references runs demoted since the
+        # previous save — only now is their physical deletion safe
+        hostagg.unique.reap_retired()
         self.last_saved = cursor
         log_event("collect_checkpoint", cursor=cursor, path=self.path,
                   frag_pos=frag_pos)
@@ -706,9 +711,13 @@ class TPUStatsBackend:
                           hostagg, momf, rho_all, quants, sample_vals,
                           sample_kept, hll_est, hists, mad, recounter,
                           probes, rho_spear=rho_spear)
+        # spill runs go FIRST: a crash between the two deletes leaves an
+        # artifact whose missing runs degrade honestly on resume
+        # (__setstate__ demotes to OVERFLOW), whereas the reverse order
+        # would orphan run files no future cleanup sweep owns
+        hostagg.unique.cleanup()     # spill runs are working space only
         if resume is not None:
             resume.clear()           # profile assembled: artifact is stale
-        hostagg.unique.cleanup()     # spill runs are working space only
         # this profile's phase timings ride the stats dict (the report
         # footer reads them from there — global state would attribute
         # another profile's scan to this report)
@@ -823,22 +832,26 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
     for spec in plan.specs:
         name, kind, common = spec.name, kinds[spec.name], commons[spec.name]
         stats = dict(common)
-        if kind in (schema.NUM, schema.BOOL):
-            lane = spec.num_lane
-            stats.update(_numeric_stats(lane, spec, momf, quants,
+        if kind == schema.NUM:
+            stats.update(_numeric_stats(spec.num_lane, spec, momf, quants,
                                         sample_vals, sample_kept, hists,
                                         mad, probes, config))
-            if kind == schema.BOOL:
-                n_true = int(round(momf["sum"][lane])) if common["count"] else 0
-                vc = pd.Series({True: n_true,
-                                False: common["count"] - n_true}
-                               ).sort_values(ascending=False)
-                freq[name] = vc
-                stats["mean"] = momf["mean"][lane]
-                stats["mode"] = bool(vc.index[0]) if common["count"] else np.nan
-                stats["mode_approx"] = False    # from exact true/false counts
-                stats["top"] = stats["mode"]
-                stats["freq"] = int(vc.iloc[0]) if common["count"] else 0
+        elif kind == schema.BOOL:
+            # same FIELD SET as the oracle's describe_bool_1d (categorical
+            # fields + mean) — the dict contract must not vary by backend
+            # (tests/test_field_parity.py); the numeric lane still supplies
+            # the exact true/false counts
+            lane = spec.num_lane
+            n_true = int(round(momf["sum"][lane])) if common["count"] else 0
+            vc = pd.Series({True: n_true,
+                            False: common["count"] - n_true}
+                           ).sort_values(ascending=False)
+            freq[name] = vc
+            stats["mean"] = float(momf["mean"][lane])
+            stats["mode"] = bool(vc.index[0]) if common["count"] else np.nan
+            stats["mode_approx"] = False    # from exact true/false counts
+            stats["top"] = stats["mode"]
+            stats["freq"] = int(vc.iloc[0]) if common["count"] else 0
         elif kind == schema.CAT:
             vc = (recounter.value_counts(name) if recounter is not None
                   else pd.Series({v: c for v, c in

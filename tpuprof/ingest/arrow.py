@@ -500,7 +500,9 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
       stay parallel), so checkpointed runs trade a little ingest overlap
       for fragment-granular resumability.
     * ``skip_batches=N``: drop the stream's first N raw batches without
-      preparing them (in-memory tables, which have no fragments)."""
+      preparing them (fallback for resume cursors saved without a
+      position — current artifacts carry positions for file-backed AND
+      in-memory sources)."""
     import queue
     import threading
 
@@ -699,12 +701,9 @@ class ArrowIngest:
     def raw_batches(self) -> Iterator[pa.RecordBatch]:
         pidx, pcount = self.process_shard
         if self._table is not None:
-            if pcount != 1:
-                raise ValueError(
-                    "multi-host profiling requires a file-backed dataset "
-                    "(each host streams its own fragments); got an "
-                    "in-memory table")
-            yield from self._table.to_batches(max_chunksize=self.batch_rows)
+            # one code path for table streaming (the positioned variant
+            # owns the multi-host guard and the zero-copy slicing)
+            yield from (rb for _fi, _bi, rb in self.raw_batches_positioned())
             return
         # Happy path: the dataset Scanner (multithreaded cross-fragment
         # readahead).  Only after the first IO error do we drop to
@@ -743,8 +742,9 @@ class ArrowIngest:
 
     def supports_positions(self) -> bool:
         """True when the source can stream (frag, batch) positioned
-        batches — i.e. it is file-backed (fragments exist)."""
-        return self._dataset is not None
+        batches: file-backed datasets (real fragments) and in-memory
+        tables (one pseudo-fragment of zero-copy slices)."""
+        return True
 
     def raw_batches_positioned(self, skip_fragments: int = 0
                                ) -> Iterator[Tuple[int, int, pa.RecordBatch]]:
@@ -755,10 +755,25 @@ class ArrowIngest:
         resume cheap: only the one partially-folded fragment re-reads.
         Batch boundaries within a fragment are deterministic for a fixed
         batch size, so positions are stable across runs.  Same
-        fragment-granular retry contract as ``raw_batches``."""
+        fragment-granular retry contract as ``raw_batches``.
+
+        In-memory tables stream as fragment 0: ``to_batches`` slices are
+        zero-copy views, so the consumer skipping ``bi < done`` costs
+        nothing per skipped batch — resume never re-decodes the folded
+        prefix (SURVEY §5 checkpoint row)."""
         if self._dataset is None:
-            raise ValueError("positioned batches require a file-backed "
-                             "dataset source")
+            pidx, pcount = self.process_shard
+            if pcount != 1:
+                raise ValueError(
+                    "multi-host profiling requires a file-backed dataset "
+                    "(each host streams its own fragments); got an "
+                    "in-memory table")
+            if skip_fragments >= 1:
+                return          # the single pseudo-fragment is complete
+            for bi, rb in enumerate(
+                    self._table.to_batches(max_chunksize=self.batch_rows)):
+                yield 0, bi, rb
+            return
         for fi, fragment in enumerate(self._my_fragments()):
             if fi < skip_fragments:
                 continue

@@ -57,6 +57,13 @@ OVERFLOW = "overflow"   # gave up within budget — distinct is approximate
 # many rows (128 MB of uint64) — RAM stays bounded at any total n
 RESOLVE_SLICE_ROWS = 1 << 24
 
+# cleanup() reclaims OTHER tokens' spill files only past this age: a
+# crashed chain's post-checkpoint orphans (which no artifact references)
+# eventually get swept, while a still-live concurrent writer's runs —
+# which cleanup cannot distinguish by name — are never touched young.
+# No realistic profile keeps a run file live this long.
+ORPHAN_SWEEP_AGE_S = 24 * 3600
+
 
 class UniqueTracker:
     """Tracks, per column, whether any value hash occurred twice."""
@@ -85,6 +92,11 @@ class UniqueTracker:
         # GC of a transient unpickled copy (e.g. a failed checkpoint
         # load) can never destroy files a live artifact references
         self._owned: List[str] = []
+        # runs demoted while persistent=True: the LAST saved checkpoint
+        # still references them by path, so physical deletion is
+        # deferred until the next successful save (reap_retired) or
+        # cleanup() — a crash in between must leave resume intact
+        self._retired: List[str] = []
         # True while a checkpoint artifact references the runs: a CRASH
         # must leave them on disk for resume, so GC cleanup is disabled
         # and only explicit cleanup() (post-assembly) deletes them
@@ -114,12 +126,32 @@ class UniqueTracker:
         self._drop_runs(name)
 
     def _drop_runs(self, name: str) -> None:
-        for path, _rows in self._runs.get(name, ()):
+        paths = [p for p, _rows in self._runs.get(name, ())]
+        self._runs[name] = []
+        if self.persistent:
+            # the last saved checkpoint artifact may still reference
+            # these files — a crash before the NEXT save must find them
+            # on disk or resume silently loses the exact answer the
+            # spill tier promised.  Defer deletion to reap_retired()
+            # (after the next save) / cleanup()
+            self._retired.extend(paths)
+            return
+        for path in paths:
             try:
                 os.remove(path)
             except OSError:
                 pass
-        self._runs[name] = []
+
+    def reap_retired(self) -> None:
+        """Physically delete runs demoted since the previous checkpoint
+        save.  Call only once a NEW artifact — which no longer
+        references them — is durably on disk."""
+        for path in self._retired:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self._retired = []
 
     def _spill(self, name: str) -> bool:
         """Write the column's consolidated in-memory chunk to a disk run
@@ -262,22 +294,42 @@ class UniqueTracker:
 
     def cleanup(self) -> None:
         """Delete every spill run (idempotent; call once the profile is
-        assembled — checkpoints reference the files until then).  Also
-        sweeps ORPHANS of this tracker's token lineage: a crash after
-        the last checkpoint leaves runs no artifact references, and a
-        resumed tracker inherits the crashed process's token, so the
-        sweep reclaims exactly its own litter — concurrent profiles
-        (different tokens) are untouched."""
+        assembled — checkpoints reference the files until then): all
+        runs this tracker references by path, everything under its own
+        filename token, and — age-gated (ORPHAN_SWEEP_AGE_S) — other
+        tokens' abandoned litter (crashed chains' post-checkpoint
+        orphans).  Young files under other tokens are never touched:
+        they may belong to a still-live concurrent writer."""
+        self.persistent = False     # nothing references the runs now —
+        # _drop_runs may delete physically instead of retiring
         for name in list(self._runs):
             self._drop_runs(name)
+        self.reap_retired()
         if self.spill_dir:
             import glob
-            pattern = os.path.join(
+            import time
+            # own token: sweep unconditionally (only this process writes
+            # under it).  Everything else — inherited ancestor tokens,
+            # unrelated dead processes — only past ORPHAN_SWEEP_AGE_S:
+            # a file under another token could belong to a STILL-LIVE
+            # writer sharing the artifact or the dir, and deleting it
+            # would hollow that process's exact claim; age is the only
+            # safe evidence of abandonment cleanup has.
+            own = os.path.join(
                 glob.escape(self.spill_dir),
                 f"tpuprof-uniq-{self._spill_token}-*.u64")
-            for path in glob.glob(pattern):
+            stale_before = time.time() - ORPHAN_SWEEP_AGE_S
+            any_pat = os.path.join(glob.escape(self.spill_dir),
+                                   "tpuprof-uniq-*.u64")
+            for path in glob.glob(own):
                 try:
                     os.remove(path)
+                except OSError:
+                    pass
+            for path in glob.glob(any_pat):
+                try:
+                    if os.path.getmtime(path) < stale_before:
+                        os.remove(path)
                 except OSError:
                     pass
 
@@ -302,12 +354,26 @@ class UniqueTracker:
         state = dict(self.__dict__)
         state["_resolve_memo"] = {}
         state["_owned"] = []
+        # retired paths belong to the WRITER's save/reap cycle, not the
+        # artifact: a restored process must neither delete nor track them
+        state["_retired"] = []
         return state
 
     def __setstate__(self, state) -> None:
         self.__dict__.update(state)
         self._resolve_memo = {}
         self._owned = []
+        self._retired = []
+        # mint a FRESH filename token for runs written after restore:
+        # two processes resuming the same artifact (or a still-live
+        # original writer) would otherwise generate identical run names
+        # and silently overwrite each other's spill files.  The
+        # inherited runs stay reachable through self._runs (cleanup
+        # deletes by path); a crashed ancestor's post-checkpoint orphans
+        # fall to the age-gated sweep.
+        import uuid
+        self._spill_token = uuid.uuid4().hex[:12]
+        self._spill_seq = 0
         for name, runs in list(self._runs.items()):
             for path, rows in runs:
                 try:

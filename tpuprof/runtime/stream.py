@@ -248,6 +248,33 @@ class StreamingProfiler:
                         "has_state": self.state is not None,
                         # HLL registers only merge with same-impl hashes
                         "native_hash": native.available()})
+        # runs demoted since the previous save are no longer referenced
+        # by any artifact — reclaim their disk now
+        self.hostagg.unique.reap_retired()
+
+    def close(self) -> None:
+        """Release the profiler's disk working space (unique-spill runs).
+
+        A checkpointed stream marks its spill runs crash-persistent, so
+        they survive process exits by design; long-lived streams with
+        ``unique_spill_dir`` must call ``close()`` (or use the profiler
+        as a context manager) once the stream is done, or the runs —
+        8 bytes/row/column — persist until manually deleted.  Snapshots
+        are invalid after close (the exact-UNIQUE state is gone);
+        take a final ``stats()``/``report_html()`` first."""
+        self.hostagg.unique.cleanup()
+
+    def __enter__(self) -> "StreamingProfiler":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # an exception escaping the with-block is exactly the "crash"
+        # a checkpoint promises to survive: once an artifact references
+        # the spill runs (persistent=True), the error path must leave
+        # them on disk for restore().  Clean exit — or a stream that
+        # never checkpointed — reclaims as usual.
+        if exc_type is None or not self.hostagg.unique.persistent:
+            self.close()
 
     @classmethod
     def restore(cls, path: str, config: Optional[ProfilerConfig] = None,
