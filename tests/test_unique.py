@@ -225,12 +225,48 @@ class TestSpillTier:
         t3 = pickle.loads(blob)
         assert t3.status["c"] == kunique.OVERFLOW
 
-    def test_merge_with_spilled_column_demotes(self, tmp_path):
+    def test_merge_adopts_visible_spilled_runs(self, tmp_path):
+        """Shared-spill-dir merge law: a peer's runs that validated
+        present on this host fold in by path; resolve() finds
+        cross-host duplicates exactly (VERDICT r3 #1)."""
         t = self._tracker(tmp_path, budget=400)
         t.update("c", np.arange(0, 401, dtype=np.uint64))      # spilled
         other = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
         other.update("c", np.arange(5000, 5100, dtype=np.uint64))
         t.merge(other)
+        assert t.status["c"] == kunique.UNIQUE
+        assert t.resolve()["c"] == kunique.UNIQUE
+        # a peer whose chunk holds a value inside OUR spilled run: no
+        # in-memory probe can see it, the k-way resolve must
+        t2 = self._tracker(tmp_path, budget=400)
+        t2.update("c", np.arange(0, 401, dtype=np.uint64))     # spilled
+        peer = kunique.UniqueTracker(["c"], 1 << 20, 1 << 20)
+        peer.update("c", np.array([200, 9000], dtype=np.uint64))
+        t2.merge(peer)
+        assert t2.resolve()["c"] == kunique.DUP
+        # both peers spilled (shared dir): runs concatenate and resolve
+        a = self._tracker(tmp_path, budget=400)
+        a.update("c", np.arange(0, 401, dtype=np.uint64))
+        b = self._tracker(tmp_path, budget=400)
+        b.update("c", np.arange(1000, 1401, dtype=np.uint64))
+        a.merge(b)
+        assert len(a._runs["c"]) == 2
+        assert a.resolve()["c"] == kunique.UNIQUE
+
+    def test_merge_with_unreachable_peer_runs_demotes(self, tmp_path):
+        """A peer whose spill disk is NOT visible here (its run files
+        are gone at unpickle) arrives OVERFLOW — the merge keeps the
+        honest bound instead of claiming exactness it cannot check."""
+        import pickle
+        t = self._tracker(tmp_path, budget=400)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))
+        peer = self._tracker(tmp_path, budget=400)
+        peer.update("c", np.arange(1000, 1401, dtype=np.uint64))
+        blob = pickle.dumps(peer)
+        peer.cleanup()                       # simulate a host-local disk
+        restored = pickle.loads(blob)        # files missing -> OVERFLOW
+        assert restored.status["c"] == kunique.OVERFLOW
+        t.merge(restored)
         assert t.status["c"] == kunique.OVERFLOW
 
     def test_backend_exact_unique_past_budget(self, tmp_path):
@@ -410,3 +446,45 @@ class TestSpillLifecycle:
                                for i in range(start, start + 512)]}))
                 raise RuntimeError("no artifact references the runs")
         assert not list((tmp_path / "sp").glob("*.u64"))
+
+
+class TestCrossHostOwnership:
+    """Ownership + verdict-broadcast mechanics behind the multi-host
+    merge (runtime/distributed.merge_host_aggs / resolve_unique_...)."""
+
+    def test_claim_runs_makes_merged_copy_reap_on_gc(self, tmp_path):
+        import gc
+        import os
+        import pickle
+        t = kunique.UniqueTracker(["c"], 400, 1 << 30,
+                                  spill_dir=str(tmp_path / "spill"))
+        t.update("c", np.arange(0, 401, dtype=np.uint64))       # spills
+        paths = [p for p, _ in t._runs["c"]]
+        merged = pickle.loads(pickle.dumps(t))   # the allgathered copy
+        t.disown_runs()
+        merged.claim_runs()
+        assert set(merged._owned) == set(paths)
+        # an exception between merge and cleanup drops the merged copy:
+        # its GC must reap the fleet's files (nobody else owns them now)
+        del merged
+        gc.collect()
+        assert not any(os.path.exists(p) for p in paths)
+        del t
+        gc.collect()        # disowned original reaps nothing (no error)
+
+    def test_seed_resolution_skips_disk(self, tmp_path, monkeypatch):
+        t = kunique.UniqueTracker(["c", "d"], 400, 1 << 30,
+                                  spill_dir=str(tmp_path / "spill"))
+        t.update("c", np.arange(0, 401, dtype=np.uint64))
+        t.update("d", np.arange(0, 401, dtype=np.uint64))
+        t.seed_resolution({"c": kunique.UNIQUE, "d": kunique.DUP})
+        # adopted verdicts are served from the memo — no memmap reads
+        def no_disk(*a, **k):
+            raise AssertionError("resolve read disk despite seeding")
+        monkeypatch.setattr(kunique.np, "memmap", no_disk)
+        out = t.resolve()
+        assert out["c"] == kunique.UNIQUE and out["d"] == kunique.DUP
+        # a mutation AFTER seeding invalidates the memo key
+        monkeypatch.undo()
+        t.update("c", np.array([200], dtype=np.uint64))  # dup in run
+        assert t.resolve()["c"] == kunique.DUP

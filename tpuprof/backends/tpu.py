@@ -200,25 +200,32 @@ class _CollectCheckpoint:
     """Batch-granular resumability for the pass-A scan (SURVEY §5):
     persist (device state, host sketches, batch cursor) every N batches;
     resume = load + skip the already-folded prefix of the deterministic
-    batch stream.  Single-process only in v1 — each host would otherwise
-    need its own artifact and a coordinated cursor.  Resume skips the
-    prefix without re-decoding it: file-backed sources skip whole
-    fragments' I/O via (fragment, batch) positions, and in-memory tables
-    skip zero-copy ``to_batches`` slices (positions on the single
-    pseudo-fragment).  Only artifacts saved without a position (older
-    layouts) fall back to decode-and-skip."""
+    batch stream.  Resume skips the prefix without re-decoding it:
+    file-backed sources skip whole fragments' I/O via (fragment, batch)
+    positions, and in-memory tables skip zero-copy ``to_batches`` slices
+    (positions on the single pseudo-fragment).  Only artifacts saved
+    without a position (older layouts) fall back to decode-and-skip.
+
+    Multi-host: each host persists its OWN stripe's scan to a per-host
+    artifact (``<path>.h<i>of<N>``) — host cursors are independent by
+    design (stripes have different batch counts and no collective runs
+    during pass A), so no coordinated global cursor exists or is needed;
+    the meta pins (process_id, process_count) so an artifact can never
+    resume a different stripe assignment, and collect runs a resume
+    barrier (runtime/distributed.allgather) so every host agrees on who
+    restored before any scanning starts."""
 
     _META_KEYS = ("n_num", "n_hash", "batch_rows", "hll_precision",
                   "native_hash", "source_fp", "quantile_sketch_size",
-                  "topk_capacity", "seed")
+                  "topk_capacity", "seed", "process_id", "process_count")
 
     def __init__(self, config: ProfilerConfig, plan, runner, pshard,
                  source_fp: str):
-        if pshard[1] != 1:
-            raise ValueError(
-                "checkpoint_path is single-process only; multi-host "
-                "profiles restart from the beginning on failure")
-        self.path = config.checkpoint_path
+        self.pshard = pshard
+        path = config.checkpoint_path
+        if pshard[1] > 1:
+            path = f"{path}.h{pshard[0]}of{pshard[1]}"
+        self.path = path
         self.every = max(int(config.checkpoint_every_batches), 1)
         self.config = config
         self.plan = plan
@@ -242,7 +249,9 @@ class _CollectCheckpoint:
                 "source_fp": self.source_fp,
                 "quantile_sketch_size": self.config.quantile_sketch_size,
                 "topk_capacity": self.config.topk_capacity,
-                "seed": self.config.seed}
+                "seed": self.config.seed,
+                "process_id": self.pshard[0],
+                "process_count": self.pshard[1]}
 
     def save(self, state, sampler, hostagg, host_hll, cursor,
              frag_pos=None) -> None:
@@ -393,19 +402,10 @@ class TPUStatsBackend:
                                                  merge_samplers,
                                                  merge_shift_estimates)
         pshard = (jax.process_index(), jax.process_count())
-        if pshard[1] > 1 and config.unique_spill_dir:
-            # spilled runs live on each host's own disk and cannot fold
-            # across hosts (UniqueTracker.merge demotes them) — spilling
-            # would be guaranteed-wasted I/O, so disable it up front
-            import dataclasses
-
-            from tpuprof.utils.trace import logger
-            logger.warning(
-                "unique_spill_dir is single-process only (spilled runs "
-                "cannot merge across hosts); exact UNIQUE tracking "
-                "falls back to the in-memory budget for this "
-                "multi-host profile")
-            config = dataclasses.replace(config, unique_spill_dir=None)
+        # multi-host spill works when unique_spill_dir is SHARED storage
+        # (each host's runs validate present everywhere and the merge
+        # adopts them — kernels/unique.py merge law); host-local dirs
+        # degrade honestly to OVERFLOW at merge time, not up front
         ingest = ArrowIngest(source, config.batch_rows, process_shard=pshard)
         plan = ingest.plan
         if not plan.specs:
@@ -446,15 +446,49 @@ class TPUStatsBackend:
             if config.checkpoint_path else None
         skip = 0
         resume_frag = None
-        if resume is not None and resume.exists():
-            (state, sampler, hostagg, host_hll, skip,
-             resume_frag) = resume.load()
-            # the artifact references the tracker's spill runs; assert
-            # crash protection on the resumed object too (artifacts
-            # pickled before the flag existed restore without it)
-            hostagg.unique.persistent = True
-        else:
-            state = None
+        restored = resume is not None and resume.exists()
+        state = None
+        if restored:
+            try:
+                (state, sampler, hostagg, host_hll, skip,
+                 resume_frag) = resume.load()
+                # the artifact references the tracker's spill runs;
+                # assert crash protection on the resumed object too
+                # (artifacts pickled before the flag existed restore
+                # without it)
+                hostagg.unique.persistent = True
+            except Exception as exc:
+                if pshard[1] == 1:
+                    raise       # single host: fail fast and say why
+                # multi-host: one host's unreadable artifact (older
+                # format, torn write) must not exit this process while
+                # its peers block in the resume-barrier collective —
+                # fall back to a fresh stripe scan, loudly
+                from tpuprof.utils.trace import logger
+                logger.warning(
+                    "host %d: checkpoint artifact %r failed to load "
+                    "(%s); rescanning this host's stripe from zero",
+                    pshard[0], resume.path, exc)
+                restored = False
+                state, skip, resume_frag = None, 0, None
+        if resume is not None and pshard[1] > 1:
+            # resume barrier: every host reports (rank, restored?,
+            # cursor) before any scanning starts — each host's meta has
+            # already pinned its artifact to this (stripe, source,
+            # config), so a mixed fleet is CORRECT (a fresh host just
+            # rescans its own stripe) but worth saying out loud
+            peers = allgather_objects((pshard[0], restored, skip))
+            log_event("multihost_resume_barrier", peers=peers)
+            flags = {r for _, r, _ in peers}
+            if flags == {True, False}:
+                from tpuprof.utils.trace import logger
+                logger.warning(
+                    "multi-host resume: hosts %s restored a checkpoint "
+                    "but hosts %s start from zero (their artifacts are "
+                    "missing or were cleared) — results are unaffected; "
+                    "the fresh hosts simply rescan their stripes",
+                    sorted(p for p, r, _ in peers if r),
+                    sorted(p for p, r, _ in peers if not r))
         cursor = skip
         # fragment-positioned streaming whenever checkpointing is on, so
         # saved cursors carry (fragment, batch) and resume skips whole
@@ -528,6 +562,18 @@ class TPUStatsBackend:
                     estimate_shift(first_hb)
                     if first_hb is not None else None)
                 state = runner.init_pass_a(shift)
+            elif pshard[1] > 1:
+                # a RESTORED host must still participate in the fleet's
+                # shift agreement: in a mixed fleet (some hosts resumed,
+                # some fresh) skipping it would skew the allgather
+                # sequence and cross collective payloads downstream.
+                # The result is discarded — this host's state keeps the
+                # shift it was built with, and the cross-host moment
+                # merge rebases differing shifts exactly
+                # (kernels/moments.merge).
+                merge_shift_estimates(
+                    estimate_shift(first_hb)
+                    if first_hb is not None else None)
             last_frag = resume_frag
             pending: List[HostBatch] = []
             if first_hb is not None:
@@ -564,6 +610,12 @@ class TPUStatsBackend:
             # aggregates ride DCN gathers
             res_a = merge_pass_a_states(res_a)
             hostagg = merge_host_aggs(hostagg)
+            if pshard[1] > 1:
+                # one k-way spill resolve for the fleet (rank 0 reads,
+                # everyone adopts) instead of N identical re-reads
+                from tpuprof.runtime.distributed import (
+                    resolve_unique_distributed)
+                resolve_unique_distributed(hostagg.unique)
             sampler = merge_samplers(sampler)
         log_event("pass_a", rows=hostagg.n_rows, devices=runner.n_dev,
                   n_num=plan.n_num, n_hash=plan.n_hash)
@@ -715,6 +767,12 @@ class TPUStatsBackend:
         # artifact whose missing runs degrade honestly on resume
         # (__setstate__ demotes to OVERFLOW), whereas the reverse order
         # would orphan run files no future cleanup sweep owns
+        if pshard[1] > 1 and config.unique_spill_dir:
+            # shared-spill-dir deployments: every host's assemble reads
+            # the SAME run files (resolve's memmaps) — barrier before
+            # any host deletes them, or a fast host could yank a slow
+            # host's files mid-resolve
+            allgather_objects("unique-cleanup-barrier")
         hostagg.unique.cleanup()     # spill runs are working space only
         if resume is not None:
             resume.clear()           # profile assembled: artifact is stale

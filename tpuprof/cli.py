@@ -47,7 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "column)")
     p.add_argument("--checkpoint", metavar="PATH",
                    help="persist the scan every N batches and resume "
-                        "from PATH after a crash")
+                        "from PATH after a crash (multi-host: each host "
+                        "writes its own PATH.h<i>of<N> artifact)")
     p.add_argument("--checkpoint-every", type=int, default=64,
                    metavar="N", help="batches between checkpoints")
     dist = p.add_argument_group(
@@ -90,11 +91,6 @@ def cmd_profile(args: argparse.Namespace) -> int:
                 or args.process_id is None:
             print("tpuprof: error: multi-host needs all three of "
                   "--coordinator, --num-processes and --process-id",
-                  file=sys.stderr)
-            return 2
-        if args.checkpoint:
-            print("tpuprof: error: --checkpoint is single-process only "
-                  "(multi-host profiles restart from the beginning)",
                   file=sys.stderr)
             return 2
         if args.backend == "cpu":

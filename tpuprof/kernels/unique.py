@@ -36,10 +36,14 @@ HLL plane and the top-k store already accept (ingest/arrow.py).
 
 Merge law (multi-host, SURVEY §4.2): DUP anywhere is definitive; else
 OVERFLOW anywhere is OVERFLOW; else the peer's in-memory chunks fold in
-through the same probe path.  A SPILLED column cannot fold across hosts
-(its runs live on the other host's disk), so it demotes to OVERFLOW on
-merge — multi-host exactness is bounded by the in-memory budget;
-single-host exactness is unbounded with a spill dir.
+through the same probe path and the peer's spilled RUNS are adopted by
+path — ``__setstate__`` validated them present on the receiving host
+(uuid filenames + size check), which is exactly the shared-spill-dir
+deployment (NFS/objFS across a pod).  resolve()'s hash-range k-way
+merge then finds cross-host duplicates by the same law as cross-epoch
+ones, so exact UNIQUE/DUP survives multi-host at any n.  A peer whose
+spill disk is NOT visible here arrives already demoted to OVERFLOW (the
+honest bound when runs are unreachable).
 """
 
 from __future__ import annotations
@@ -391,6 +395,37 @@ class UniqueTracker:
                     self._demote(name, OVERFLOW)
                     break
 
+    def disown_runs(self) -> None:
+        """Transfer run-file ownership away from this instance: its GC
+        must no longer reap them.  Called on the ORIGINAL tracker after
+        a cross-host merge, right before the caller rebinds its
+        reference to the merged (unpickled) copy — which takes over via
+        ``claim_runs``."""
+        self._owned = []
+
+    def claim_runs(self) -> None:
+        """Take GC ownership of every run file this tracker references.
+        Called on the MERGED tracker after a cross-host gather: without
+        it no live object would own the fleet's spill files (unpickled
+        copies start with ``_owned=[]``), and an exception between the
+        merge and cleanup() would orphan them all.  Multiple hosts
+        claiming the same shared paths is fine — deletion is
+        idempotent and statuses demote identically everywhere."""
+        self._owned = [p for runs in self._runs.values()
+                       for p, _rows in runs]
+
+    def seed_resolution(self, statuses: Dict[str, str]) -> None:
+        """Adopt another process's resolve() verdicts for still-spilled
+        columns (memo injection, keyed on the current run/row state so a
+        later mutation still invalidates it).  After a deterministic
+        cross-host merge every host holds byte-identical run lists, so
+        rank 0 can pay the k-way read once and peers adopt — N× shared-
+        storage resolve traffic becomes 1× (runtime/distributed.py)."""
+        for name, st in statuses.items():
+            if self.status.get(name) == UNIQUE and self._runs.get(name):
+                key = (tuple(self._runs[name]), self._rows[name])
+                self._resolve_memo[name] = (key, st)
+
     def merge(self, other: "UniqueTracker") -> None:
         for name, ost in other.status.items():
             if name not in self.status:
@@ -399,15 +434,27 @@ class UniqueTracker:
                 self._demote(name, DUP)
             elif OVERFLOW in (self.status[name], ost):
                 self._demote(name, OVERFLOW)
-            elif self._runs.get(name) or other._runs.get(name):
-                # spilled runs live on their host's disk — a cross-host
-                # fold cannot probe them, so the exact claim is bounded
-                # by the in-memory budget in multi-host runs
-                self._demote(name, OVERFLOW)
             else:
                 # a cross-host duplicate is only detectable when both
                 # hosts hashed with the same implementation; otherwise an
                 # exact "no duplicate" claim would be unsound
                 okind = other._kind.get(name, "")
+                mkind = self._kind.get(name, "")
+                if okind and mkind and okind != mkind:
+                    self._demote(name, OVERFLOW)
+                    continue
+                if other._runs.get(name):
+                    # adopt the peer's spilled runs: reaching here means
+                    # __setstate__ validated those files present ON THIS
+                    # HOST (unique uuid filenames + size check), i.e. the
+                    # spill dir is shared storage — a peer whose disk we
+                    # cannot see arrives already demoted to OVERFLOW.
+                    # Runs are internally dup-free; cross-host duplicates
+                    # surface in resolve()'s k-way hash-range merge, the
+                    # same law that resolves cross-epoch duplicates
+                    # within one host (SURVEY §4.2 mergeability).
+                    self._runs[name].extend(other._runs[name])
+                if okind and not mkind:
+                    self._kind[name] = okind
                 for c in other._chunks[name]:
                     self.update(name, c, hash_kind=okind)

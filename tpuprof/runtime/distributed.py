@@ -90,7 +90,30 @@ def merge_host_aggs(hostagg):
     merged = parts[0]
     for other in parts[1:]:
         merged = _merge_pair(merged, other)
+    if len(parts) > 1:
+        # run-file ownership transfers: the caller is about to rebind
+        # its reference to the merged copy, which must reap the fleet's
+        # spill files at GC/cleanup — and the ORIGINAL must not.
+        # Ordered after the merge so a failure mid-merge leaves each
+        # host's original owning (and eventually reaping) its own files.
+        hostagg.unique.disown_runs()
+        merged.unique.claim_runs()
     return merged
+
+
+def resolve_unique_distributed(tracker) -> None:
+    """Decide spilled columns' UNIQUE/DUP verdicts once for the fleet:
+    rank 0 runs the k-way hash-range resolve (kernels/unique.resolve)
+    and every host adopts the result.  After the deterministic
+    cross-host merge all hosts hold byte-identical run lists, so N
+    hosts re-reading the whole shared spill dir for identical answers
+    would be pure wasted bandwidth.  No-op single-process."""
+    import jax
+    if jax.process_count() == 1:
+        return
+    statuses = tracker.resolve() if jax.process_index() == 0 else None
+    parts = allgather_objects(statuses)
+    tracker.seed_resolution(parts[0])
 
 
 def merge_shift_estimates(local_shift):
