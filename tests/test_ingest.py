@@ -280,3 +280,109 @@ def test_low_card_dictionary_content_reuse(monkeypatch):
     v1, _, _ = ia._dictionary_views(cache, "c", d1, False)
     v2, _, _ = ia._dictionary_views(cache, "c", d2, False)
     assert list(v1) == ["x", "y"] and list(v2) == ["x", "z"]
+
+
+class TestPreparePipeline:
+    """Cross-batch prepare pipelining (VERDICT r3 #2): parallel workers
+    must be invisible to every consumer — same batch order, same
+    hashes, same stats, in-order error propagation."""
+
+    def _ds(self, tmp_path, n_frags=3, rows=2000):
+        import pyarrow.parquet as pq
+        rng = np.random.default_rng(5)
+        d = tmp_path / "ds"
+        d.mkdir()
+        for f in range(n_frags):
+            pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+                "x": rng.normal(size=rows),
+                "s": rng.choice(["a", "b", "c", "d"], rows),
+                "u": [f"k{f}_{i:05d}" for i in range(rows)],
+            }), preserve_index=False), str(d / f"p{f}.parquet"))
+        return str(d)
+
+    def _collect_stream(self, src, workers):
+        from tpuprof.ingest.arrow import prefetch_prepared
+        ing = ArrowIngest(src, batch_rows=512)
+        out = []
+        for hb in prefetch_prepared(ing, ing.plan, 512, 11, depth=2,
+                                    workers=workers):
+            out.append((hb.nrows, hb.frag_pos,
+                        hb.x[:hb.nrows].tobytes(),
+                        hb.hll[:hb.nrows].tobytes()))
+        return out
+
+    def test_parallel_stream_identical_to_serial(self, tmp_path):
+        src = self._ds(tmp_path)
+        serial = self._collect_stream(src, workers=1)
+        piped = self._collect_stream(src, workers=4)
+        assert len(serial) == len(piped) and serial == piped
+
+    def test_parallel_profile_matches_serial(self, tmp_path, monkeypatch):
+        """End-to-end: a profile with 4 prepare workers equals the
+        1-worker profile bit-for-bit on every compared stat (sampler
+        determinism rides the delivery order)."""
+        from tpuprof import ProfilerConfig
+        from tpuprof.backends.tpu import TPUStatsBackend
+        src = self._ds(tmp_path)
+        cfg = ProfilerConfig(backend="tpu", batch_rows=512,
+                             topk_capacity=64, unique_track_rows=512,
+                             unique_spill_dir=str(tmp_path / "sp"))
+        monkeypatch.setenv("TPUPROF_PREPARE_WORKERS", "1")
+        a = TPUStatsBackend().collect(src, cfg)
+        monkeypatch.setenv("TPUPROF_PREPARE_WORKERS", "4")
+        b = TPUStatsBackend().collect(src, cfg)
+        for col in ("x", "s", "u"):
+            va, vb = a["variables"][col], b["variables"][col]
+            assert va["type"] == vb["type"], col
+            for k in ("count", "n_missing", "distinct_count", "mean",
+                      "std", "p50", "freq"):
+                if k in va:
+                    x, y = va[k], vb[k]
+                    assert (x == y) or (x != x and y != y), (col, k)
+        assert a["variables"]["u"]["type"] == "UNIQUE"
+
+    def test_prepare_error_propagates_in_order(self, tmp_path,
+                                               monkeypatch):
+        import tpuprof.ingest.arrow as ia
+        from tpuprof.ingest.arrow import prefetch_prepared
+        src = self._ds(tmp_path)
+        ing = ArrowIngest(src, batch_rows=512)
+        import threading
+        real = ia.prepare_batch
+        calls = {"n": 0}
+        lock = threading.Lock()
+
+        def poisoned(*a, **k):
+            with lock:                  # pool threads race the counter
+                calls["n"] += 1
+                poison = calls["n"] == 5
+            if poison:
+                raise ValueError("poisoned batch")
+            return real(*a, **k)
+
+        monkeypatch.setattr(ia, "prepare_batch", poisoned)
+        got = 0
+        with pytest.raises(ValueError, match="poisoned batch"):
+            for _hb in prefetch_prepared(ing, ing.plan, 512, 11,
+                                         workers=4):
+                got += 1
+        assert got == 4          # everything before the poison arrived
+
+    def test_abandoned_consumer_stops_pipeline(self, tmp_path):
+        import threading
+        import time
+        from tpuprof.ingest.arrow import prefetch_prepared
+        src = self._ds(tmp_path, n_frags=4, rows=4000)
+        ing = ArrowIngest(src, batch_rows=256)
+        gen = prefetch_prepared(ing, ing.plan, 256, 11, workers=4)
+        next(gen)
+        gen.close()              # consumer walks away mid-stream
+        # the reader thread must notice cancellation and exit (bounded
+        # by the 0.5 s put timeout); pool threads may idle harmlessly
+        deadline = time.time() + 10
+        while time.time() < deadline and any(
+                t.name == "tpuprof-prep-reader"
+                for t in threading.enumerate()):
+            time.sleep(0.1)
+        assert not any(t.name == "tpuprof-prep-reader"
+                       for t in threading.enumerate())

@@ -215,9 +215,14 @@ class _CollectCheckpoint:
     barrier (runtime/distributed.allgather) so every host agrees on who
     restored before any scanning starts."""
 
+    # batch_enum versions the batch-boundary ENUMERATION (how a source
+    # splits into cursor-counted batches): "window-v2" = fixed-size
+    # combined windows for in-memory tables.  An artifact whose cursors
+    # counted a different enumeration must be rejected, not mis-skipped.
     _META_KEYS = ("n_num", "n_hash", "batch_rows", "hll_precision",
                   "native_hash", "source_fp", "quantile_sketch_size",
-                  "topk_capacity", "seed", "process_id", "process_count")
+                  "topk_capacity", "seed", "process_id", "process_count",
+                  "batch_enum")
 
     def __init__(self, config: ProfilerConfig, plan, runner, pshard,
                  source_fp: str):
@@ -251,7 +256,8 @@ class _CollectCheckpoint:
                 "topk_capacity": self.config.topk_capacity,
                 "seed": self.config.seed,
                 "process_id": self.pshard[0],
-                "process_count": self.pshard[1]}
+                "process_count": self.pshard[1],
+                "batch_enum": "window-v2"}
 
     def save(self, state, sampler, hostagg, host_hll, cursor,
              frag_pos=None) -> None:
@@ -463,7 +469,11 @@ class TPUStatsBackend:
                 # multi-host: one host's unreadable artifact (older
                 # format, torn write) must not exit this process while
                 # its peers block in the resume-barrier collective —
-                # fall back to a fresh stripe scan, loudly
+                # fall back to a fresh stripe scan, loudly.  EVERY
+                # restored accumulator resets: a failure after the
+                # unpack (e.g. a pre-spill-era HostAgg) would otherwise
+                # leave restored sketches under a zeroed cursor and
+                # double-count the prefix
                 from tpuprof.utils.trace import logger
                 logger.warning(
                     "host %d: checkpoint artifact %r failed to load "
@@ -471,6 +481,13 @@ class TPUStatsBackend:
                     pshard[0], resume.path, exc)
                 restored = False
                 state, skip, resume_frag = None, 0, None
+                hostagg = HostAgg(plan, config)
+                sampler = RowSampler(config.quantile_sketch_size,
+                                     plan.n_num, seed=config.seed,
+                                     process_index=pshard[0])
+                host_hll = khll.HostRegisters(
+                    plan.n_hash, config.hll_precision) \
+                    if use_host_hll else None
         if resume is not None and pshard[1] > 1:
             # resume barrier: every host reports (rank, restored?,
             # cursor) before any scanning starts — each host's meta has

@@ -241,8 +241,13 @@ def _dictionary_views(cache: Dict[str, Dict[str, object]], name: str,
                    "dh": None, "kind": ""}
             cache[name] = ent
     if want_hashes and ent["dh"] is None and len(ent["dvals"]):
-        ent["dh"], ent["kind"] = _hash64_dictionary(ent["ref"],
-                                                    ent["dvals"])
+        dh, kind = _hash64_dictionary(ent["ref"], ent["dvals"])
+        # kind BEFORE dh: concurrent prepares (cross-batch pipeline)
+        # gate on dh being non-None — a reader that sees the hashes must
+        # also see which implementation made them, or the uniqueness
+        # tracker could silently mix hash kinds
+        ent["kind"] = kind
+        ent["dh"] = dh
     return ent["dvals"], ent["dh"], ent["kind"]
 
 
@@ -284,7 +289,8 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                   hashes: bool = True,
                   frag_pos: Optional[Tuple[int, int]] = None,
                   dict_cache: Optional[Dict[str, Dict[str, object]]] = None,
-                  col_stats: Optional[Dict[str, int]] = None
+                  col_stats: Optional[Dict[str, int]] = None,
+                  decode_threads: Optional[int] = None
                   ) -> HostBatch:
     """Decode one Arrow record batch into a fixed-shape HostBatch.
 
@@ -293,7 +299,9 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     categorical codes.  ``col_stats`` (owned by the ingest, like
     ``dict_cache``) carries each column's last observed per-batch
     distinct count, steering plain-string columns onto the row-hash
-    path once they prove high-cardinality."""
+    path once they prove high-cardinality.  ``decode_threads`` caps this
+    batch's per-column thread pool (the cross-batch pipeline divides the
+    host's cores between concurrent prepares)."""
     from tpuprof import native
     from tpuprof.kernels import hll as khll
     if dict_cache is None:
@@ -457,7 +465,8 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     # Column decode is embarrassingly parallel (disjoint output columns)
     # and numpy/arrow/ctypes all release the GIL, so on multi-core hosts
     # a thread pool overlaps the work; single-core stays serial.
-    workers = min(_decode_threads(), len(plan.specs))
+    workers = min(decode_threads if decode_threads is not None
+                  else _decode_threads(), len(plan.specs))
     if workers > 1:
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(max_workers=workers) as pool:
@@ -480,12 +489,18 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                       hll_precision: int, depth: int = 2,
                       hashes: bool = True, skip_batches: int = 0,
                       positions: bool = False,
-                      resume_pos: Optional[Tuple[int, int]] = None):
-    """Yield prepared HostBatches with a background thread running
-    ``depth`` batches ahead, so Arrow decode + hashing + buffer layout
-    overlap the device scan instead of serializing with it.  Exceptions
-    from the reader (including the fragment-retry path) re-raise in the
-    consumer.
+                      resume_pos: Optional[Tuple[int, int]] = None,
+                      workers: Optional[int] = None):
+    """Yield prepared HostBatches with decode/hash/pack of DIFFERENT
+    batches pipelined across a small thread pool (``workers``, default
+    ``_prepare_workers()``), so one process can saturate its cores
+    feeding one chip instead of needing one process per core.  The
+    heavy per-batch ops — Arrow decode, native xxh64, factorize — all
+    release the GIL.  Arrival order is the raw-batch order regardless
+    of which prepare finishes first (a bounded queue of futures), so
+    sampler determinism and checkpoint cursors see exactly the serial
+    stream.  Exceptions from the reader (including the fragment-retry
+    path) and from any prepare re-raise in the consumer, in order.
 
     Resume modes (checkpointing — the batch order of a rescannable
     source is deterministic):
@@ -505,7 +520,19 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
       in-memory sources)."""
     import queue
     import threading
+    from concurrent.futures import ThreadPoolExecutor
 
+    w = workers if workers is not None else _prepare_workers()
+    # the queue must hold at least w futures or the pool can never be
+    # full; more than that buffers prepared batches ahead of the scan
+    depth = max(depth, w)
+    # concurrent prepares split the host's cores: each batch's internal
+    # per-column pool gets its share instead of all of them (w batches
+    # times 8 column threads would thrash a smaller host)
+    col_threads = None
+    if w > 1:
+        import os
+        col_threads = max(1, (os.cpu_count() or 1) // w)
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     sentinel = object()
     failure = []
@@ -514,8 +541,8 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
     def _put(item) -> bool:
         # bounded put that notices consumer abandonment: if the consumer
         # stops draining (exception mid-scan, generator GC'd), the
-        # worker must not block on the full queue forever — that would
-        # leak the thread, depth+1 prepared batches, and the reader
+        # reader must not block on the full queue forever — that would
+        # leak the thread, depth+1 in-flight prepares, and the reader
         while not cancelled.is_set():
             try:
                 q.put(item, timeout=0.5)
@@ -524,7 +551,21 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                 continue
         return False
 
-    def worker():
+    pool = ThreadPoolExecutor(max_workers=w,
+                              thread_name_prefix="tpuprof-prep")
+
+    def _prep(rb, frag_pos):
+        return prepare_batch(rb, plan, pad, hll_precision, hashes=hashes,
+                             frag_pos=frag_pos,
+                             dict_cache=ingest._dict_cache,
+                             col_stats=ingest._col_stats,
+                             decode_threads=col_threads)
+
+    def reader():
+        # enumerates raw batches (cheap: zero-copy slices / parquet page
+        # reads) and queues prepare FUTURES in stream order; the pool
+        # runs up to w prepares concurrently while the queue preserves
+        # delivery order
         try:
             if positions and ingest.supports_positions():
                 start_frag, done = resume_pos if resume_pos else (0, 0)
@@ -532,37 +573,47 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                         skip_fragments=start_frag):
                     if fi == start_frag and bi < done:
                         continue
-                    if not _put(prepare_batch(rb, plan, pad,
-                                              hll_precision, hashes=hashes,
-                                              frag_pos=(fi, bi),
-                                              dict_cache=ingest._dict_cache,
-                                              col_stats=ingest._col_stats)):
+                    if not _put(pool.submit(_prep, rb, (fi, bi))):
                         return
             else:
                 for k, rb in enumerate(ingest.raw_batches()):
                     if k < skip_batches:
                         continue
-                    if not _put(prepare_batch(rb, plan, pad, hll_precision,
-                                              hashes=hashes,
-                                              dict_cache=ingest._dict_cache,
-                                              col_stats=ingest._col_stats)):
+                    if not _put(pool.submit(_prep, rb, None)):
                         return
         except BaseException as exc:          # re-raised consumer-side
             failure.append(exc)
         finally:
             _put(sentinel)
 
-    threading.Thread(target=worker, daemon=True).start()
+    threading.Thread(target=reader, daemon=True,
+                     name="tpuprof-prep-reader").start()
     try:
         while True:
             item = q.get()
             if item is sentinel:
                 break
-            yield item
+            yield item.result()     # in-order; re-raises prepare errors
         if failure:
             raise failure[0]
     finally:
         cancelled.set()
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _prepare_workers() -> int:
+    """Cross-batch prepare parallelism.  Each prepare already fans out
+    across columns internally (``_decode_threads``), so the cross-batch
+    tier mainly covers the per-column serial portions and the tail;
+    half the cores capped at 4 saturates hosts up to ~8 cores, and
+    ``TPUPROF_PREPARE_WORKERS`` raises it on bigger ones.  1 on a
+    single-core host — the pipeline then degenerates to exactly the
+    old one-reader behavior."""
+    import os
+    env = os.environ.get("TPUPROF_PREPARE_WORKERS")
+    if env:
+        return max(int(env), 1)
+    return max(1, min(4, (os.cpu_count() or 1) // 2))
 
 
 def _open_path_dataset(path: str) -> pads.Dataset:
@@ -770,9 +821,21 @@ class ArrowIngest:
                     "in-memory table")
             if skip_fragments >= 1:
                 return          # the single pseudo-fragment is complete
-            for bi, rb in enumerate(
-                    self._table.to_batches(max_chunksize=self.batch_rows)):
-                yield 0, bi, rb
+            # fixed-size windows, chunks COMBINED per window: plain
+            # ``to_batches(max_chunksize)`` also splits at column chunk
+            # boundaries, and a pandas-concat'd table can carry its
+            # string columns in thousands of small chunks — every
+            # resulting 10k-row batch then pads to the 64k device batch
+            # (measured 4x whole-profile slowdown).  Slicing is
+            # zero-copy; combine copies only multi-chunk windows, i.e.
+            # exactly the case that needs it.
+            tbl, bi, pos = self._table, 0, 0
+            while pos < tbl.num_rows:
+                window = tbl.slice(pos, self.batch_rows).combine_chunks()
+                for rb in window.to_batches():
+                    yield 0, bi, rb
+                    bi += 1
+                pos += self.batch_rows
             return
         for fi, fragment in enumerate(self._my_fragments()):
             if fi < skip_fragments:

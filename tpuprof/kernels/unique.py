@@ -378,6 +378,7 @@ class UniqueTracker:
         import uuid
         self._spill_token = uuid.uuid4().hex[:12]
         self._spill_seq = 0
+        lost = []
         for name, runs in list(self._runs.items()):
             for path, rows in runs:
                 try:
@@ -393,7 +394,21 @@ class UniqueTracker:
                     # writer references
                     self._runs[name] = []
                     self._demote(name, OVERFLOW)
+                    lost.append(name)
                     break
+        if lost:
+            # say it ONCE per tracker: the scan paid the spill I/O for
+            # these columns, and without this the exactness loss (e.g. a
+            # host-LOCAL spill dir in a multi-host run, whose peers can
+            # never see the files) would be silent until the report's
+            # distinct_approx flag
+            import logging
+            logging.getLogger("tpuprof").warning(
+                "%d spilled column(s) (%s) fell back to the approximate "
+                "distinct estimate: their run files are not readable "
+                "here.  In multi-host runs exact UNIQUE needs "
+                "unique_spill_dir on storage SHARED by all hosts",
+                len(lost), ", ".join(sorted(lost)[:5]))
 
     def disown_runs(self) -> None:
         """Transfer run-file ownership away from this instance: its GC
