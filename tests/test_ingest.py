@@ -347,18 +347,16 @@ class TestPreparePipeline:
         from tpuprof.ingest.arrow import prefetch_prepared
         src = self._ds(tmp_path)
         ing = ArrowIngest(src, batch_rows=512)
-        import threading
         real = ia.prepare_batch
-        calls = {"n": 0}
-        lock = threading.Lock()
 
-        def poisoned(*a, **k):
-            with lock:                  # pool threads race the counter
-                calls["n"] += 1
-                poison = calls["n"] == 5
-            if poison:
+        def poisoned(rb, *a, **k):
+            # poison by batch IDENTITY, not call-entry order (pool
+            # threads race into prepare, so "the 5th entrant" is not
+            # deterministically stream index 4): index 4 is the first
+            # batch of fragment 1 — 2000 rows / 512 = 4 batches/frag
+            if rb.column("u")[0].as_py() == "k1_00000":
                 raise ValueError("poisoned batch")
-            return real(*a, **k)
+            return real(rb, *a, **k)
 
         monkeypatch.setattr(ia, "prepare_batch", poisoned)
         got = 0

@@ -225,8 +225,9 @@ class _CollectCheckpoint:
                   "batch_enum")
 
     def __init__(self, config: ProfilerConfig, plan, runner, pshard,
-                 source_fp: str):
+                 source_fp: str, table_source: bool = False):
         self.pshard = pshard
+        self.table_source = bool(table_source)
         path = config.checkpoint_path
         if pshard[1] > 1:
             path = f"{path}.h{pshard[0]}of{pshard[1]}"
@@ -257,7 +258,11 @@ class _CollectCheckpoint:
                 "seed": self.config.seed,
                 "process_id": self.pshard[0],
                 "process_count": self.pshard[1],
-                "batch_enum": "window-v2"}
+                # scoped to table sources: only THEIR enumeration changed
+                # in v2 (fixed combined windows); file-backed fragment
+                # cursors are unchanged and stamp None, so pre-existing
+                # parquet artifacts keep resuming
+                "batch_enum": "window-v2" if self.table_source else None}
 
     def save(self, state, sampler, hostagg, host_hll, cursor,
              frag_pos=None) -> None:
@@ -448,7 +453,8 @@ class TPUStatsBackend:
         # cursor) every N batches; a crashed profile resumes by skipping
         # the already-folded prefix of the (deterministic) batch stream.
         resume = _CollectCheckpoint(config, plan, runner, pshard,
-                                    ingest.fingerprint()) \
+                                    ingest.fingerprint(),
+                                    table_source=ingest._table is not None) \
             if config.checkpoint_path else None
         skip = 0
         resume_frag = None
@@ -653,6 +659,7 @@ class TPUStatsBackend:
         mad: Optional[np.ndarray] = None
         recounter: Optional[Recounter] = None
         rho_spear: Optional[np.ndarray] = None
+        spear_approx = False
         if config.exact_passes and ingest.rescannable and plan.n_num > 0 \
                 and hostagg.n_rows > 0:
             recounter = Recounter(hostagg)
@@ -757,13 +764,20 @@ class TPUStatsBackend:
             hists, mad = khistogram.finalize(
                 res_b, momf["fmin"], momf["fmax"], momf["n"], config.bins)
         elif config.spearman and hostagg.n_rows > 0 and plan.n_num > 1:
-            # requested but the rank pass cannot run (single-pass mode or
-            # a non-rescannable source) — say so instead of silently
-            # omitting the matrix
+            # the rank pass cannot run (single-pass mode or a
+            # non-rescannable source) — estimate from the K-row merged
+            # uniform sample instead of omitting: rank correlation of a
+            # uniform row sample has ~1/sqrt(K) standard error
+            # (ingest/sample.spearman), and the matrix says so via
+            # .attrs["approx"]
+            spear_approx = True
+            rho_spear = sampler.spearman()
             from tpuprof.utils.trace import logger
-            logger.warning(
-                "spearman=True requires a rescannable source and "
-                "exact_passes=True; the spearman matrix was skipped")
+            logger.info(
+                "spearman: single-pass mode — matrix estimated from the "
+                "%d-row sample (rank error ~%.3f)",
+                min(sampler.values.shape[0], sampler.k),
+                1.0 / np.sqrt(max(sampler.k, 1)))
         if recounter is None and config.exact_passes \
                 and ingest.rescannable and hostagg.n_rows > 0:
             # no numeric columns — only the top-k recount matters.
@@ -779,7 +793,8 @@ class TPUStatsBackend:
         stats = _assemble(plan, config, ingest.sample(config.sample_rows),
                           hostagg, momf, rho_all, quants, sample_vals,
                           sample_kept, hll_est, hists, mad, recounter,
-                          probes, rho_spear=rho_spear)
+                          probes, rho_spear=rho_spear,
+                          spear_approx=spear_approx)
         # spill runs go FIRST: a crash between the two deletes leaves an
         # artifact whose missing runs degrade honestly on resume
         # (__setstate__ demotes to OVERFLOW), whereas the reverse order
@@ -816,7 +831,7 @@ def _sample_mode(values: np.ndarray, kept: np.ndarray) -> float:
 
 def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
               sample_vals, sample_kept, hll_est, hists, mad, recounter,
-              probes, rho_spear=None) -> Dict[str, Any]:
+              probes, rho_spear=None, spear_approx=False) -> Dict[str, Any]:
     n = hostagg.n_rows
     variables: Dict[str, Dict[str, Any]] = {}
     freq: Dict[str, pd.Series] = {}
@@ -963,9 +978,13 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
     messages = schema.derive_messages(variables, config)
     correlations = {"pearson": corr_df}
     if rho_spear is not None and len(lanes) >= 2:
-        correlations["spearman"] = pd.DataFrame(
+        spear_df = pd.DataFrame(
             rho_spear[np.ix_(lanes, lanes)], index=num_names,
             columns=num_names)
+        # sample-estimated matrices say so (single-pass/streaming tier;
+        # ~1/sqrt(K) rank error) — .attrs rides pandas copies
+        spear_df.attrs["approx"] = bool(spear_approx)
+        correlations["spearman"] = spear_df
     return {
         "table": table,
         "variables": variables,

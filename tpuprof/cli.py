@@ -35,7 +35,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--single-pass", action="store_true",
                    help="one scan only (sketch-derived histograms/top-k)")
     p.add_argument("--spearman", action="store_true",
-                   help="also compute Spearman rank correlations")
+                   help="also compute Spearman rank correlations (with "
+                        "--single-pass: estimated from the row sample, "
+                        "~1/sqrt(K) rank error)")
     p.add_argument("--stats-json", metavar="PATH",
                    help="also dump the stats dict as JSON")
     p.add_argument("--trace", metavar="DIR",
@@ -78,11 +80,6 @@ def build_parser() -> argparse.ArgumentParser:
 def cmd_profile(args: argparse.Namespace) -> int:
     from tpuprof import ProfileReport, ProfilerConfig
     from tpuprof.utils.trace import phase_timer, trace_to
-
-    if args.spearman and args.single_pass:
-        print("tpuprof: error: --spearman needs the second scan "
-              "(incompatible with --single-pass)", file=sys.stderr)
-        return 2
 
     multi_host = args.coordinator is not None \
         or args.num_processes is not None or args.process_id is not None

@@ -238,17 +238,20 @@ def _dictionary_views(cache: Dict[str, Dict[str, object]], name: str,
             ent = {"key": key, "ref": dictionary, "content": digest,
                    "dvals": np.asarray(dictionary.to_pandas(),
                                        dtype=object),
-                   "dh": None, "kind": ""}
+                   "hash": None}
             cache[name] = ent
-    if want_hashes and ent["dh"] is None and len(ent["dvals"]):
-        dh, kind = _hash64_dictionary(ent["ref"], ent["dvals"])
-        # kind BEFORE dh: concurrent prepares (cross-batch pipeline)
-        # gate on dh being non-None — a reader that sees the hashes must
-        # also see which implementation made them, or the uniqueness
-        # tracker could silently mix hash kinds
-        ent["kind"] = kind
-        ent["dh"] = dh
-    return ent["dvals"], ent["dh"], ent["kind"]
+    pair = ent["hash"]
+    if want_hashes and pair is None and len(ent["dvals"]):
+        # (dh, kind) publish as ONE tuple write (GIL-atomic): concurrent
+        # prepares (cross-batch pipeline) may both compute, but each
+        # writes an internally-consistent pair and each reader sees one
+        # whole pair — hashes can never carry the wrong implementation
+        # label into the uniqueness tracker
+        pair = _hash64_dictionary(ent["ref"], ent["dvals"])
+        ent["hash"] = pair
+    if pair is None:
+        return ent["dvals"], None, ""
+    return ent["dvals"], pair[0], pair[1]
 
 
 def _dictionary_digest(dictionary, bufs) -> bytes:

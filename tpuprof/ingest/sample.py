@@ -126,6 +126,21 @@ class RowSampler:
                 out[c] = np.quantile(v, probes).astype(np.float32)
         return out
 
+    def spearman(self) -> np.ndarray:
+        """(n_num, n_num) pairwise-complete Spearman rank correlation of
+        the sampled rows.  The sample is a uniform row sample (kept rows
+        carry every lane jointly), so this estimates the full-data
+        matrix with standard error ~1/sqrt(K) (~0.016 at K=4096); exact
+        when the sample holds every row (n <= K).  Average ranks on
+        ties — the same convention as scipy/pandas."""
+        import pandas as pd
+        if self.values.shape[0] < 2:
+            return np.full((self.n_num, self.n_num), np.nan)
+        df = pd.DataFrame(self.values)
+        with np.errstate(invalid="ignore"):
+            rho = df.corr(method="spearman").to_numpy()
+        return rho
+
     def sorted_padded(self) -> Tuple[np.ndarray, np.ndarray]:
         """For the Spearman rank-CDF pass: per-column ascending finite
         sample padded with +inf to k, plus kept counts."""

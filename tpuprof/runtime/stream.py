@@ -210,12 +210,20 @@ class StreamingProfiler:
         sample_vals, sample_kept = self.sampler.columns()
         hll_regs = self.host_hll.regs if self.host_hll is not None \
             else res["hll"]
+        rho_spear = None
+        if self.config.spearman and self.plan.n_num > 1 \
+                and self.hostagg.n_rows > 0:
+            # streaming is single-pass by construction: the Spearman
+            # matrix comes from the K-row sample (~1/sqrt(K) rank
+            # error), flagged via .attrs["approx"]
+            rho_spear = self.sampler.spearman()
         stats = _assemble(
             self.plan, self.config,
             self._sample if self._sample is not None else pd.DataFrame(),
             self.hostagg, momf, kcorr.finalize(res["corr"]),
             self.sampler.quantiles(probes), sample_vals, sample_kept,
-            khll.finalize(hll_regs), None, None, None, probes)
+            khll.finalize(hll_regs), None, None, None, probes,
+            rho_spear=rho_spear, spear_approx=True)
         from tpuprof.schema import VariablesView
         stats["variables"] = VariablesView(stats["variables"])
         return stats
