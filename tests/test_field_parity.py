@@ -9,6 +9,7 @@ consumers then see one contract regardless of which backend ran
 
 import numpy as np
 import pandas as pd
+import pytest
 
 from tpuprof import ProfilerConfig, schema
 from tpuprof.backends.cpu import CPUStatsBackend
@@ -51,3 +52,41 @@ def test_field_sets_match_per_kind_across_backends():
     # the fixture must actually exercise every kind for the pin to mean
     # anything
     assert kinds_seen == set(schema.ALL_KINDS)
+
+
+def test_nullable_extension_dtypes_parity():
+    """Pandas nullable/extension dtypes (Int64, boolean, Float64,
+    string, category) must classify and aggregate identically on both
+    backends — Arrow conversion hands the TPU ingest masked arrays where
+    the oracle sees pandas NA semantics."""
+    rng = np.random.default_rng(1)
+    n = 3000
+    df = pd.DataFrame({
+        "i_null": pd.array(
+            np.where(rng.random(n) < 0.1, None,
+                     rng.integers(0, 100, n)).tolist(), dtype="Int64"),
+        "b_null": pd.array(
+            np.where(rng.random(n) < 0.1, None,
+                     rng.random(n) < 0.5).tolist(), dtype="boolean"),
+        "f_null": pd.array(
+            np.where(rng.random(n) < 0.1, None,
+                     rng.normal(size=n)).tolist(), dtype="Float64"),
+        "s_ext": pd.array(
+            np.where(rng.random(n) < 0.1, None,
+                     rng.choice(["p", "q", "r"], n)).tolist(),
+            dtype="string"),
+        "cat_dtype": pd.Categorical(rng.choice(["u", "v", "w"], n)),
+    })
+    cfg = ProfilerConfig(batch_rows=512)
+    cpu = CPUStatsBackend().collect(df, cfg)
+    tpu = TPUStatsBackend().collect(df, cfg)
+    for col in df.columns:
+        cv, tv = cpu["variables"][col], tpu["variables"][col]
+        assert cv["type"] == tv["type"], (col, cv["type"], tv["type"])
+        assert cv["count"] == tv["count"], col
+        assert cv["n_missing"] == tv["n_missing"], col
+        if "mean" in cv:
+            assert tv["mean"] == pytest.approx(cv["mean"], rel=1e-4), col
+        if cv["type"] in ("CAT", "BOOL"):
+            assert cv["freq"] == tv["freq"], col
+            assert str(cv["top"]) == str(tv["top"]), col

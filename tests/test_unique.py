@@ -488,3 +488,117 @@ class TestCrossHostOwnership:
         monkeypatch.undo()
         t.update("c", np.array([200], dtype=np.uint64))  # dup in run
         assert t.resolve()["c"] == kunique.DUP
+
+
+class TestExactDistinct:
+    """exact_distinct mode (round 4, beyond the sanctioned HLL
+    deviation): duplicates no longer stop tracking — per-epoch dedup'd
+    runs spill and the k-way range merge counts the union exactly."""
+
+    def _tracker(self, tmp_path, budget=400):
+        return kunique.UniqueTracker(
+            ["c"], budget, 1 << 30,
+            spill_dir=str(tmp_path / "spill"), count_exact=True)
+
+    def test_exact_count_with_duplicates_across_epochs(self, tmp_path):
+        rng = np.random.default_rng(7)
+        # 10k draws from a 3k-value domain: heavy duplication within and
+        # across batches and spill epochs
+        vals = rng.integers(0, 3000, 10_000).astype(np.uint64)
+        t = self._tracker(tmp_path)
+        for i in range(0, vals.size, 500):
+            t.update("c", vals[i:i + 500])
+        assert t.status["c"] == kunique.DUP           # claim settled...
+        assert len(t._runs["c"]) >= 2                 # ...spills happened
+        truth = len(np.unique(vals))
+        assert t.distinct_counts()["c"] == truth
+        # resolve() still answers the claim from the same walk
+        assert t.resolve()["c"] == kunique.DUP
+        # streaming continues after a snapshot count
+        more = rng.integers(5000, 5100, 300).astype(np.uint64)
+        t.update("c", more)
+        truth2 = len(np.unique(np.concatenate([vals, more])))
+        assert t.distinct_counts()["c"] == truth2
+        t.cleanup()
+
+    def test_exact_count_all_unique_in_memory(self, tmp_path):
+        t = self._tracker(tmp_path, budget=1 << 20)   # never spills
+        t.update("c", np.arange(500, dtype=np.uint64))
+        t.update("c", np.arange(500, 1000, dtype=np.uint64))
+        assert t.status["c"] == kunique.UNIQUE
+        assert t.distinct_counts()["c"] == 1000       # live rows ARE it
+
+    def test_merge_counting_trackers(self, tmp_path):
+        rng = np.random.default_rng(8)
+        a_vals = rng.integers(0, 2000, 3000).astype(np.uint64)
+        b_vals = rng.integers(1000, 4000, 3000).astype(np.uint64)
+        a = self._tracker(tmp_path)
+        b = self._tracker(tmp_path)
+        for i in range(0, 3000, 500):
+            a.update("c", a_vals[i:i + 500])
+            b.update("c", b_vals[i:i + 500])
+        a.merge(b)
+        truth = len(np.unique(np.concatenate([a_vals, b_vals])))
+        assert a.distinct_counts()["c"] == truth
+        assert a.status["c"] == kunique.DUP
+
+    def test_counting_off_without_spill_dir(self):
+        t = kunique.UniqueTracker(["c"], 400, 1 << 30, count_exact=True)
+        t.update("c", np.array([1, 1], dtype=np.uint64))
+        assert t.status["c"] == kunique.DUP
+        assert t.distinct_counts() == {}              # no storage tier
+
+    def test_backend_exact_distinct_end_to_end(self, tmp_path):
+        from tpuprof import ProfilerConfig
+        from tpuprof.backends.tpu import TPUStatsBackend
+        rng = np.random.default_rng(9)
+        n = 8000
+        dup_col = [f"v{i:05d}" for i in rng.integers(0, 3000, n)]
+        uniq_col = [f"id{i:06d}" for i in range(n)]
+        df = pd.DataFrame({"d": dup_col, "u": uniq_col})
+        cfg = ProfilerConfig(backend="tpu", batch_rows=512,
+                             topk_capacity=64,       # MG overflows
+                             unique_track_rows=600,  # spills happen
+                             unique_spill_dir=str(tmp_path / "sp"),
+                             exact_distinct=True)
+        stats = TPUStatsBackend().collect(df, cfg)
+        vd, vu = stats["variables"]["d"], stats["variables"]["u"]
+        truth = len(set(dup_col))
+        assert vd["distinct_count"] == truth, \
+            (vd["distinct_count"], truth)
+        assert vd["distinct_approx"] is False
+        assert vd["type"] == schema.CAT
+        assert vu["type"] == schema.UNIQUE and vu["distinct_count"] == n
+        # no approximation warning for either column
+        assert not [m for m in stats["messages"]
+                    if m.kind == schema.MSG_APPROX_DISTINCT]
+        assert not list((tmp_path / "sp").glob("*.u64"))  # reclaimed
+
+    def test_config_requires_spill_dir(self):
+        from tpuprof import ProfilerConfig
+        with pytest.raises(ValueError, match="unique_spill_dir"):
+            ProfilerConfig(exact_distinct=True)
+
+    def test_storage_abort_preserves_settled_dup(self, tmp_path):
+        """A settled DUP verdict survives counting-storage aborts (spill
+        failure, hashless batch, kind clash): opting into exact counts
+        must never downgrade an exact claim to OVERFLOW (review r4)."""
+        t = self._tracker(tmp_path)
+        t.update("c", np.array([5, 5], dtype=np.uint64))
+        assert t.status["c"] == kunique.DUP and t._counting["c"]
+        t.deactivate("c")                      # e.g. a hashless batch
+        assert t.status["c"] == kunique.DUP    # claim kept
+        assert not t._counting["c"]
+        assert t.distinct_counts() == {}       # count honestly dropped
+        # kind clash path
+        t2 = self._tracker(tmp_path)
+        t2.update("c", np.array([5, 5], dtype=np.uint64),
+                  hash_kind="native")
+        t2.update("c", np.array([9], dtype=np.uint64),
+                  hash_kind="pandas")
+        assert t2.status["c"] == kunique.DUP
+        # a UNIQUE-status column still demotes to OVERFLOW as before
+        t3 = self._tracker(tmp_path)
+        t3.update("c", np.arange(10, dtype=np.uint64))
+        t3.deactivate("c")
+        assert t3.status["c"] == kunique.OVERFLOW

@@ -81,7 +81,8 @@ class HostAgg:
         self.unique = UniqueTracker(
             (s.name for s in plan.by_role("cat")),
             config.unique_track_rows, config.unique_track_total_rows,
-            spill_dir=config.unique_spill_dir)
+            spill_dir=config.unique_spill_dir,
+            count_exact=config.exact_distinct)
         self.cat_null: Dict[str, int] = {s.name: 0 for s in plan.by_role("cat")}
         self.date_min: Dict[str, int] = {}
         self.date_max: Dict[str, int] = {}
@@ -578,7 +579,8 @@ class TPUStatsBackend:
                 ingest, plan, pad, config.hll_precision,
                 depth=max(2, min(scan_s, 8)),
                 skip_batches=0 if use_positions else skip,
-                positions=use_positions, resume_pos=resume_pos)
+                positions=use_positions, resume_pos=resume_pos,
+                workers=config.prepare_workers)
             first_hb = next(batches, None)
             if state is None:
                 shift = merge_shift_estimates(
@@ -750,7 +752,8 @@ class TPUStatsBackend:
                 for hb in prefetch_prepared(ingest, plan, pad,
                                             config.hll_precision,
                                             depth=max(2, min(scan_s, 8)),
-                                            hashes=False):
+                                            hashes=False,
+                                            workers=config.prepare_workers):
                     recounter.update(hb)
                     pending_b.append(hb)
                     if len(pending_b) >= scan_s:
@@ -785,7 +788,8 @@ class TPUStatsBackend:
             # the host hash + HLL-packing loop is skipped on this scan.
             recounter = Recounter(hostagg)
             for hb in prefetch_prepared(ingest, plan, pad,
-                                        config.hll_precision, hashes=False):
+                                        config.hll_precision, hashes=False,
+                                        workers=config.prepare_workers):
                 recounter.update(hb)
             # each host recounts only its own fragment stripe
             recounter.counts = merge_recount_arrays(recounter.counts)
@@ -838,8 +842,10 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
 
     # ---- first sweep: per-column counts/distincts + provisional kinds ----
     # spilled unique-tracker columns are decided here (exact cross-epoch
-    # duplicate resolution over the disk runs — kernels/unique.resolve)
+    # duplicate resolution over the disk runs — kernels/unique.resolve);
+    # exact_distinct columns additionally carry their exact counts
     unique_status = hostagg.unique.resolve()
+    unique_counts = hostagg.unique.distinct_counts()
     kinds: Dict[str, str] = {}
     commons: Dict[str, Dict[str, Any]] = {}
     for spec in plan.specs:
@@ -869,6 +875,10 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
             exact_distinct = mg.distinct_count()
             if exact_distinct is not None:
                 distinct = exact_distinct
+            elif spec.name in unique_counts:
+                # exact_distinct mode: the spill-run union count is the
+                # reference's countDistinct answer, exact at any n
+                distinct = min(unique_counts[spec.name], count)
             else:
                 # MG overflowed — but the duplicate tracker keeps the
                 # reference's exact `distinct == count -> UNIQUE` rule

@@ -29,6 +29,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scan-batches", type=int, default=8, metavar="S",
                    help="prepared batches staged per device dispatch "
                         "(multi-batch scan; 1 disables staging)")
+    p.add_argument("--prepare-workers", type=int, default=None,
+                   metavar="W",
+                   help="host-prep pipeline width: decode/hash/pack of W "
+                        "batches in parallel (default: half the cores, "
+                        "capped at 4)")
     p.add_argument("--sketch-size", type=int, default=4096,
                    help="quantile sample-sketch size K")
     p.add_argument("--hll-precision", type=int, default=11)
@@ -47,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "classification never falls back to an estimate "
                         "(disk cost: 8 bytes/row per high-cardinality "
                         "column)")
+    p.add_argument("--exact-distinct", action="store_true",
+                   help="count distincts exactly for every column at any "
+                        "size (needs --unique-spill-dir; 8 bytes per "
+                        "distinct value per column of disk)")
     p.add_argument("--checkpoint", metavar="PATH",
                    help="persist the scan every N batches and resume "
                         "from PATH after a crash (multi-host: each host "
@@ -80,6 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
 def cmd_profile(args: argparse.Namespace) -> int:
     from tpuprof import ProfileReport, ProfilerConfig
     from tpuprof.utils.trace import phase_timer, trace_to
+
+    if args.exact_distinct and not args.unique_spill_dir:
+        print("tpuprof: error: --exact-distinct requires "
+              "--unique-spill-dir (exact counting must be able to "
+              "spill past the RAM budget)", file=sys.stderr)
+        return 2
 
     multi_host = args.coordinator is not None \
         or args.num_processes is not None or args.process_id is not None
@@ -124,9 +139,11 @@ def cmd_profile(args: argparse.Namespace) -> int:
     config = ProfilerConfig(
         backend=args.backend, bins=args.bins, corr_reject=args.corr_reject,
         batch_rows=args.batch_rows, scan_batches=args.scan_batches,
+        prepare_workers=args.prepare_workers,
         quantile_sketch_size=args.sketch_size,
         hll_precision=args.hll_precision, exact_passes=not args.single_pass,
         spearman=args.spearman, unique_spill_dir=args.unique_spill_dir,
+        exact_distinct=args.exact_distinct,
         checkpoint_path=args.checkpoint,
         checkpoint_every_batches=args.checkpoint_every,
         compile_cache_dir=cache_dir)

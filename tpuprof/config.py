@@ -70,6 +70,17 @@ class ProfilerConfig:
                                             # None keeps the bounded
                                             # in-memory tier with the
                                             # HLL-estimate fallback
+    exact_distinct: bool = False    # count distincts EXACTLY for every
+                                    # tracked CAT column at any n (the
+                                    # reference's countDistinct semantics,
+                                    # beyond the sanctioned HLL deviation):
+                                    # per-epoch dedup'd hash runs spill to
+                                    # unique_spill_dir (REQUIRED; 8 B/
+                                    # distinct/column) and the k-way range
+                                    # merge counts the union at finalize.
+                                    # Exact up to 64-bit hash collisions
+                                    # (~n²/2⁶⁵), the same contract as the
+                                    # UNIQUE/DUP claims.
     exact_passes: bool = True       # second scan: exact histograms + exact
                                     # recount of top-k candidates (parity with
                                     # Spark's exact groupBy().count()).
@@ -100,8 +111,19 @@ class ProfilerConfig:
                                             # persist the pass-A scan here
                                             # every checkpoint_every_batches
                                             # and resume from it on restart
-                                            # (single-process; SURVEY §5)
+                                            # (multi-host: per-host
+                                            # artifacts path.h<i>of<N>;
+                                            # SURVEY §5)
     checkpoint_every_batches: int = 64
+    prepare_workers: Optional[int] = None   # cross-batch host-prep
+                                            # pipeline width (decode/hash/
+                                            # pack of DIFFERENT batches in
+                                            # parallel, delivery order
+                                            # preserved).  None = auto:
+                                            # TPUPROF_PREPARE_WORKERS env,
+                                            # else half the cores capped
+                                            # at 4 (1 on a 1-core host =
+                                            # the serial path exactly)
     seed: int = 0                   # PRNG seed for the sample sketch
     use_pallas: Optional[bool] = None   # None = auto (on for real TPU):
                                         # dense pallas histogram kernel vs
@@ -136,6 +158,13 @@ class ProfilerConfig:
             raise ValueError("scan_batches must be >= 1")
         if self.stream_flush_rows is not None and self.stream_flush_rows < 1:
             raise ValueError("stream_flush_rows must be >= 1 (or None)")
+        if self.prepare_workers is not None and self.prepare_workers < 1:
+            raise ValueError("prepare_workers must be >= 1 (or None)")
+        if self.exact_distinct and not self.unique_spill_dir:
+            raise ValueError(
+                "exact_distinct needs unique_spill_dir: exact counting "
+                "stores 8 bytes per distinct value per column, which "
+                "must be able to spill past the RAM budget")
         if not 0.0 < self.corr_reject <= 1.0:
             raise ValueError("corr_reject must be in (0, 1]")
         if not 2 <= self.spearman_grid <= 4096:

@@ -70,11 +70,24 @@ ORPHAN_SWEEP_AGE_S = 24 * 3600
 
 
 class UniqueTracker:
-    """Tracks, per column, whether any value hash occurred twice."""
+    """Tracks, per column, whether any value hash occurred twice — and,
+    in ``count_exact`` mode, the EXACT distinct count at any n.
+
+    Counting mode (config.exact_distinct; needs a spill dir): instead of
+    demoting a column on its first duplicate, the tracker keeps folding
+    — every batch is deduplicated against the live chunks (so in-memory
+    storage is per-epoch-distinct, not per-row), epochs spill to sorted
+    runs as usual, and ``distinct_counts()`` k-way-merges runs + chunks
+    by hash range to count the union exactly.  This exceeds the
+    sanctioned HLL deviation (SURVEY §7.2): the reference's
+    ``countDistinct`` exactness is restored for every tracked column,
+    up to 64-bit hash collisions (~n²/2⁶⁵ — the same collision contract
+    the UNIQUE/DUP claims already carry)."""
 
     def __init__(self, names: Iterable[str], budget_rows: int,
                  total_budget_rows: int,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 count_exact: bool = False):
         self.budget = int(budget_rows)
         self.total_budget = int(total_budget_rows)
         self.spill_dir = spill_dir
@@ -105,24 +118,46 @@ class UniqueTracker:
         # must leave them on disk for resume, so GC cleanup is disabled
         # and only explicit cleanup() (post-assembly) deletes them
         self.persistent = False
-        self._resolve_memo: Dict[str, Tuple[Tuple, str]] = {}
+        # memo: name -> (state_key, status, count_or_None)
+        self._resolve_memo: Dict[str, Tuple] = {}
         disabled = self.budget <= 0 or self.total_budget <= 0
+        # per-column: still counting exact distincts (requires storage,
+        # so it needs a spill dir to survive the budget)
+        counting = bool(count_exact) and spill_dir is not None \
+            and not disabled
+        self._counting: Dict[str, bool] = {}
         for n in names:
             self.status[n] = OVERFLOW if disabled else UNIQUE
             self._chunks[n] = []
             self._rows[n] = 0
             self._kind[n] = ""
             self._runs[n] = []
+            self._counting[n] = counting
 
     def active(self, name: str) -> bool:
-        return self.status.get(name) == UNIQUE
+        """True while this column's hashes must keep flowing in: either
+        the exact no-duplicate claim is still open, or counting mode is
+        still accumulating the exact distinct count."""
+        return self.status.get(name) == UNIQUE \
+            or self._counting.get(name, False)
 
     def deactivate(self, name: str, status: str = OVERFLOW) -> None:
         """Give up exact tracking for a column (e.g. a batch arrived
-        without hashes, so coverage can no longer be guaranteed)."""
+        without hashes, so coverage can no longer be guaranteed) —
+        counting stops too: a gap in coverage invalidates the count."""
         self._demote(name, status)
 
     def _demote(self, name: str, status: str) -> None:
+        """Stop tracking a column and free its storage.  Counting always
+        stops here (every demote path loses count coverage), and a
+        SETTLED DUP verdict survives a storage abort: demoting a
+        DUP-status counting column to OVERFLOW (spill failure, hashless
+        batch, kind clash, lost runs) would discard an exact-and-final
+        claim the non-counting mode preserves — opting into MORE
+        exactness must never report less."""
+        self._counting[name] = False
+        if status == OVERFLOW and self.status.get(name) == DUP:
+            status = DUP
         self._live -= self._rows[name]
         self._rows[name] = 0
         self._chunks[name] = []
@@ -196,7 +231,8 @@ class UniqueTracker:
         ("native" | "pandas"); the same value hashes DIFFERENTLY under
         the two, so a column whose stream switches implementations can
         no longer be compared exactly and demotes to OVERFLOW."""
-        if self.status.get(name) != UNIQUE:
+        counting = self._counting.get(name, False)
+        if self.status.get(name) != UNIQUE and not counting:
             return
         h = np.asarray(hashes, dtype=np.uint64)
         if not h.size:
@@ -207,15 +243,34 @@ class UniqueTracker:
                 return
             self._kind[name] = hash_kind
         sh = np.sort(h)
-        if sh.size > 1 and (sh[1:] == sh[:-1]).any():
-            self._demote(name, DUP)
-            return
+        # within-batch dedup (counting stores per-epoch DISTINCT values,
+        # so memory tracks cardinality, not row count)
+        dup = False
+        if sh.size > 1:
+            keep = np.empty(sh.size, dtype=bool)
+            keep[0] = True
+            np.not_equal(sh[1:], sh[:-1], out=keep[1:])
+            if not keep.all():
+                dup = True
+                sh = sh[keep]
+        # probe the live chunks: detects duplicates for the UNIQUE claim
+        # and discards already-stored values (keeps chunks mutually
+        # dup-free, so the live rows count IS the epoch's distinct count)
         for c in self._chunks[name]:
             pos = np.searchsorted(c, sh)
             inb = pos < c.size
-            if inb.any() and (c[pos[inb]] == sh[inb]).any():
+            hit = np.zeros(sh.size, dtype=bool)
+            hit[inb] = c[pos[inb]] == sh[inb]
+            if hit.any():
+                dup = True
+                sh = sh[~hit]
+        if dup:
+            if not counting:
                 self._demote(name, DUP)
                 return
+            self.status[name] = DUP     # claim settled; count continues
+        if not sh.size:
+            return
         self._chunks[name].append(sh)
         self._rows[name] += sh.size
         self._live += sh.size
@@ -251,16 +306,42 @@ class UniqueTracker:
         out = {}
         for name, st in self.status.items():
             if st == UNIQUE and self._runs.get(name):
-                out[name] = self._resolve_spilled(name)
+                # counting columns want the count anyway — one disk walk
+                # serves both (the early DUP break would otherwise force
+                # distinct_counts() to re-read every run)
+                out[name] = self._resolve_spilled(
+                    name, count=self._counting.get(name, False))[0]
             else:
                 out[name] = st
         return out
 
-    def _resolve_spilled(self, name: str) -> str:
+    def distinct_counts(self) -> Dict[str, int]:
+        """EXACT distinct counts for columns still in counting mode
+        (count_exact), at any n.  Live chunks are mutually dup-free (the
+        update probe discards already-stored values), so a column with
+        no spilled runs counts as its live row total; spilled columns
+        count the union via the same hash-range k-way merge resolve()
+        uses.  Non-destructive and memoized alongside the status."""
+        out: Dict[str, int] = {}
+        for name, counting in self._counting.items():
+            if not counting or self.status.get(name) == OVERFLOW:
+                continue
+            if not self._runs.get(name):
+                out[name] = self._rows[name]
+            else:
+                _st, count = self._resolve_spilled(name, count=True)
+                if count is not None:
+                    out[name] = count
+        return out
+
+    def _resolve_spilled(self, name: str, count: bool = False
+                         ) -> Tuple[str, Optional[int]]:
         key = (tuple(self._runs[name]), self._rows[name])
         memo = self._resolve_memo.get(name)
-        if memo is not None and memo[0] == key:
-            return memo[1]
+        if memo is not None and memo[0] == key \
+                and not (count and memo[2] is None
+                         and memo[1] != OVERFLOW):
+            return memo[1], memo[2]
         arrays: List[np.ndarray] = []
         for path, rows in self._runs[name]:
             try:
@@ -269,14 +350,16 @@ class UniqueTracker:
             except (OSError, ValueError):
                 # a run vanished (tmp cleaner, resume on another box):
                 # the exact claim is gone — honest fallback
-                self._resolve_memo[name] = (key, OVERFLOW)
-                return OVERFLOW
+                self._counting[name] = False
+                self._resolve_memo[name] = (key, OVERFLOW, None)
+                return OVERFLOW, None
         if self._chunks[name]:
             arrays.append(np.sort(np.concatenate(self._chunks[name])))
         total = sum(a.size for a in arrays)
         n_slices = max(1, -(-total // RESOLVE_SLICE_ROWS))
         step = (1 << 64) // n_slices
         status = UNIQUE
+        distinct = 0
         for k in range(n_slices):
             lo = np.uint64(k * step)
             hi = np.uint64((k + 1) * step - 1) if k + 1 < n_slices \
@@ -288,13 +371,23 @@ class UniqueTracker:
                 if j > i:
                     parts.append(np.asarray(a[i:j]))
             if len(parts) < 2:
+                distinct += parts[0].size if parts else 0
                 continue            # one source can't cross-duplicate
             s = np.sort(np.concatenate(parts))
-            if (s[1:] == s[:-1]).any():
+            if s.size > 1:
+                news = int((s[1:] != s[:-1]).sum()) + 1
+            else:
+                news = s.size
+            if news != s.size:
                 status = DUP
-                break
-        self._resolve_memo[name] = (key, status)
-        return status
+                if not count:
+                    break           # claim settled; count not wanted
+            distinct += news
+        self._resolve_memo[name] = (
+            key, status, distinct if count or status == UNIQUE else None)
+        # a clean full walk also yields the count for free when every
+        # slice completed (status UNIQUE => no early break happened)
+        return status, self._resolve_memo[name][2]
 
     def cleanup(self) -> None:
         """Delete every spill run (idempotent; call once the profile is
@@ -378,6 +471,8 @@ class UniqueTracker:
         import uuid
         self._spill_token = uuid.uuid4().hex[:12]
         self._spill_seq = 0
+        if not hasattr(self, "_counting"):      # pre-counting artifacts
+            self._counting = {n: False for n in self.status}
         lost = []
         for name, runs in list(self._runs.items()):
             for path, rows in runs:
@@ -429,35 +524,56 @@ class UniqueTracker:
         self._owned = [p for runs in self._runs.values()
                        for p, _rows in runs]
 
-    def seed_resolution(self, statuses: Dict[str, str]) -> None:
-        """Adopt another process's resolve() verdicts for still-spilled
-        columns (memo injection, keyed on the current run/row state so a
-        later mutation still invalidates it).  After a deterministic
-        cross-host merge every host holds byte-identical run lists, so
-        rank 0 can pay the k-way read once and peers adopt — N× shared-
-        storage resolve traffic becomes 1× (runtime/distributed.py)."""
+    def seed_resolution(self, statuses: Dict[str, str],
+                        counts: Optional[Dict[str, int]] = None) -> None:
+        """Adopt another process's resolve() verdicts (and exact
+        distinct counts) for still-spilled columns (memo injection,
+        keyed on the current run/row state so a later mutation still
+        invalidates it).  After a deterministic cross-host merge every
+        host holds byte-identical run lists, so rank 0 can pay the
+        k-way read once and peers adopt — N× shared-storage resolve
+        traffic becomes 1× (runtime/distributed.py)."""
+        counts = counts or {}
         for name, st in statuses.items():
-            if self.status.get(name) == UNIQUE and self._runs.get(name):
+            if self._runs.get(name) and (
+                    self.status.get(name) == UNIQUE
+                    or self._counting.get(name)):
                 key = (tuple(self._runs[name]), self._rows[name])
-                self._resolve_memo[name] = (key, st)
+                self._resolve_memo[name] = (key, st, counts.get(name))
 
     def merge(self, other: "UniqueTracker") -> None:
         for name, ost in other.status.items():
             if name not in self.status:
                 continue
+            okind = other._kind.get(name, "")
+            mkind = self._kind.get(name, "")
+            kind_clash = bool(okind and mkind and okind != mkind)
+            counting = self._counting.get(name, False) \
+                and other._counting.get(name, False)
+            if not counting:
+                self._counting[name] = False
+            if counting and not kind_clash \
+                    and OVERFLOW not in (self.status[name], ost):
+                # counting survives a DUP on either side: adopt the
+                # peer's runs + fold its chunks, and let resolve() count
+                # the union exactly (same laws as the UNIQUE claim)
+                if other._runs.get(name):
+                    self._runs[name].extend(other._runs[name])
+                if okind and not mkind:
+                    self._kind[name] = okind
+                if DUP in (self.status[name], ost):
+                    self.status[name] = DUP
+                for c in other._chunks[name]:
+                    self.update(name, c, hash_kind=okind)
+                continue
             if DUP in (self.status[name], ost):
                 self._demote(name, DUP)
-            elif OVERFLOW in (self.status[name], ost):
+            elif OVERFLOW in (self.status[name], ost) or kind_clash:
                 self._demote(name, OVERFLOW)
             else:
                 # a cross-host duplicate is only detectable when both
                 # hosts hashed with the same implementation; otherwise an
                 # exact "no duplicate" claim would be unsound
-                okind = other._kind.get(name, "")
-                mkind = self._kind.get(name, "")
-                if okind and mkind and okind != mkind:
-                    self._demote(name, OVERFLOW)
-                    continue
                 if other._runs.get(name):
                     # adopt the peer's spilled runs: reaching here means
                     # __setstate__ validated those files present ON THIS

@@ -111,9 +111,10 @@ def resolve_unique_distributed(tracker) -> None:
     import jax
     if jax.process_count() == 1:
         return
-    statuses = tracker.resolve() if jax.process_index() == 0 else None
-    parts = allgather_objects(statuses)
-    tracker.seed_resolution(parts[0])
+    payload = (tracker.resolve(), tracker.distinct_counts()) \
+        if jax.process_index() == 0 else None
+    parts = allgather_objects(payload)
+    tracker.seed_resolution(parts[0][0], parts[0][1])
 
 
 def merge_shift_estimates(local_shift):
