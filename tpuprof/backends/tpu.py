@@ -78,11 +78,23 @@ class HostAgg:
             for s in plan.by_role("cat")}
         # exact "duplicate seen" flags: restores the reference's exact
         # UNIQUE classification for columns whose MG summary overflows
+        # exact_distinct extends the tracker to EVERY column: num/date
+        # lanes feed their full 64-bit hash streams (HostBatch.num_hashes)
+        # so the reference's countDistinct exactness holds with no HLL
+        # estimate anywhere, not just for string/categorical columns
         self.unique = UniqueTracker(
-            (s.name for s in plan.by_role("cat")),
+            (s.name for s in (plan.specs if config.exact_distinct
+                              else plan.by_role("cat"))),
             config.unique_track_rows, config.unique_track_total_rows,
             spill_dir=config.unique_spill_dir,
             count_exact=config.exact_distinct)
+        # num/date columns whose exact counting expects full hashes on
+        # every batch (coverage gap => honest deactivation)
+        self._numdate_tracked = [s.name for s in plan.specs
+                                 if s.role != "cat"] \
+            if config.exact_distinct else []
+        from tpuprof import native
+        self._numkind = "native" if native.available() else "pandas"
         self.cat_null: Dict[str, int] = {s.name: 0 for s in plan.by_role("cat")}
         self.date_min: Dict[str, int] = {}
         self.date_max: Dict[str, int] = {}
@@ -149,6 +161,21 @@ class HostAgg:
                 lo, hi = int(ints[valid].min()), int(ints[valid].max())
                 self.date_min[name] = min(self.date_min.get(name, lo), lo)
                 self.date_max[name] = max(self.date_max.get(name, hi), hi)
+        if self._numdate_tracked:
+            nh = hb.num_hashes or {}
+            for name in self._numdate_tracked:
+                if not self.unique.active(name):
+                    continue
+                pair = nh.get(name)
+                if pair is None:
+                    # batch prepared without full hashes: coverage
+                    # broken, the exact count is no longer sound
+                    self.unique.deactivate(name)
+                    continue
+                h, valid = pair
+                h, valid = h[: hb.nrows], valid[: hb.nrows]
+                self.unique.update(name, h[valid],
+                                   hash_kind=self._numkind)
 
     def memorysize(self, name: str) -> float:
         """Arrow buffer bytes for one column (NaN if never observed)."""
@@ -223,7 +250,7 @@ class _CollectCheckpoint:
     _META_KEYS = ("n_num", "n_hash", "batch_rows", "hll_precision",
                   "native_hash", "source_fp", "quantile_sketch_size",
                   "topk_capacity", "seed", "process_id", "process_count",
-                  "batch_enum")
+                  "batch_enum", "exact_distinct")
 
     def __init__(self, config: ProfilerConfig, plan, runner, pshard,
                  source_fp: str, table_source: bool = False):
@@ -263,7 +290,11 @@ class _CollectCheckpoint:
                 # in v2 (fixed combined windows); file-backed fragment
                 # cursors are unchanged and stamp None, so pre-existing
                 # parquet artifacts keep resuming
-                "batch_enum": "window-v2" if self.table_source else None}
+                "batch_enum": "window-v2" if self.table_source else None,
+                # the tracker's column set and hash coverage differ by
+                # mode — resuming across a flip would silently drop or
+                # hollow the exact counts
+                "exact_distinct": self.config.exact_distinct}
 
     def save(self, state, sampler, hostagg, host_hll, cursor,
              frag_pos=None) -> None:
@@ -580,7 +611,8 @@ class TPUStatsBackend:
                 depth=max(2, min(scan_s, 8)),
                 skip_batches=0 if use_positions else skip,
                 positions=use_positions, resume_pos=resume_pos,
-                workers=config.prepare_workers)
+                workers=config.prepare_workers,
+                full_hashes=config.exact_distinct)
             first_hb = next(batches, None)
             if state is None:
                 shift = merge_shift_estimates(
@@ -858,6 +890,9 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
                 distinct = 1
             elif spec.base_kind == schema.BOOL:
                 distinct = 2 if count else 0
+            elif spec.name in unique_counts:
+                # exact_distinct: the full-hash stream counted exactly
+                distinct = min(unique_counts[spec.name], count)
             else:
                 distinct = int(round(hll_est[spec.hash_lane]))
                 distinct = max(min(distinct, count), 1 if count else 0)
@@ -865,9 +900,12 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
         elif spec.role == "date":
             n_missing = hostagg.date_null[spec.name]
             count = n - n_missing
-            distinct = int(round(hll_est[spec.hash_lane]))
-            distinct = max(min(distinct, count), 1 if count else 0)
-            distinct_approx = count > 0
+            if spec.name in unique_counts:
+                distinct = min(unique_counts[spec.name], count)
+            else:
+                distinct = int(round(hll_est[spec.hash_lane]))
+                distinct = max(min(distinct, count), 1 if count else 0)
+                distinct_approx = count > 0
         else:
             n_missing = hostagg.cat_null[spec.name]
             count = n - n_missing

@@ -52,6 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "classification never falls back to an estimate "
                         "(disk cost: 8 bytes/row per high-cardinality "
                         "column)")
+    p.add_argument("--unique-track-rows", type=int, default=None,
+                   metavar="N",
+                   help="per-column RAM budget (rows) for exact "
+                        "UNIQUE/distinct tracking before spilling "
+                        "(default: 4M rows = ~32 MB/column)")
     p.add_argument("--exact-distinct", action="store_true",
                    help="count distincts exactly for every column at any "
                         "size (needs --unique-spill-dir; 8 bytes per "
@@ -144,6 +149,8 @@ def cmd_profile(args: argparse.Namespace) -> int:
         hll_precision=args.hll_precision, exact_passes=not args.single_pass,
         spearman=args.spearman, unique_spill_dir=args.unique_spill_dir,
         exact_distinct=args.exact_distinct,
+        **({"unique_track_rows": args.unique_track_rows}
+           if args.unique_track_rows is not None else {}),
         checkpoint_path=args.checkpoint,
         checkpoint_every_batches=args.checkpoint_every,
         compile_cache_dir=cache_dir)

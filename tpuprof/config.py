@@ -165,6 +165,12 @@ class ProfilerConfig:
                 "exact_distinct needs unique_spill_dir: exact counting "
                 "stores 8 bytes per distinct value per column, which "
                 "must be able to spill past the RAM budget")
+        if self.exact_distinct and (self.unique_track_rows <= 0
+                                    or self.unique_track_total_rows <= 0):
+            raise ValueError(
+                "exact_distinct conflicts with a disabled tracking "
+                "budget (unique_track_rows/unique_track_total_rows "
+                "<= 0): exact counting needs the in-memory tier")
         if not 0.0 < self.corr_reject <= 1.0:
             raise ValueError("corr_reject must be in (0, 1]")
         if not 2 <= self.spearman_grid <= 4096:

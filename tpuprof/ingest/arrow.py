@@ -121,6 +121,12 @@ class HostBatch:
     # (Misra-Gries survivors, first report rows).  Columns prepared this
     # way have NO cat_codes entry for the batch.
     cat_hashed: Optional[Dict[str, Tuple]] = None
+    # full 64-bit hashes of numeric/date lanes, name -> (hashes u64,
+    # valid bool), produced only when the batch was prepared with
+    # full_hashes=True (config.exact_distinct): the HLL plane packs
+    # hashes down to 16 bits, so exact distinct counting of num/date
+    # columns needs the unpacked stream retained
+    num_hashes: Optional[Dict[str, Tuple[np.ndarray, np.ndarray]]] = None
     # (fragment ordinal, batch ordinal within fragment) when the batch
     # came from the positioned per-fragment stream — the checkpoint
     # records it so resume can skip whole fragments' I/O
@@ -293,7 +299,8 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                   frag_pos: Optional[Tuple[int, int]] = None,
                   dict_cache: Optional[Dict[str, Dict[str, object]]] = None,
                   col_stats: Optional[Dict[str, int]] = None,
-                  decode_threads: Optional[int] = None
+                  decode_threads: Optional[int] = None,
+                  full_hashes: bool = False
                   ) -> HostBatch:
     """Decode one Arrow record batch into a fixed-shape HostBatch.
 
@@ -329,6 +336,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     cat_hash_kind: Dict[str, str] = {}
     cat_hashed: Dict[str, Tuple] = {}   # payload valid=None ⇒ no nulls
     date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    num_hashes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
 
     col_nbytes: Dict[str, int] = {}
     col_dict_nbytes: Dict[str, int] = {}
@@ -362,16 +370,24 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                     xf = np.where(valid, xf, np.nan)
                 x[:n, spec.num_lane] = xf
             if hashes:
+                keys = _num_keys(vals)
                 hll_packed[:n, spec.hash_lane] = _packed_obs(
-                    _num_keys(vals), valid, hll_precision)
+                    keys, valid, hll_precision)
+                if full_hashes:
+                    # exact distinct counting needs the unpacked 64-bit
+                    # stream (the HLL plane keeps only 16 packed bits)
+                    num_hashes[spec.name] = (_hash64(keys), valid)
         elif spec.role == "date":
             valid = arr.is_valid().to_numpy(zero_copy_only=False)
             ints = arr.cast(pa.timestamp("ns"), safe=False) \
                       .cast(pa.int64(), safe=False) \
                       .fill_null(0).to_numpy(zero_copy_only=False)
             if hashes:
+                keys = _num_keys(ints)
                 hll_packed[:n, spec.hash_lane] = _packed_obs(
-                    _num_keys(ints), valid, hll_precision)
+                    keys, valid, hll_precision)
+                if full_hashes:
+                    num_hashes[spec.name] = (_hash64(keys), valid)
             date_ints[spec.name] = (ints, valid)
         else:  # cat
             if pa.types.is_nested(arr.type):
@@ -484,6 +500,8 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                      cat_hashes=cat_hashes if hashes else None,
                      cat_hash_kind=cat_hash_kind if hashes else None,
                      cat_hashed=cat_hashed if hashes else None,
+                     num_hashes=num_hashes if hashes and full_hashes
+                     else None,
                      hll_precision=hll_precision, col_nbytes=col_nbytes,
                      col_dict_nbytes=col_dict_nbytes, frag_pos=frag_pos)
 
@@ -493,7 +511,8 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                       hashes: bool = True, skip_batches: int = 0,
                       positions: bool = False,
                       resume_pos: Optional[Tuple[int, int]] = None,
-                      workers: Optional[int] = None):
+                      workers: Optional[int] = None,
+                      full_hashes: bool = False):
     """Yield prepared HostBatches with decode/hash/pack of DIFFERENT
     batches pipelined across a small thread pool (``workers``, default
     ``_prepare_workers()``), so one process can saturate its cores
@@ -562,7 +581,8 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                              frag_pos=frag_pos,
                              dict_cache=ingest._dict_cache,
                              col_stats=ingest._col_stats,
-                             decode_threads=col_threads)
+                             decode_threads=col_threads,
+                             full_hashes=full_hashes)
 
     def reader():
         # enumerates raw batches (cheap: zero-copy slices / parquet page

@@ -157,7 +157,8 @@ class StreamingProfiler:
         hb = prepare_batch(rbs[0], self.plan, self.runner.rows,
                            self.config.hll_precision,
                            dict_cache=self._dict_cache,
-                           col_stats=self._col_stats)
+                           col_stats=self._col_stats,
+                           full_hashes=self.config.exact_distinct)
         if self.state is None:
             from tpuprof.backends.tpu import estimate_shift
             self.state = self.runner.init_pass_a(estimate_shift(hb))
