@@ -294,3 +294,61 @@ def test_unique_spill_tier_matches_ground_truth(seed, n, budget,
         truth = kunique.DUP if force_dup else kunique.UNIQUE
         assert t.resolve()["c"] == truth
         t.cleanup()
+
+
+@given(st.integers(0, 2**31 - 1), st.integers(1, 8), st.integers(8, 128),
+       st.integers(4, 3000))
+@settings(**SETTINGS)
+def test_exact_distinct_count_truth(seed, n_chunks, budget, universe):
+    """Counting mode: distinct_counts() must equal numpy's ground truth
+    for ANY stream/batching/budget (spills included), and survive an
+    interleaved snapshot (resolve is non-destructive)."""
+    rng = np.random.default_rng(seed)
+    stream = rng.choice(universe, size=rng.integers(1, 400),
+                        replace=True).astype(np.uint64)
+    with tempfile.TemporaryDirectory() as d:
+        t = kunique.UniqueTracker(["c"], budget, 1 << 30,
+                                  spill_dir=d, count_exact=True)
+        chunks = np.array_split(stream, n_chunks)
+        for i, chunk in enumerate(chunks):
+            t.update("c", chunk)
+            if i == len(chunks) // 2:
+                # mid-stream snapshot must match the prefix truth
+                prefix = np.concatenate(chunks[:i + 1])
+                assert t.distinct_counts()["c"] == \
+                    len(np.unique(prefix))
+        assert t.distinct_counts()["c"] == len(np.unique(stream))
+        t.cleanup()
+
+
+@given(st.integers(0, 2**31 - 1), st.integers(1, 4), st.integers(1, 4),
+       st.integers(8, 96))
+@settings(**SETTINGS)
+def test_exact_distinct_merge_law(seed, n_a, n_b, budget):
+    """merge(t(A), t(B)).count == |unique(A ∪ B)| — the same
+    mergeability law every other sketch obeys (SURVEY §4.2), across
+    arbitrary splits and spill boundaries."""
+    rng = np.random.default_rng(seed)
+    sa = rng.choice(500, size=rng.integers(1, 200), replace=True
+                    ).astype(np.uint64)
+    sb = rng.choice(500, size=rng.integers(1, 200), replace=True
+                    ).astype(np.uint64)
+    with tempfile.TemporaryDirectory() as d:
+        ta = kunique.UniqueTracker(["c"], budget, 1 << 30,
+                                   spill_dir=d, count_exact=True)
+        tb = kunique.UniqueTracker(["c"], budget, 1 << 30,
+                                   spill_dir=d, count_exact=True)
+        for chunk in np.array_split(sa, n_a):
+            ta.update("c", chunk)
+        for chunk in np.array_split(sb, n_b):
+            tb.update("c", chunk)
+        ta.merge(tb)
+        union = np.concatenate([sa, sb])
+        assert ta.distinct_counts()["c"] == len(np.unique(union))
+        has_dup = len(np.unique(union)) < union.size
+        # resolve() is the final-verdict API: a duplicate hidden in a
+        # SPILLED run is invisible to the streaming status until the
+        # k-way merge surfaces it
+        assert (ta.resolve()["c"] == kunique.DUP) == has_dup
+        ta.cleanup()
+        tb.cleanup()
