@@ -302,7 +302,8 @@ def test_unique_spill_tier_matches_ground_truth(seed, n, budget,
 def test_exact_distinct_count_truth(seed, n_chunks, budget, universe):
     """Counting mode: distinct_counts() must equal numpy's ground truth
     for ANY stream/batching/budget (spills included), and survive an
-    interleaved snapshot (resolve is non-destructive)."""
+    interleaved snapshot — both resolve() and distinct_counts() are
+    exercised mid-stream to pin their non-destructiveness."""
     rng = np.random.default_rng(seed)
     stream = rng.choice(universe, size=rng.integers(1, 400),
                         replace=True).astype(np.uint64)
@@ -313,10 +314,14 @@ def test_exact_distinct_count_truth(seed, n_chunks, budget, universe):
         for i, chunk in enumerate(chunks):
             t.update("c", chunk)
             if i == len(chunks) // 2:
-                # mid-stream snapshot must match the prefix truth
+                # mid-stream snapshot must match the prefix truth, and
+                # the status resolve must agree with it — both calls
+                # must leave the stream able to continue
                 prefix = np.concatenate(chunks[:i + 1])
-                assert t.distinct_counts()["c"] == \
-                    len(np.unique(prefix))
+                cnt = t.distinct_counts()["c"]
+                assert cnt == len(np.unique(prefix))
+                assert (t.resolve()["c"] == kunique.DUP) == \
+                    (cnt < prefix.size)
         assert t.distinct_counts()["c"] == len(np.unique(stream))
         t.cleanup()
 

@@ -342,3 +342,54 @@ def test_inmemory_resume_skips_prefix_without_decode(tmp_path, monkeypatch):
             else:
                 assert value == expect or (
                     value != value and expect != expect), (name, field)
+
+
+def test_resume_preserves_exact_distinct_counts(tmp_path, monkeypatch):
+    """exact_distinct + checkpoint: a crash after spills must resume and
+    still deliver the EXACT count (counting state + persistent runs ride
+    the artifact)."""
+    rng = np.random.default_rng(15)
+    n = 6000
+    df = pd.DataFrame({
+        "d": [f"v{i:05d}" for i in rng.integers(0, 2500, n)],
+        "a": rng.normal(size=n),
+    })
+    path = str(tmp_path / "ed.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+
+    cfg = _cfg(tmp_path, unique_track_rows=600, topk_capacity=64,
+               unique_spill_dir=str(tmp_path / "spill"),
+               exact_distinct=True)
+    calls = {"n": 0}
+    real_update = HostAgg.update
+
+    def crashing_update(self, hb):
+        calls["n"] += 1
+        if calls["n"] == 15:           # several spills in
+            raise RuntimeError("injected crash mid-scan")
+        return real_update(self, hb)
+
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        TPUStatsBackend().collect(path, cfg)
+    monkeypatch.setattr(HostAgg, "update", real_update)
+    assert (tmp_path / "scan.ckpt").exists()
+    assert list((tmp_path / "spill").glob("*.u64"))
+
+    resumed = TPUStatsBackend().collect(path, cfg)
+    v = resumed["variables"]["d"]
+    truth = df["d"].nunique()
+    assert v["distinct_count"] == truth, (v["distinct_count"], truth)
+    assert v["distinct_approx"] is False
+    assert not list((tmp_path / "spill").glob("*.u64"))
+
+    # resuming under a FLIPPED mode must be refused, not silently hollow
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    calls["n"] = 0
+    with pytest.raises(RuntimeError, match="injected crash"):
+        TPUStatsBackend().collect(path, cfg)
+    monkeypatch.setattr(HostAgg, "update", real_update)
+    flipped = _cfg(tmp_path, unique_track_rows=600, topk_capacity=64,
+                   unique_spill_dir=str(tmp_path / "spill"))
+    with pytest.raises(ValueError, match="exact_distinct"):
+        TPUStatsBackend().collect(path, flipped)
