@@ -326,7 +326,8 @@ class TestPreparePipeline:
         src = self._ds(tmp_path)
         cfg = ProfilerConfig(backend="tpu", batch_rows=512,
                              topk_capacity=64, unique_track_rows=512,
-                             unique_spill_dir=str(tmp_path / "sp"))
+                             unique_spill_dir=str(tmp_path / "sp"),
+                             exact_distinct=True)   # + full-hash lanes
         monkeypatch.setenv("TPUPROF_PREPARE_WORKERS", "1")
         a = TPUStatsBackend().collect(src, cfg)
         monkeypatch.setenv("TPUPROF_PREPARE_WORKERS", "4")

@@ -161,21 +161,24 @@ class HostAgg:
                 lo, hi = int(ints[valid].min()), int(ints[valid].max())
                 self.date_min[name] = min(self.date_min.get(name, lo), lo)
                 self.date_max[name] = max(self.date_max.get(name, hi), hi)
-        if self._numdate_tracked:
-            nh = hb.num_hashes or {}
-            for name in self._numdate_tracked:
-                if not self.unique.active(name):
-                    continue
-                pair = nh.get(name)
-                if pair is None:
-                    # batch prepared without full hashes: coverage
-                    # broken, the exact count is no longer sound
-                    self.unique.deactivate(name)
-                    continue
-                h, valid = pair
-                h, valid = h[: hb.nrows], valid[: hb.nrows]
-                self.unique.update(name, h[valid],
-                                   hash_kind=self._numkind)
+        # getattr: StreamingProfiler.restore() unpickles HostAgg from
+        # artifacts whose meta does NOT version this attribute (unlike
+        # _CollectCheckpoint, whose meta gate rejects old layouts), so a
+        # pre-exact-distinct streaming checkpoint reaches update()
+        # without it — verified live against the public restore API
+        nh = hb.num_hashes or {}
+        for name in getattr(self, "_numdate_tracked", ()):
+            if not self.unique.active(name):
+                continue
+            pair = nh.get(name)
+            if pair is None:
+                # batch prepared without full hashes: coverage broken,
+                # the exact count is no longer sound
+                self.unique.deactivate(name)
+                continue
+            h, valid = pair
+            h, valid = h[: hb.nrows], valid[: hb.nrows]
+            self.unique.update(name, h[valid], hash_kind=self._numkind)
 
     def memorysize(self, name: str) -> float:
         """Arrow buffer bytes for one column (NaN if never observed)."""
