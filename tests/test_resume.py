@@ -87,6 +87,48 @@ def test_crash_then_resume_matches_uninterrupted(tmp_path, parquet_source,
                     value != value and expect != expect), (name, field)
 
 
+def test_pre_upgrade_checkpoint_without_new_meta_keys_resumes(
+        tmp_path, parquet_source, monkeypatch):
+    """Artifacts written before (process_id, process_count,
+    exact_distinct) were stamped carry none of those meta keys; absence
+    must read as the then-only behavior (0 / 1 / False), not as a
+    mismatch that hard-fails the resume (ADVICE r4)."""
+    import pickle
+
+    cfg = _cfg(tmp_path)
+    calls = {"n": 0}
+    real_update = HostAgg.update
+
+    def crashing_update(self, hb):
+        calls["n"] += 1
+        if calls["n"] == 8:
+            raise RuntimeError("injected crash mid-scan")
+        return real_update(self, hb)
+
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        TPUStatsBackend().collect(parquet_source, cfg)
+    monkeypatch.setattr(HostAgg, "update", real_update)
+
+    path = tmp_path / "scan.ckpt"
+    with open(path, "rb") as fh:
+        header = pickle.load(fh)
+        payload = pickle.load(fh)
+    for key in ("process_id", "process_count", "exact_distinct"):
+        assert key in payload["meta"]
+        del payload["meta"][key]
+    with open(path, "wb") as fh:
+        pickle.dump(header, fh, protocol=pickle.HIGHEST_PROTOCOL)
+        pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
+
+    control = TPUStatsBackend().collect(
+        parquet_source, ProfilerConfig(backend="tpu", batch_rows=256))
+    resumed = TPUStatsBackend().collect(parquet_source, cfg)
+    assert resumed["table"]["n"] == 4000
+    assert _key_stats(resumed)["a"]["mean"] == pytest.approx(
+        _key_stats(control)["a"]["mean"], rel=1e-5)
+
+
 def test_resume_skips_completed_fragments_io(tmp_path, monkeypatch):
     """The resume cursor is fragment-positioned: fragments fully folded
     before the last checkpoint are never re-opened (no file I/O), only

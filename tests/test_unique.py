@@ -382,6 +382,118 @@ class TestSpillLifecycle:
         assert fresh.exists(), "young foreign run swept — could be live"
         assert not stale.exists(), "aged-out orphan not reclaimed"
 
+    def test_update_restamps_referenced_files(self, tmp_path):
+        """Referenced runs must stay younger than the shared-dir orphan
+        sweep's age gate while the owning tracker is alive (ADVICE r4):
+        a >24h chain — checkpointed or not — would otherwise hold files
+        another profile's cleanup() could legally destroy.  Liveness is
+        signalled by update() itself (rate-limited mtime refresh)."""
+        import os
+        import time
+        t = self._tracker(tmp_path)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))       # spills
+        paths = [p for p, _ in t._runs["c"]]
+        old = time.time() - kunique.ORPHAN_SWEEP_AGE_S - 60
+        for p in paths:
+            os.utime(p, (old, old))
+        t._last_touch = 0.0             # simulate TOUCH_INTERVAL_S passing
+        t.update("d", np.array([1], dtype=np.uint64))
+        stale_before = time.time() - kunique.ORPHAN_SWEEP_AGE_S
+        assert all(os.path.getmtime(p) > stale_before for p in paths)
+        # the concrete hazard: a foreign tracker's sweep of the same dir
+        # no longer reclaims the (now provably young) live runs
+        other = self._tracker(tmp_path)
+        other.cleanup()
+        assert all(os.path.exists(p) for p in paths)
+
+    def test_update_restamps_retired_runs(self, tmp_path):
+        """Runs demoted while persistent move to _retired but stay
+        referenced by the LAST saved artifact until the next save's
+        reap — the liveness touch must keep THEM young too, or a crash
+        resume >24h later finds them swept."""
+        import os
+        import time
+        t = self._tracker(tmp_path)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))       # spills
+        paths = [p for p, _ in t._runs["c"]]
+        t.persistent = True
+        t.update("c", np.array([7, 7], dtype=np.uint64))        # demotes
+        assert t._retired == paths
+        old = time.time() - kunique.ORPHAN_SWEEP_AGE_S - 60
+        for p in paths:
+            os.utime(p, (old, old))
+        t._last_touch = 0.0
+        t.update("d", np.array([1], dtype=np.uint64))
+        stale_before = time.time() - kunique.ORPHAN_SWEEP_AGE_S
+        assert all(os.path.getmtime(p) > stale_before for p in paths)
+
+    def test_touch_runs_rate_limited(self, tmp_path):
+        """Between TOUCH_INTERVAL_S refreshes the per-update touch is one
+        clock read — no utime traffic on the (typically NFS) spill dir."""
+        import os
+        import time
+        t = self._tracker(tmp_path)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))       # spills
+        paths = [p for p, _ in t._runs["c"]]
+        t.touch_runs(force=True)        # _last_touch = now
+        marker = time.time() - 3600
+        for p in paths:
+            os.utime(p, (marker, marker))
+        t.update("d", np.array([2], dtype=np.uint64))
+        t.touch_runs()                  # within the interval: no-op
+        assert all(abs(os.path.getmtime(p) - marker) < 5 for p in paths)
+
+    def test_restore_restamps_aged_inherited_runs(self, tmp_path):
+        """A crash chain resumed after ORPHAN_SWEEP_AGE_S inherits runs
+        already past the sweep's age gate; unpickling must restamp them
+        before any other profile's cleanup can race the first save."""
+        import os
+        import pickle
+        import time
+        t = self._tracker(tmp_path)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))
+        t.persistent = True
+        blob = pickle.dumps(t)
+        paths = [p for p, _ in t._runs["c"]]
+        old = time.time() - kunique.ORPHAN_SWEEP_AGE_S - 60
+        for p in paths:
+            os.utime(p, (old, old))
+        t2 = pickle.loads(blob)
+        stale_before = time.time() - kunique.ORPHAN_SWEEP_AGE_S
+        assert all(os.path.getmtime(p) > stale_before for p in paths)
+        assert t2.resolve()["c"] == kunique.UNIQUE
+
+    def test_streaming_liveness_restamps_runs(self, tmp_path):
+        """The stream that updates forever but never checkpoints is the
+        worst case for the age-gated sweep; its per-batch updates must
+        keep the spill runs young."""
+        import os
+        import time
+        import pyarrow as pa
+        from tpuprof import ProfilerConfig
+        from tpuprof.runtime.stream import StreamingProfiler
+        cfg = ProfilerConfig(batch_rows=512, unique_track_rows=600,
+                             topk_capacity=64,
+                             unique_spill_dir=str(tmp_path / "sp"))
+        schema_ = pa.schema([("u", pa.string())])
+        with StreamingProfiler(schema_, cfg) as prof:
+            for start in range(0, 2048, 512):
+                prof.update(pd.DataFrame(
+                    {"u": [f"id{i:07d}" for i in range(start, start + 512)]}))
+            prof._drain(force=True)
+            paths = [p for runs in prof.hostagg.unique._runs.values()
+                     for p, _ in runs]
+            assert paths
+            old = time.time() - kunique.ORPHAN_SWEEP_AGE_S - 60
+            for p in paths:
+                os.utime(p, (old, old))
+            prof.hostagg.unique._last_touch = 0.0   # interval elapsed
+            prof.update(pd.DataFrame(
+                {"u": [f"id{i:07d}" for i in range(2048, 3072)]}))
+            prof._drain(force=True)
+            stale_before = time.time() - kunique.ORPHAN_SWEEP_AGE_S
+            assert all(os.path.getmtime(p) > stale_before for p in paths)
+
     def test_streaming_close_reclaims_spill_runs(self, tmp_path):
         import pyarrow as pa
         from tpuprof import ProfilerConfig

@@ -161,11 +161,12 @@ class HostAgg:
                 lo, hi = int(ints[valid].min()), int(ints[valid].max())
                 self.date_min[name] = min(self.date_min.get(name, lo), lo)
                 self.date_max[name] = max(self.date_max.get(name, hi), hi)
-        # getattr: StreamingProfiler.restore() unpickles HostAgg from
-        # artifacts whose meta does NOT version this attribute (unlike
-        # _CollectCheckpoint, whose meta gate rejects old layouts), so a
-        # pre-exact-distinct streaming checkpoint reaches update()
-        # without it — verified live against the public restore API
+        # getattr: pre-exact-distinct artifacts unpickle a HostAgg
+        # without this attribute, and BOTH resume paths let them reach
+        # update(): StreamingProfiler.restore()'s meta never versioned
+        # it, and _CollectCheckpoint.load() deliberately defaults the
+        # absent exact_distinct meta key to False (old artifacts must
+        # keep resuming) — the guard is load-bearing for both
         nh = hb.num_hashes or {}
         for name in getattr(self, "_numdate_tracked", ()):
             if not self.unique.active(name):
@@ -329,8 +330,17 @@ class _CollectCheckpoint:
         payload = ckpt.load_payload(self.path)
         meta = payload["meta"]
         mine = self._meta()
+        # keys added after an artifact was written are absent from its
+        # meta; absence means the writer ran the then-only behavior, so
+        # compare against that default instead of None (which would
+        # hard-fail every pre-existing artifact on upgrade).  batch_enum
+        # is deliberately NOT defaulted: for table sources the old
+        # enumeration really did differ (window-v2), so absent != "v2"
+        # must reject; for parquet sources both sides stamp None anyway.
+        absent_defaults = {"process_id": 0, "process_count": 1,
+                           "exact_distinct": False}
         for key in self._META_KEYS:
-            if meta.get(key) != mine[key]:
+            if meta.get(key, absent_defaults.get(key)) != mine[key]:
                 raise ValueError(
                     f"checkpoint {key}={meta.get(key)!r} does not match "
                     f"this run's {mine[key]!r} — the batch stream or "

@@ -548,6 +548,13 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
     # the queue must hold at least w futures or the pool can never be
     # full; more than that buffers prepared batches ahead of the scan
     depth = max(depth, w)
+    # full_hashes (exact_distinct) makes every buffered HostBatch retain
+    # 64-bit hashes + valid masks for ALL num/date columns — roughly
+    # 9 B/row/column on top of the packed lanes.  Cap the buffer at the
+    # pool width so peak host RAM stays ~w batches, not depth batches
+    # (wide-numeric tables would otherwise multiply by the readahead).
+    if full_hashes:
+        depth = w
     # concurrent prepares split the host's cores: each batch's internal
     # per-column pool gets its share instead of all of them (w batches
     # times 8 column threads would thrash a smaller host)

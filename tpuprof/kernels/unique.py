@@ -67,6 +67,10 @@ RESOLVE_SLICE_ROWS = 1 << 24
 # which cleanup cannot distinguish by name — are never touched young.
 # No realistic profile keeps a run file live this long.
 ORPHAN_SWEEP_AGE_S = 24 * 3600
+# Refresh referenced-run mtimes at most this often (see touch_runs):
+# a quarter of the sweep gate keeps live runs provably young while
+# paying O(run files) utime syscalls only a handful of times per day.
+TOUCH_INTERVAL_S = ORPHAN_SWEEP_AGE_S // 4
 
 
 class UniqueTracker:
@@ -120,6 +124,8 @@ class UniqueTracker:
         self.persistent = False
         # memo: name -> (state_key, status, count_or_None)
         self._resolve_memo: Dict[str, Tuple] = {}
+        self._last_touch = 0.0          # see touch_runs
+
         disabled = self.budget <= 0 or self.total_budget <= 0
         # per-column: still counting exact distincts (requires storage,
         # so it needs a spill dir to survive the budget)
@@ -192,6 +198,47 @@ class UniqueTracker:
                 pass
         self._retired = []
 
+    def touch_runs(self, force: bool = False) -> None:
+        """Refresh the mtime of every run this tracker still references.
+
+        cleanup()'s orphan sweep uses file age (> ORPHAN_SWEEP_AGE_S) as
+        its only evidence of abandonment; run files are written once and
+        never rewritten, so any tracker alive longer than the gate — a
+        long checkpoint chain, a stream that never checkpoints, a crash
+        chain resumed days later — holds runs an unrelated profile's
+        sweep of the shared dir could legally destroy.  update() /
+        resolve() / distinct_counts() call this, rate-limited to
+        TOUCH_INTERVAL_S so the common case is one clock read; restore
+        forces a pass so inherited runs are restamped before any sweep
+        can race it.
+
+        _retired runs are touched too: the LAST saved artifact still
+        references them by path until the next save's reap, and a crash
+        resume needs them intact.
+
+        Residual exposure (documented bound): only running code can
+        refresh an mtime, so a tracker that receives NO calls for longer
+        than ORPHAN_SWEEP_AGE_S - TOUCH_INTERVAL_S (>= 18 h fully idle)
+        cannot defend its files; a concurrent profile's sweep may then
+        reclaim them, and the column degrades honestly (OVERFLOW /
+        estimate) on next access."""
+        import time
+        now = time.time()
+        if not force and now - self._last_touch < TOUCH_INTERVAL_S:
+            return
+        self._last_touch = now
+        for path in self._retired:
+            try:
+                os.utime(path, None)
+            except OSError:
+                pass
+        for runs in self._runs.values():
+            for path, _rows in runs:
+                try:
+                    os.utime(path, None)
+                except OSError:
+                    pass
+
     def _spill(self, name: str) -> bool:
         """Write the column's consolidated in-memory chunk to a disk run
         and free the memory; tracking continues in a fresh epoch."""
@@ -231,6 +278,7 @@ class UniqueTracker:
         ("native" | "pandas"); the same value hashes DIFFERENTLY under
         the two, so a column whose stream switches implementations can
         no longer be compared exactly and demotes to OVERFLOW."""
+        self.touch_runs()       # liveness signal: keep runs sweep-safe
         counting = self._counting.get(name, False)
         if self.status.get(name) != UNIQUE and not counting:
             return
@@ -303,6 +351,7 @@ class UniqueTracker:
         however large the column.  Non-destructive (streaming snapshots
         may call it repeatedly); per-column results are memoized on the
         (runs, live-rows) state."""
+        self.touch_runs()       # liveness signal: keep runs sweep-safe
         out = {}
         for name, st in self.status.items():
             if st == UNIQUE and self._runs.get(name):
@@ -322,6 +371,7 @@ class UniqueTracker:
         no spilled runs counts as its live row total; spilled columns
         count the union via the same hash-range k-way merge resolve()
         uses.  Non-destructive and memoized alongside the status."""
+        self.touch_runs()       # liveness signal: keep runs sweep-safe
         out: Dict[str, int] = {}
         for name, counting in self._counting.items():
             if not counting or self.status.get(name) == OVERFLOW:
@@ -473,6 +523,7 @@ class UniqueTracker:
         self._spill_seq = 0
         if not hasattr(self, "_counting"):      # pre-counting artifacts
             self._counting = {n: False for n in self.status}
+        self._last_touch = 0.0
         lost = []
         for name, runs in list(self._runs.items()):
             for path, rows in runs:
@@ -504,6 +555,11 @@ class UniqueTracker:
                 "here.  In multi-host runs exact UNIQUE needs "
                 "unique_spill_dir on storage SHARED by all hosts",
                 len(lost), ", ".join(sorted(lost)[:5]))
+        # restamp surviving inherited runs (demoted columns' lists are
+        # already empty): a chain resumed after ORPHAN_SWEEP_AGE_S holds
+        # files past the sweep's age gate — fair game for any other
+        # profile's cleanup() until touched
+        self.touch_runs(force=True)
 
     def disown_runs(self) -> None:
         """Transfer run-file ownership away from this instance: its GC
