@@ -82,6 +82,14 @@ class ProfileReport:
         with io.open(outputfile, "w", encoding="utf-8") as fh:
             fh.write(page)
 
+    def to_json_dict(self) -> Dict[str, Any]:
+        """The complete stats dict (every top-level key of the SURVEY §1
+        contract — table, variables, freq, correlations, messages,
+        sample) as a ``json.dump``-ready structure.  ``--stats-json``
+        writes exactly this."""
+        from tpuprof.report.export import stats_to_json
+        return stats_to_json(self.description)
+
     def get_rejected_variables(self, threshold: Optional[float] = None
                                ) -> List[str]:
         """Columns rejected for high correlation (SURVEY §3.4) — reads the

@@ -44,7 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "--single-pass: estimated from the row sample, "
                         "~1/sqrt(K) rank error)")
     p.add_argument("--stats-json", metavar="PATH",
-                   help="also dump the stats dict as JSON")
+                   help="also dump the FULL stats dict as JSON (table, "
+                        "variables, freq, correlations, messages, sample)")
     p.add_argument("--trace", metavar="DIR",
                    help="capture a jax.profiler trace into DIR")
     p.add_argument("--unique-spill-dir", metavar="DIR",
@@ -178,14 +179,8 @@ def cmd_profile(args: argparse.Namespace) -> int:
           f"{wrote} in {elapsed:.2f}s ({rate:,.0f} rows/s)",
           file=sys.stderr)
     if args.stats_json and write_output:
-        from tpuprof.report.formatters import fmt_value
-        payload = {
-            name: {k: fmt_value(v) for k, v in var.items()
-                   if k not in ("histogram", "mini_histogram")}
-            for name, var in report.description["variables"].items()}
         with open(args.stats_json, "w") as fh:
-            json.dump({"table": {k: fmt_value(v) for k, v in table.items()},
-                       "variables": payload}, fh, indent=2)
+            json.dump(report.to_json_dict(), fh, indent=2)
     return 0
 
 

@@ -1,0 +1,88 @@
+"""Machine-readable export of the full stats dict.
+
+SURVEY §1 calls the stats dict "the single most important compatibility
+requirement"; the JSON export must therefore carry EVERY top-level key
+of the contract (table, variables, freq, correlations, messages,
+sample), not just table+variables (VERDICT r4 #5 — a computed Spearman
+matrix appeared in the HTML but was dropped from ``--stats-json``).
+
+``table``/``variables`` keep the human-oriented formatter output they
+have had since v0.1 (pinned by tests/test_cli.py); the keys this module
+adds carry raw machine values: floats stay floats (non-finite → null —
+JSON has no NaN), counts stay ints, timestamps become ISO strings.
+"""
+
+from __future__ import annotations
+
+import math
+from datetime import datetime, timedelta
+from typing import Any, Dict
+
+import numpy as np
+import pandas as pd
+
+from tpuprof.report.formatters import fmt_value
+
+
+def json_scalar(value: Any) -> Any:
+    """One value → its JSON-safe raw form (no human formatting)."""
+    if value is None or value is pd.NaT:
+        return None
+    if isinstance(value, (bool, np.bool_)):
+        return bool(value)
+    if isinstance(value, (int, np.integer)):
+        return int(value)
+    if isinstance(value, (float, np.floating)):
+        value = float(value)
+        return value if math.isfinite(value) else None
+    if isinstance(value, (pd.Timestamp, datetime, np.datetime64)):
+        return str(pd.Timestamp(value))
+    if isinstance(value, (pd.Timedelta, timedelta, np.timedelta64)):
+        return str(pd.Timedelta(value))
+    return str(value)
+
+
+def _corr_entry(matrix: pd.DataFrame) -> Dict[str, Any]:
+    cols = [str(c) for c in matrix.columns]
+    return {
+        "columns": cols,
+        "matrix": {str(r): {str(c): json_scalar(matrix.loc[r, c])
+                            for c in matrix.columns}
+                   for r in matrix.index},
+        # sample-estimate Spearman (single-pass/streaming) flags itself;
+        # exact matrices carry approx=False so consumers need no default
+        "approx": bool(matrix.attrs.get("approx", False)),
+    }
+
+
+def stats_to_json(stats: Dict[str, Any]) -> Dict[str, Any]:
+    """The complete stats dict as a ``json.dump``-ready structure."""
+    out: Dict[str, Any] = {
+        "table": {k: fmt_value(v) for k, v in stats["table"].items()},
+        # histograms are render-layer artifacts (bin arrays feeding the
+        # SVG), not column statistics — same exclusion as since v0.1
+        "variables": {
+            name: {k: fmt_value(v) for k, v in var.items()
+                   if k not in ("histogram", "mini_histogram")}
+            for name, var in stats["variables"].items()},
+        "freq": {
+            str(col): [{"value": json_scalar(idx), "count": int(cnt)}
+                       for idx, cnt in vc.items()]
+            for col, vc in stats.get("freq", {}).items()},
+        "correlations": {
+            str(method): _corr_entry(matrix)
+            for method, matrix in stats.get("correlations", {}).items()},
+        "messages": [
+            {**m.to_dict(), "value": json_scalar(m.value)}
+            for m in stats.get("messages", ())],
+    }
+    sample = stats.get("sample")
+    if sample is None or len(sample) == 0:
+        out["sample"] = {"columns": [], "rows": []}
+    else:
+        out["sample"] = {
+            "columns": [str(c) for c in sample.columns],
+            "rows": [[json_scalar(v) for v in row]
+                     for row in sample.itertuples(index=False, name=None)],
+        }
+    return out
