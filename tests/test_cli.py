@@ -76,6 +76,27 @@ def test_stats_json_carries_every_contract_key(parquet_path, tmp_path):
         len(r) == 3 for r in payload["sample"]["rows"])
 
 
+def test_stats_json_corr_message_is_structured(tmp_path):
+    """A CORR message's (partner, rho) value must export as JSON
+    structure, not a Python-repr string."""
+    from tpuprof import ProfileReport
+    rng = np.random.default_rng(1)
+    df = pd.DataFrame({"a": rng.normal(size=500)})
+    df["a2"] = df["a"] * 3 + 1e-12
+    payload = ProfileReport(df, backend="cpu").to_json_dict()
+    corr = [m for m in payload["messages"] if m["kind"] == "CORR"]
+    assert corr and corr[0]["value"][0] == "a"
+    assert isinstance(corr[0]["value"][1], float)
+
+
+def test_stats_json_empty_source_keeps_sample_columns():
+    from tpuprof import ProfileReport
+    empty = pd.DataFrame({"a": pd.Series(dtype="float64"),
+                          "b": pd.Series(dtype="object")})
+    payload = ProfileReport(empty, backend="cpu").to_json_dict()
+    assert payload["sample"] == {"columns": ["a", "b"], "rows": []}
+
+
 def test_stats_json_spearman_sample_estimate_flagged(parquet_path, tmp_path):
     """Single-pass Spearman is a sample estimate; the export's approx
     flag must say so (the HTML badge already does)."""

@@ -1,0 +1,181 @@
+"""Column projection (VERDICT r4 #4): the reference's users subset via
+``df.select(...)`` before profiling; tpuprof mirrors that with
+``ProfileReport(source, columns=[...])`` / ``--columns a,b,c``.  The
+projection prunes parquet reads at the scanner and is the documented
+escape hatch for nested columns' slow stringified ingest."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof import ProfileReport, ProfilerConfig, describe
+from tpuprof.cli import main
+from tpuprof.ingest.arrow import ArrowIngest
+
+
+@pytest.fixture
+def frame():
+    rng = np.random.default_rng(5)
+    n = 2000
+    return pd.DataFrame({
+        "a": rng.normal(size=n),
+        "b": rng.exponential(size=n),
+        "c": rng.choice(["x", "y", "z"], n),
+        "d": pd.to_datetime("2024-01-01")
+        + pd.to_timedelta(rng.integers(0, 999, n), unit="h"),
+    })
+
+
+@pytest.fixture
+def parquet_path(frame, tmp_path):
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(frame, preserve_index=False), path)
+    return path
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"],
+                         ids=["oracle", "engine"])
+def test_projection_profiles_only_and_in_order(frame, backend):
+    stats = describe(frame, ProfilerConfig(
+        backend=backend, batch_rows=512, columns=("c", "a")))
+    assert list(stats["variables"].keys()) == ["c", "a"]
+    assert stats["table"]["nvar"] == 2
+    assert stats["table"]["n"] == 2000
+    assert list(stats["sample"].columns) == ["c", "a"]
+    # the projected profile matches the full profile on shared columns
+    full = describe(frame, ProfilerConfig(backend=backend, batch_rows=512))
+    for col in ("c", "a"):
+        for field in ("count", "n_missing", "distinct_count", "type"):
+            assert stats["variables"][col][field] == \
+                full["variables"][col][field], (col, field)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"],
+                         ids=["oracle", "engine"])
+def test_unknown_column_raises(parquet_path, backend):
+    with pytest.raises(ValueError, match=r"columns not in the source.*nope"):
+        describe(parquet_path, ProfilerConfig(
+            backend=backend, batch_rows=512, columns=("a", "nope")))
+
+
+def test_int_labeled_frame_projects_on_both_backends():
+    """Header-less frames carry int column labels; the projection
+    matches on their stringified names (what the TPU engine sees after
+    pyarrow conversion) on BOTH backends — no oracle/engine divergence,
+    no KeyError."""
+    df = pd.DataFrame([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    for backend in ("cpu", "tpu"):
+        stats = describe(df, ProfilerConfig(
+            backend=backend, batch_rows=512, columns=("0",)))
+        assert list(map(str, stats["variables"].keys())) == ["0"], backend
+        assert stats["table"]["n"] == 3
+
+
+def test_config_rejects_empty_and_duplicates():
+    with pytest.raises(ValueError, match="at least one"):
+        ProfilerConfig(columns=())
+    with pytest.raises(ValueError, match="duplicates"):
+        ProfilerConfig(columns=("a", "b", "a"))
+
+
+def test_parquet_scan_reads_only_projected_columns(parquet_path):
+    ingest = ArrowIngest(parquet_path, batch_rows=512, columns=["b"])
+    batches = list(ingest.raw_batches())
+    assert batches and all(rb.schema.names == ["b"] for rb in batches)
+    assert [s.name for s in ingest.plan.specs] == ["b"]
+
+
+def test_projection_changes_source_fingerprint(parquet_path):
+    """A checkpoint saved under one projection must not resume a scan
+    with another: the cursors counted different batch contents."""
+    fp_all = ArrowIngest(parquet_path, batch_rows=512).fingerprint()
+    fp_a = ArrowIngest(parquet_path, batch_rows=512,
+                       columns=["a"]).fingerprint()
+    fp_ab = ArrowIngest(parquet_path, batch_rows=512,
+                        columns=["a", "b"]).fingerprint()
+    fp_ba = ArrowIngest(parquet_path, batch_rows=512,
+                        columns=["b", "a"]).fingerprint()
+    assert len({fp_all, fp_a, fp_ab, fp_ba}) == 4
+
+
+def test_nested_column_escape_hatch(tmp_path):
+    """One list<int64> column degrades ingest ~200x (PERF.md); excluding
+    it via the projection must keep the scan on the fast path — no
+    nested-stringification warning, full stats for the kept columns."""
+    import tpuprof.ingest.arrow as arrow_mod
+    n = 1500
+    rng = np.random.default_rng(6)
+    table = pa.table({
+        "num": pa.array(rng.normal(size=n)),
+        "nest": pa.array([[i, i + 1] for i in range(n)],
+                         type=pa.list_(pa.int64())),
+    })
+    path = str(tmp_path / "nested.parquet")
+    pq.write_table(table, path)
+    arrow_mod._NESTED_WARNED.discard("nest")
+    report = ProfileReport(path, backend="tpu", batch_rows=512,
+                           columns=["num"])
+    assert list(report.description["variables"].keys()) == ["num"]
+    assert report.description["variables"]["num"]["count"] == n
+    assert "nest" not in arrow_mod._NESTED_WARNED, \
+        "projection should prevent the nested decode entirely"
+
+
+def test_cpu_unknown_column_fails_before_reading(tmp_path):
+    """A misspelled projection must error from the schema, not after a
+    full dataset materialization (the nested column it was meant to
+    exclude would otherwise be read AND stringified first).  Proven by
+    ordering: with the data file gone, a read raises OSError — the
+    validation must win with ValueError first."""
+    import os
+
+    import pyarrow.dataset as pads
+
+    from tpuprof.backends.cpu import CPUStatsBackend
+    n = 500
+    table = pa.table({"num": pa.array(np.arange(n, dtype=np.float64)),
+                      "nest": pa.array([[i] for i in range(n)],
+                                       type=pa.list_(pa.int64()))})
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(table, path)
+    dataset = pads.dataset(path)        # schema discovered; then ...
+    os.remove(path)                     # ... any actual read would fail
+    with pytest.raises(ValueError, match="columns not in the source"):
+        CPUStatsBackend().collect(dataset, ProfilerConfig(
+            backend="cpu", columns=("numm",)))
+    with pytest.raises(OSError):        # control: a valid projection
+        CPUStatsBackend().collect(dataset, ProfilerConfig(  # does read
+            backend="cpu", columns=("num",)))
+
+
+def test_cli_empty_columns_value_errors(parquet_path, tmp_path):
+    """--columns "" (e.g. an unset shell variable) must error like
+    --columns "," does — not silently profile every column."""
+    rc = main(["profile", parquet_path, "-o", str(tmp_path / "r.html"),
+               "--backend", "cpu", "--columns", ""])
+    assert rc == 2
+
+
+def test_cli_bad_columns_speak_cli_errors(parquet_path, tmp_path, capsys):
+    """Duplicate and unknown --columns names exit 2 with a 'tpuprof:
+    error:' line, not a traceback."""
+    out = str(tmp_path / "r.html")
+    rc = main(["profile", parquet_path, "-o", out, "--backend", "cpu",
+               "--columns", "a,a"])
+    assert rc == 2 and "duplicates" in capsys.readouterr().err
+    rc = main(["profile", parquet_path, "-o", out, "--backend", "cpu",
+               "--columns", "nope"])
+    assert rc == 2 and "columns not in the source" in capsys.readouterr().err
+
+
+def test_cli_columns_flag(parquet_path, tmp_path):
+    out = str(tmp_path / "r.html")
+    rc = main(["profile", parquet_path, "-o", out, "--backend", "tpu",
+               "--batch-rows", "512", "--columns", "a,c",
+               "--no-compile-cache"])
+    assert rc == 0
+    page = open(out).read()
+    assert 'id="var-a"' in page and 'id="var-c"' in page
+    assert 'id="var-b"' not in page

@@ -58,7 +58,7 @@ def test_fragment_retry_resumes_without_duplicates():
         def __init__(self):
             self.calls = 0
 
-        def to_batches(self, batch_size):
+        def to_batches(self, batch_size, columns=None):
             self.calls += 1
             batches = table.to_batches(max_chunksize=30)
             if self.calls == 1:
@@ -66,7 +66,7 @@ def test_fragment_retry_resumes_without_duplicates():
                 raise OSError("transient read failure")
             yield from batches
 
-    def scanner_batches(batch_size):
+    def scanner_batches(batch_size, columns=None):
         # scanner delivers one batch then dies -> fallback path takes over
         yield table.to_batches(max_chunksize=30)[0]
         raise OSError("scanner failure")
@@ -83,11 +83,11 @@ def test_fragment_retry_resumes_without_duplicates():
 
 def test_fragment_retry_exhaustion_raises():
     class DeadFragment:
-        def to_batches(self, batch_size):
+        def to_batches(self, batch_size, columns=None):
             raise OSError("gone")
             yield  # pragma: no cover
 
-    def dead_scanner(batch_size):
+    def dead_scanner(batch_size, columns=None):
         raise OSError("gone")
         yield  # pragma: no cover
 

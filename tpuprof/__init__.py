@@ -26,7 +26,9 @@ SURVEY.md §1):
 
 from tpuprof.api import ProfileReport, describe
 from tpuprof.config import ProfilerConfig
+from tpuprof.errors import InputError
 
-__version__ = "0.3.0"
+__version__ = "0.5.0"
 
-__all__ = ["ProfileReport", "describe", "ProfilerConfig", "__version__"]
+__all__ = ["ProfileReport", "describe", "ProfilerConfig", "InputError",
+           "__version__"]

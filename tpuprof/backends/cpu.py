@@ -219,7 +219,10 @@ class CPUStatsBackend:
     name = "cpu"
 
     def collect(self, source: Any, config: ProfilerConfig) -> Dict[str, Any]:
-        df = _stringify_unhashable(_as_pandas(source))
+        # _as_pandas owns the projection (the reference's df.select
+        # idiom): unknown names raise BEFORE any file-backed read
+        df = _stringify_unhashable(_as_pandas(source,
+                                              columns=config.columns))
         n = len(df)
 
         base_kinds: Dict[str, str] = {}
@@ -283,17 +286,37 @@ class CPUStatsBackend:
         }
 
 
-def _as_pandas(source: Any) -> pd.DataFrame:
+def _as_pandas(source: Any, columns=None) -> pd.DataFrame:
+    """``columns`` projects (in the caller's order), validated up front;
+    file-backed reads push it into the scanner so excluded columns'
+    pages are never read — the nested-column escape hatch works for the
+    oracle too."""
+    from tpuprof.ingest.arrow import validate_projection
     if isinstance(source, pd.DataFrame):
+        if columns is not None:
+            # match on STRINGIFIED labels (the TPU engine sees pyarrow's
+            # stringified names, e.g. int labels from header-less CSVs)
+            # but index with the originals — source[["0"]] on int labels
+            # would KeyError
+            validate_projection(columns, source.columns)
+            by_str = {str(c): c for c in source.columns}
+            return source[[by_str[c] for c in columns]]
         return source
     try:
         import pyarrow as pa
         import pyarrow.dataset as ds
         if isinstance(source, pa.Table):
+            if columns is not None:
+                return source.select(
+                    validate_projection(columns, source.schema.names)
+                ).to_pandas()
             return source.to_pandas()
-        if isinstance(source, (str,)):
-            return ds.dataset(source).to_table().to_pandas()
+        if isinstance(source, str):
+            source = ds.dataset(source)
         if isinstance(source, ds.Dataset):
+            if columns is not None:
+                return source.to_table(columns=validate_projection(
+                    columns, source.schema.names)).to_pandas()
             return source.to_table().to_pandas()
     except ImportError:
         pass

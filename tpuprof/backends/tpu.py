@@ -339,9 +339,10 @@ class _CollectCheckpoint:
         # must reject; for parquet sources both sides stamp None anyway.
         absent_defaults = {"process_id": 0, "process_count": 1,
                            "exact_distinct": False}
+        from tpuprof.errors import InputError
         for key in self._META_KEYS:
             if meta.get(key, absent_defaults.get(key)) != mine[key]:
-                raise ValueError(
+                raise InputError(
                     f"checkpoint {key}={meta.get(key)!r} does not match "
                     f"this run's {mine[key]!r} — the batch stream or "
                     "sketch shapes would diverge from the saved prefix")
@@ -462,7 +463,8 @@ class TPUStatsBackend:
         # (each host's runs validate present everywhere and the merge
         # adopts them — kernels/unique.py merge law); host-local dirs
         # degrade honestly to OVERFLOW at merge time, not up front
-        ingest = ArrowIngest(source, config.batch_rows, process_shard=pshard)
+        ingest = ArrowIngest(source, config.batch_rows, process_shard=pshard,
+                             columns=config.columns)
         plan = ingest.plan
         if not plan.specs:
             return _empty_stats(config)

@@ -43,6 +43,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also compute Spearman rank correlations (with "
                         "--single-pass: estimated from the row sample, "
                         "~1/sqrt(K) rank error)")
+    p.add_argument("--columns", metavar="A,B,C",
+                   help="profile only these columns, in this order (the "
+                        "reference's df.select idiom).  Parquet reads "
+                        "skip the excluded columns entirely — also the "
+                        "escape hatch for nested (list/struct/map) "
+                        "columns, whose stringified ingest is ~200x "
+                        "slower.  Unknown names error.")
     p.add_argument("--stats-json", metavar="PATH",
                    help="also dump the FULL stats dict as JSON (table, "
                         "variables, freq, correlations, messages, sample)")
@@ -94,6 +101,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def cmd_profile(args: argparse.Namespace) -> int:
     from tpuprof import ProfileReport, ProfilerConfig
+    from tpuprof.errors import InputError
     from tpuprof.utils.trace import phase_timer, trace_to
 
     if args.exact_distinct and not args.unique_spill_dir:
@@ -142,24 +150,51 @@ def cmd_profile(args: argparse.Namespace) -> int:
             or os.path.expanduser("~/.cache"),
             "tpuprof", "xla")
 
-    config = ProfilerConfig(
-        backend=args.backend, bins=args.bins, corr_reject=args.corr_reject,
-        batch_rows=args.batch_rows, scan_batches=args.scan_batches,
-        prepare_workers=args.prepare_workers,
-        quantile_sketch_size=args.sketch_size,
-        hll_precision=args.hll_precision, exact_passes=not args.single_pass,
-        spearman=args.spearman, unique_spill_dir=args.unique_spill_dir,
-        exact_distinct=args.exact_distinct,
-        **({"unique_track_rows": args.unique_track_rows}
-           if args.unique_track_rows is not None else {}),
-        checkpoint_path=args.checkpoint,
-        checkpoint_every_batches=args.checkpoint_every,
-        compile_cache_dir=cache_dir)
+    columns = None
+    if args.columns is not None:
+        # "" (e.g. an unset shell variable) must error, not silently
+        # profile everything — same outcome as "," or " "
+        columns = tuple(c.strip() for c in args.columns.split(",")
+                        if c.strip())
+        if not columns:
+            print("tpuprof: error: --columns needs at least one name",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        config = ProfilerConfig(
+            backend=args.backend, columns=columns,
+            bins=args.bins, corr_reject=args.corr_reject,
+            batch_rows=args.batch_rows, scan_batches=args.scan_batches,
+            prepare_workers=args.prepare_workers,
+            quantile_sketch_size=args.sketch_size,
+            hll_precision=args.hll_precision,
+            exact_passes=not args.single_pass,
+            spearman=args.spearman, unique_spill_dir=args.unique_spill_dir,
+            exact_distinct=args.exact_distinct,
+            **({"unique_track_rows": args.unique_track_rows}
+               if args.unique_track_rows is not None else {}),
+            checkpoint_path=args.checkpoint,
+            checkpoint_every_batches=args.checkpoint_every,
+            compile_cache_dir=cache_dir)
+    except ValueError as exc:
+        # config validation (duplicate --columns, bad thresholds, ...)
+        # speaks the CLI's error convention, not a traceback
+        print(f"tpuprof: error: {exc}", file=sys.stderr)
+        return 2
 
     t0 = time.perf_counter()
     with trace_to(args.trace):
         with phase_timer("profile"):
-            report = ProfileReport(args.source, config=config)
+            try:
+                report = ProfileReport(args.source, config=config)
+            except InputError as exc:
+                # user-input errors ONLY (unknown --columns names,
+                # checkpoint/source mismatch) speak the CLI convention;
+                # internal ValueErrors keep their traceback so real
+                # bugs stay diagnosable
+                print(f"tpuprof: error: {exc}", file=sys.stderr)
+                return 2
         # every host computes the complete merged stats (the cross-host
         # merges are allgathers), but only host 0 renders + writes —
         # N processes racing one output path helps nobody

@@ -22,6 +22,18 @@ class ProfilerConfig:
     sample_rows: int = 5            # head rows shown in the report
     top_freq: int = 10              # value-count rows shown per CAT column
     correlation_overrides: Optional[Sequence[str]] = None  # never reject these
+    columns: Optional[Sequence[str]] = None  # profile ONLY these columns,
+                                             # in this order (the reference's
+                                             # ``df.select(...)`` idiom —
+                                             # SURVEY §1).  Parquet sources
+                                             # read only the projected
+                                             # columns (I/O drops
+                                             # proportionally); unknown
+                                             # names raise.  Also the
+                                             # escape hatch for nested
+                                             # (list/struct/map) columns,
+                                             # whose stringified ingest is
+                                             # ~200x slower (PERF.md).
 
     # ---- warning thresholds (reference: messages derivation, SURVEY §2.1) -
     high_cardinality_threshold: int = 50     # CAT distinct count above => warn
@@ -154,6 +166,18 @@ class ProfilerConfig:
     def __post_init__(self) -> None:
         if self.bins < 1:
             raise ValueError("bins must be >= 1")
+        if self.columns is not None:
+            cols = tuple(self.columns)
+            if not cols:
+                raise ValueError(
+                    "columns must name at least one column (or be None "
+                    "to profile every column)")
+            if not all(isinstance(c, str) and c for c in cols):
+                raise ValueError("columns must be non-empty strings")
+            dupes = sorted({c for c in cols if cols.count(c) > 1})
+            if dupes:
+                raise ValueError(f"columns lists duplicates: {dupes}")
+            self.columns = cols
         if self.scan_batches < 1:
             raise ValueError("scan_batches must be >= 1")
         if self.stream_flush_rows is not None and self.stream_flush_rows < 1:

@@ -1,0 +1,14 @@
+"""Exception types.
+
+``InputError`` marks errors caused by what the USER asked for — an
+unknown ``columns=`` name, a checkpoint that does not match the current
+source/config — as opposed to internal failures.  The CLI reports
+InputError as a one-line ``tpuprof: error: ...`` with exit code 2;
+everything else keeps its traceback so real bugs stay diagnosable.
+Subclasses ValueError, so library callers that caught ValueError before
+keep working.
+"""
+
+
+class InputError(ValueError):
+    pass

@@ -25,7 +25,7 @@ instead of O(rows) (SURVEY §7.2's vectorize-before-C++ guidance).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pandas as pd
@@ -691,6 +691,22 @@ def _decode_threads() -> int:
     return min(os.cpu_count() or 1, 8)
 
 
+def validate_projection(columns: Sequence[str],
+                        available: Sequence[str]) -> List[str]:
+    """One shared gate for the ``columns=`` projection (TPU ingest and
+    the CPU oracle alike): unknown names raise the same error, from the
+    SCHEMA — before any data is read, so a misspelling never pays a
+    dataset scan."""
+    from tpuprof.errors import InputError
+    available = [str(c) for c in available]
+    unknown = [c for c in columns if c not in available]
+    if unknown:
+        raise InputError(
+            f"columns not in the source: {sorted(unknown)} "
+            f"(available: {sorted(set(available))})")
+    return list(columns)
+
+
 class ArrowIngest:
     """Normalize a source into repeatable streams of HostBatches.
 
@@ -699,7 +715,8 @@ class ArrowIngest:
     never materialized — SURVEY §7.2 '1B×200 memory')."""
 
     def __init__(self, source: Any, batch_rows: int, max_retries: int = 2,
-                 process_shard: Tuple[int, int] = (0, 1)):
+                 process_shard: Tuple[int, int] = (0, 1),
+                 columns: Optional[Sequence[str]] = None):
         self.batch_rows = int(batch_rows)
         self.max_retries = int(max_retries)
         # (process_index, process_count): multi-host runs stripe dataset
@@ -721,8 +738,25 @@ class ArrowIngest:
             raise TypeError(
                 f"cannot ingest {type(source)!r}; expected DataFrame, "
                 f"pyarrow Table/RecordBatch/Dataset, or a Parquet path")
+        full_schema = (self._table.schema if self._table is not None
+                       else self._dataset.schema)
+        # column projection (the reference's df.select idiom): everything
+        # downstream — the plan, the fingerprint, the raw batch streams,
+        # the sample — sees only the projection, in the caller's order.
+        # File-backed datasets push it into the scanner, so parquet reads
+        # skip the excluded columns' pages entirely (the nested-column
+        # escape hatch: an excluded list<...> column costs zero I/O).
+        self._columns: Optional[List[str]] = None
+        if columns is not None:
+            self._columns = validate_projection(columns, full_schema.names)
+            if self._table is not None:
+                self._table = self._table.select(self._columns)
+            else:
+                full_schema = pa.schema([full_schema.field(c)
+                                         for c in self._columns])
         arrow_schema = (self._table.schema if self._table is not None
-                        else self._dataset.schema)
+                        else full_schema)
+        self.arrow_schema = arrow_schema
         self.plan = ColumnPlan.from_schema(arrow_schema)
         self.rescannable = True
         self.fragments_opened = 0   # observability: I/O units touched
@@ -744,9 +778,10 @@ class ArrowIngest:
         different dataset."""
         import hashlib
         h = hashlib.sha256()
-        schema = (self._table.schema if self._table is not None
-                  else self._dataset.schema)
-        for field in schema:
+        # the PROJECTED schema: profiling the same files with a different
+        # column selection is a different scan (cursors count different
+        # batch contents), so resume must reject the mix
+        for field in self.arrow_schema:
             t = field.type
             if isinstance(t, pa.DictionaryType):
                 # dictionary encoding is a READER choice (e.g. the
@@ -797,7 +832,8 @@ class ArrowIngest:
         if pcount == 1:
             try:
                 for rb in self._dataset.to_batches(
-                        batch_size=self.batch_rows):
+                        batch_size=self.batch_rows,
+                        columns=self._columns):
                     yield rb
                     delivered += 1
                 return
@@ -875,7 +911,8 @@ class ArrowIngest:
             for attempt in range(self.max_retries + 1):
                 try:
                     for bi, rb in enumerate(
-                            fragment.to_batches(batch_size=self.batch_rows)):
+                            fragment.to_batches(batch_size=self.batch_rows,
+                                                columns=self._columns)):
                         if bi < delivered:
                             continue        # already yielded pre-failure
                         yield fi, bi, rb
@@ -895,4 +932,4 @@ class ArrowIngest:
     def sample(self, n_rows: int) -> pd.DataFrame:
         if self._table is not None:
             return self._table.slice(0, n_rows).to_pandas()
-        return self._dataset.head(n_rows).to_pandas()
+        return self._dataset.head(n_rows, columns=self._columns).to_pandas()

@@ -28,6 +28,9 @@ def json_scalar(value: Any) -> Any:
     """One value → its JSON-safe raw form (no human formatting)."""
     if value is None or value is pd.NaT:
         return None
+    if isinstance(value, (tuple, list)):
+        # e.g. a CORR message's (partner_column, rho) — keep it structured
+        return [json_scalar(v) for v in value]
     if isinstance(value, (bool, np.bool_)):
         return bool(value)
     if isinstance(value, (int, np.integer)):
@@ -77,9 +80,10 @@ def stats_to_json(stats: Dict[str, Any]) -> Dict[str, Any]:
             for m in stats.get("messages", ())],
     }
     sample = stats.get("sample")
-    if sample is None or len(sample) == 0:
+    if sample is None:
         out["sample"] = {"columns": [], "rows": []}
     else:
+        # an empty source still names its columns — only rows go empty
         out["sample"] = {
             "columns": [str(c) for c in sample.columns],
             "rows": [[json_scalar(v) for v in row]
