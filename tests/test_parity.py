@@ -1,0 +1,166 @@
+"""The one-flag parity switch (VERDICT r4 #3): ``parity=True`` /
+``--parity`` = reference semantics, exactly — exact distinct counts for
+every column (Spark countDistinct, no HLL estimate anywhere), the exact
+second pass, and Spearman — with the spill dir auto-derived under
+TMPDIR and removed after the profile."""
+
+import glob
+import json
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfileReport, ProfilerConfig
+from tpuprof.cli import main
+
+
+@pytest.fixture
+def frame():
+    rng = np.random.default_rng(11)
+    n = 4000
+    return pd.DataFrame({
+        "x": rng.normal(size=n),
+        "y": rng.exponential(size=n),
+        # cardinality beyond the tracking budget below: forces the spill
+        # tier, so exactness here proves the auto-derived dir works
+        "hicard": [f"k{i:06d}" for i in rng.integers(0, 3200, n)],
+        "cat": rng.choice(["a", "b", "c"], n),
+    })
+
+
+def test_parity_exact_everywhere_and_no_residue(frame):
+    cfg = ProfilerConfig(backend="tpu", batch_rows=512, parity=True,
+                         unique_track_rows=300)
+    assert cfg.exact_distinct and cfg.spearman and cfg.exact_passes
+    assert cfg.unique_spill_dir and cfg.spill_dir_auto
+    # ONE well-known per-user dir (not uuid-per-run): a crashed run's
+    # litter is reclaimed by the next parity run's age-gated sweep, and
+    # per-user keeps a multi-user host's /tmp permissions out of it
+    assert cfg.unique_spill_dir == os.path.join(
+        tempfile.gettempdir(), f"tpuprof-parity-{os.getuid()}")
+    report = ProfileReport(frame, config=cfg)
+    variables = report.description["variables"]
+    truth = frame.nunique()
+    for col, v in variables.items():
+        assert v["distinct_approx"] is False, col
+        assert v["distinct_count"] == truth[col], col
+    assert "spearman" in report.description["correlations"]
+    assert report.description["correlations"]["spearman"].attrs.get(
+        "approx", False) is False
+    # no run files left; the dir itself is rmdir'd once it empties
+    # (another process may hold it open with ITS runs — then it stays)
+    leftover = glob.glob(os.path.join(cfg.unique_spill_dir, "*.u64"))
+    assert leftover == []
+
+
+def test_crashed_parity_litter_reclaimed_by_next_run(frame):
+    """A killed parity run's spill files age out and the NEXT parity
+    run's cleanup sweep reclaims them (same well-known dir), so TMPDIR
+    never accumulates unbounded litter."""
+    import time
+
+    from tpuprof.kernels import unique as kunique
+    cfg = ProfilerConfig(backend="tpu", batch_rows=512, parity=True,
+                         unique_track_rows=300)
+    os.makedirs(cfg.unique_spill_dir, exist_ok=True)
+    stale = os.path.join(cfg.unique_spill_dir,
+                         "tpuprof-uniq-deadcrash0001-0.u64")
+    np.arange(8, dtype=np.uint64).tofile(stale)
+    old = time.time() - kunique.ORPHAN_SWEEP_AGE_S - 60
+    os.utime(stale, (old, old))
+    ProfileReport(frame, config=cfg)
+    assert not os.path.exists(stale)
+
+
+def test_parity_respects_explicit_spill_dir(frame, tmp_path):
+    spill = tmp_path / "user-spill"
+    spill.mkdir()
+    cfg = ProfilerConfig(backend="tpu", batch_rows=512, parity=True,
+                         unique_track_rows=300,
+                         unique_spill_dir=str(spill))
+    assert not cfg.spill_dir_auto
+    ProfileReport(frame, config=cfg)
+    # run files are reclaimed, but the USER'S directory survives
+    assert spill.is_dir() and not list(spill.glob("*.u64"))
+
+
+def test_parity_rejects_single_pass():
+    with pytest.raises(ValueError, match="single-pass"):
+        ProfilerConfig(parity=True, exact_passes=False)
+
+
+def test_streaming_rejects_parity():
+    import pyarrow as pa
+
+    from tpuprof import InputError
+    from tpuprof.runtime.stream import StreamingProfiler
+    with pytest.raises(InputError, match="not supported for streaming"):
+        StreamingProfiler(pa.schema([("x", pa.float64())]),
+                          ProfilerConfig(parity=True))
+
+
+def test_streaming_honors_columns():
+    """A projection set on the config must not be silently ignored by
+    the stream: the plan covers only the projection and extra columns
+    in each micro-batch are dropped."""
+    import pyarrow as pa
+
+    from tpuprof.runtime.stream import StreamingProfiler
+    schema_ = pa.schema([("x", pa.float64()), ("y", pa.float64()),
+                         ("c", pa.string())])
+    cfg = ProfilerConfig(batch_rows=512, columns=("x", "c"))
+    prof = StreamingProfiler(schema_, cfg)
+    rng = np.random.default_rng(15)
+    for _ in range(3):
+        prof.update(pd.DataFrame({"x": rng.normal(size=400),
+                                  "y": rng.normal(size=400),
+                                  "c": rng.choice(["a", "b"], 400)}))
+    stats = prof.stats()
+    assert sorted(stats["variables"]) == ["c", "x"]
+    assert stats["table"]["n"] == 1200
+
+
+def test_cli_multihost_parity_requires_shared_spill_dir(tmp_path):
+    """--parity's auto dir is host-local; a multi-host fleet using it
+    would silently lose cross-host exactness, so the CLI refuses (fast,
+    before jax.distributed would block on peers)."""
+    rc = main(["profile", str(tmp_path / "d"), "-o", str(tmp_path / "r"),
+               "--parity", "--coordinator", "localhost:1",
+               "--num-processes", "2", "--process-id", "0"])
+    assert rc == 2
+
+
+def test_dataframe_projection_skips_arrow_conversion():
+    """Excluded DataFrame columns must not pay from_pandas: a column
+    whose Arrow conversion would CRASH profiles fine once projected
+    away (the in-memory analogue of never reading parquet pages)."""
+    class Unconvertible:
+        pass
+
+    df = pd.DataFrame({"num": [1.0, 2.0, 3.0],
+                       "bad": [Unconvertible() for _ in range(3)]})
+    report = ProfileReport(df, backend="tpu", batch_rows=512,
+                           columns=["num"])
+    assert list(report.description["variables"].keys()) == ["num"]
+
+
+def test_cli_parity(frame, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(frame, preserve_index=False), path)
+    out = str(tmp_path / "r.html")
+    sj = str(tmp_path / "s.json")
+    rc = main(["profile", path, "-o", out, "--backend", "tpu",
+               "--batch-rows", "512", "--unique-track-rows", "300",
+               "--parity", "--stats-json", sj, "--no-compile-cache"])
+    assert rc == 0
+    payload = json.load(open(sj))
+    assert all(v["distinct_approx"] == "False"
+               for v in payload["variables"].values())
+    assert "spearman" in payload["correlations"]
+    assert main(["profile", path, "-o", out, "--parity",
+                 "--single-pass"]) == 2

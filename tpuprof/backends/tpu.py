@@ -87,7 +87,8 @@ class HostAgg:
                               else plan.by_role("cat"))),
             config.unique_track_rows, config.unique_track_total_rows,
             spill_dir=config.unique_spill_dir,
-            count_exact=config.exact_distinct)
+            count_exact=config.exact_distinct,
+            own_spill_dir=getattr(config, "spill_dir_auto", False))
         # num/date columns whose exact counting expects full hashes on
         # every batch (coverage gap => honest deactivation)
         self._numdate_tracked = [s.name for s in plan.specs

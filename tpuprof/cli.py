@@ -69,6 +69,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="count distincts exactly for every column at any "
                         "size (needs --unique-spill-dir; 8 bytes per "
                         "distinct value per column of disk)")
+    p.add_argument("--parity", action="store_true",
+                   help="reference semantics, exactly, in one switch: "
+                        "exact distinct counts for every column (no HLL "
+                        "estimate anywhere), exact histograms/top-k "
+                        "(second pass), and Spearman.  Auto-derives a "
+                        "spill dir under TMPDIR when --unique-spill-dir "
+                        "is not given (8 bytes per distinct value per "
+                        "column; removed after the profile).  Multi-host "
+                        "runs should still pass --unique-spill-dir on "
+                        "shared storage.")
     p.add_argument("--checkpoint", metavar="PATH",
                    help="persist the scan every N batches and resume "
                         "from PATH after a crash (multi-host: each host "
@@ -104,11 +114,10 @@ def cmd_profile(args: argparse.Namespace) -> int:
     from tpuprof.errors import InputError
     from tpuprof.utils.trace import phase_timer, trace_to
 
-    if args.exact_distinct and not args.unique_spill_dir:
-        print("tpuprof: error: --exact-distinct requires "
-              "--unique-spill-dir (exact counting must be able to "
-              "spill past the RAM budget)", file=sys.stderr)
-        return 2
+    # flag-interaction constraints (--exact-distinct without a spill
+    # dir, --parity with --single-pass, ...) are enforced ONCE, by
+    # ProfilerConfig.__post_init__; its ValueError is reported through
+    # the config try/except below in the CLI's error convention
 
     multi_host = args.coordinator is not None \
         or args.num_processes is not None or args.process_id is not None
@@ -124,6 +133,17 @@ def cmd_profile(args: argparse.Namespace) -> int:
                   "striping — every process would profile the whole "
                   "dataset; multi-host requires the tpu engine (which "
                   "also runs on CPU devices)", file=sys.stderr)
+            return 2
+        if args.parity and not args.unique_spill_dir:
+            # config's auto-derived dir is HOST-LOCAL; the cross-host
+            # merge could not adopt peers' spill runs and exact distinct
+            # counts would silently degrade to estimates — the opposite
+            # of what --parity promises.  This is a cross-flag constraint
+            # config cannot see (it has no notion of multi-host).
+            print("tpuprof: error: multi-host --parity needs "
+                  "--unique-spill-dir on storage SHARED by all hosts "
+                  "(the auto-derived TMPDIR dir is host-local)",
+                  file=sys.stderr)
             return 2
         # 'auto' could resolve to the pandas oracle on a CPU-only
         # cluster, which ignores process striping — the tpu engine is
@@ -152,14 +172,11 @@ def cmd_profile(args: argparse.Namespace) -> int:
 
     columns = None
     if args.columns is not None:
-        # "" (e.g. an unset shell variable) must error, not silently
-        # profile everything — same outcome as "," or " "
+        # "" (an unset shell variable) parses to an EMPTY tuple, which
+        # ProfilerConfig rejects below — same outcome as "," or " ",
+        # never a silent full profile
         columns = tuple(c.strip() for c in args.columns.split(",")
                         if c.strip())
-        if not columns:
-            print("tpuprof: error: --columns needs at least one name",
-                  file=sys.stderr)
-            return 2
 
     try:
         config = ProfilerConfig(
@@ -171,7 +188,7 @@ def cmd_profile(args: argparse.Namespace) -> int:
             hll_precision=args.hll_precision,
             exact_passes=not args.single_pass,
             spearman=args.spearman, unique_spill_dir=args.unique_spill_dir,
-            exact_distinct=args.exact_distinct,
+            exact_distinct=args.exact_distinct, parity=args.parity,
             **({"unique_track_rows": args.unique_track_rows}
                if args.unique_track_rows is not None else {}),
             checkpoint_path=args.checkpoint,

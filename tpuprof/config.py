@@ -41,6 +41,20 @@ class ProfilerConfig:
     zeros_threshold: float = 0.5             # p_zeros above => warn
     skewness_threshold: float = 20.0         # |skew| above => warn
 
+    # ---- reference semantics, exactly, in one switch ----------------------
+    parity: bool = False    # "give me what the reference would have said":
+                            # exact_distinct (Spark countDistinct — no HLL
+                            # estimate anywhere) + the exact second pass
+                            # (exact histograms / top-k recounts) +
+                            # Spearman.  When no unique_spill_dir is set,
+                            # one is auto-derived under TMPDIR (disk cost:
+                            # 8 B per distinct value per column) and
+                            # removed after the profile.  Multi-host runs
+                            # should still point unique_spill_dir at
+                            # SHARED storage — a host-local auto dir
+                            # degrades cross-host UNIQUE exactness
+                            # honestly at merge time.
+
     # ---- backend selection ------------------------------------------------
     backend: str = "auto"           # "auto" | "cpu" | "tpu"
 
@@ -82,6 +96,11 @@ class ProfilerConfig:
                                             # None keeps the bounded
                                             # in-memory tier with the
                                             # HLL-estimate fallback
+    spill_dir_auto: bool = False    # unique_spill_dir was derived by
+                                    # parity (not user-chosen): the
+                                    # tracker may remove the DIRECTORY
+                                    # itself at cleanup, not just the
+                                    # run files
     exact_distinct: bool = False    # count distincts EXACTLY for every
                                     # tracked CAT column at any n (the
                                     # reference's countDistinct semantics,
@@ -184,11 +203,40 @@ class ProfilerConfig:
             raise ValueError("stream_flush_rows must be >= 1 (or None)")
         if self.prepare_workers is not None and self.prepare_workers < 1:
             raise ValueError("prepare_workers must be >= 1 (or None)")
+        if self.parity:
+            if not self.exact_passes:
+                raise ValueError(
+                    "parity conflicts with single-pass mode "
+                    "(exact_passes=False): the reference's histograms "
+                    "and top-k counts are exact, which needs the "
+                    "second scan")
+            self.exact_distinct = True
+            self.spearman = True
+            if self.unique_spill_dir is None:
+                # ONE well-known dir, not a uuid-per-run dir: run files
+                # are already isolated by per-tracker filename tokens,
+                # and a crashed run's litter here is reclaimed by the
+                # NEXT parity run's age-gated orphan sweep — a per-run
+                # dir would never be revisited and leak forever.
+                # Nothing is created until a column actually spills;
+                # cleanup rmdirs the dir when it empties (own_spill_dir)
+                import os
+                import tempfile
+                # per-user: a world-shared fixed path would hand user
+                # B an EACCES on user A's 0755 dir (and be symlink-
+                # squattable), silently demoting the exactness the flag
+                # exists for
+                uid = os.getuid() if hasattr(os, "getuid") else "u"
+                self.unique_spill_dir = os.path.join(
+                    tempfile.gettempdir(), f"tpuprof-parity-{uid}")
+                self.spill_dir_auto = True
         if self.exact_distinct and not self.unique_spill_dir:
             raise ValueError(
-                "exact_distinct needs unique_spill_dir: exact counting "
-                "stores 8 bytes per distinct value per column, which "
-                "must be able to spill past the RAM budget")
+                "exact_distinct needs unique_spill_dir (CLI: "
+                "--unique-spill-dir, or --parity which derives one): "
+                "exact counting stores 8 bytes per distinct value per "
+                "column, which must be able to spill past the RAM "
+                "budget")
         if self.exact_distinct and (self.unique_track_rows <= 0
                                     or self.unique_track_total_rows <= 0):
             raise ValueError(

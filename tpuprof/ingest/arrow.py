@@ -725,6 +725,15 @@ class ArrowIngest:
         self._table: Optional[pa.Table] = None
         self._dataset: Optional[pads.Dataset] = None
         if isinstance(source, pd.DataFrame):
+            if columns is not None:
+                # project BEFORE Arrow conversion: the excluded columns
+                # (possibly nested/object — the escape-hatch case) must
+                # not pay from_pandas.  Labels match on their stringified
+                # names (what the converted schema would carry)
+                validate_projection(columns, source.columns)
+                by_str = {str(c): c for c in source.columns}
+                source = source[[by_str[c] for c in columns]]
+                columns = None          # applied; skip the generic path
             self._table = pa.Table.from_pandas(source, preserve_index=False)
         elif isinstance(source, pa.Table):
             self._table = source

@@ -91,10 +91,16 @@ class UniqueTracker:
     def __init__(self, names: Iterable[str], budget_rows: int,
                  total_budget_rows: int,
                  spill_dir: Optional[str] = None,
-                 count_exact: bool = False):
+                 count_exact: bool = False,
+                 own_spill_dir: bool = False):
         self.budget = int(budget_rows)
         self.total_budget = int(total_budget_rows)
         self.spill_dir = spill_dir
+        # True when the DIRECTORY was auto-derived for this profile
+        # (config.parity), not user-chosen: cleanup may remove it, not
+        # just the run files — a user's (possibly shared) dir is never
+        # touched
+        self.own_spill_dir = bool(own_spill_dir)
         names = list(names)
         self.status: Dict[str, str] = {}
         self._chunks: Dict[str, List[np.ndarray]] = {}
@@ -248,8 +254,17 @@ class UniqueTracker:
             f"tpuprof-uniq-{self._spill_token}-{self._spill_seq}.u64")
         self._spill_seq += 1
         try:
-            os.makedirs(self.spill_dir, exist_ok=True)
-            merged.tofile(path)
+            # two attempts: a concurrent profile sharing the dir (e.g.
+            # the fixed parity dir) may rmdir it between our makedirs
+            # and tofile — recreating once makes that race harmless
+            for attempt in (0, 1):
+                os.makedirs(self.spill_dir, exist_ok=True)
+                try:
+                    merged.tofile(path)
+                    break
+                except OSError:
+                    if attempt:
+                        raise
         except OSError as exc:
             # the user explicitly asked for exactness — a full/unwritable
             # spill disk must not demote silently; also reap the partial
@@ -477,6 +492,14 @@ class UniqueTracker:
                 try:
                     if os.path.getmtime(path) < stale_before:
                         os.remove(path)
+                except OSError:
+                    pass
+            if getattr(self, "own_spill_dir", False):
+                # an auto-derived (parity) dir leaves no residue; rmdir
+                # refuses non-empty, so a concurrent writer's young runs
+                # keep the dir alive
+                try:
+                    os.rmdir(self.spill_dir)
                 except OSError:
                     pass
 

@@ -56,6 +56,22 @@ def _to_record_batches(batch: Any, schema: Optional[pa.Schema]):
     raise TypeError(f"cannot stream {type(batch)!r}")
 
 
+def _project_batch(batch: Any, cols: Sequence[str]) -> Any:
+    """Drop columns outside the profiler's projection from an incoming
+    micro-batch.  A batch MISSING a projected column passes through
+    untouched so the stream-schema mismatch error names the problem."""
+    if isinstance(batch, pd.DataFrame):
+        by_str = {str(c): c for c in batch.columns}
+        if all(c in by_str for c in cols):
+            return batch[[by_str[c] for c in cols]]
+        return batch
+    if isinstance(batch, (pa.Table, pa.RecordBatch)):
+        if all(c in batch.schema.names for c in cols):
+            return batch.select(list(cols))
+        return batch
+    return batch
+
+
 class StreamingProfiler:
     """A live, mergeable profile over an unbounded stream.
 
@@ -69,8 +85,29 @@ class StreamingProfiler:
                  config: Optional[ProfilerConfig] = None,
                  devices: Optional[Sequence] = None):
         import dataclasses
+
+        from tpuprof.errors import InputError
+        config = config or ProfilerConfig()
+        if config.parity:
+            # be honest BEFORE the internal exact_passes=False replace
+            # re-runs validation and blames "single-pass mode" for an
+            # option the user never set
+            raise InputError(
+                "parity is not supported for streaming: an unbounded "
+                "stream has no second exact pass (histograms/top-k stay "
+                "sketch-derived).  For the stream's exact tier set "
+                "exact_distinct=True (with unique_spill_dir) and "
+                "spearman=True explicitly")
         self.config = dataclasses.replace(    # streaming is single-pass
-            config or ProfilerConfig(), exact_passes=False)
+            config, exact_passes=False)
+        if self.config.columns is not None:
+            # the projection idiom works for streams too: plan (and all
+            # sketch lanes) cover only the projection, and update()
+            # drops extra columns from each micro-batch
+            from tpuprof.ingest.arrow import validate_projection
+            cols = validate_projection(self.config.columns,
+                                       arrow_schema.names)
+            arrow_schema = pa.schema([arrow_schema.field(c) for c in cols])
         self.arrow_schema = arrow_schema
         self.plan = ColumnPlan.from_schema(arrow_schema)
         self.runner = MeshRunner(self.config, self.plan.n_num,
@@ -126,6 +163,8 @@ class StreamingProfiler:
         """Buffer one micro-batch (pandas DataFrame / Arrow Table or
         RecordBatch); folds into the device state whenever a full flush
         quantum has accumulated."""
+        if self.config.columns is not None:
+            batch = _project_batch(batch, self.config.columns)
         for rb in _to_record_batches(batch, self.arrow_schema):
             if self._sample is None or len(self._sample) < \
                     self.config.sample_rows:
