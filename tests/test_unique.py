@@ -620,7 +620,7 @@ class TestExactDistinct:
         t = self._tracker(tmp_path)
         for i in range(0, vals.size, 500):
             t.update("c", vals[i:i + 500])
-        assert t.status["c"] == kunique.DUP           # claim settled...
+        assert t.resolve()["c"] == kunique.DUP        # claim settled...
         assert len(t._runs["c"]) >= 2                 # ...spills happened
         truth = len(np.unique(vals))
         assert t.distinct_counts()["c"] == truth
@@ -652,7 +652,7 @@ class TestExactDistinct:
         a.merge(b)
         truth = len(np.unique(np.concatenate([a_vals, b_vals])))
         assert a.distinct_counts()["c"] == truth
-        assert a.status["c"] == kunique.DUP
+        assert a.resolve()["c"] == kunique.DUP
 
     def test_counting_off_without_spill_dir(self):
         t = kunique.UniqueTracker(["c"], 400, 1 << 30, count_exact=True)
@@ -691,29 +691,118 @@ class TestExactDistinct:
         with pytest.raises(ValueError, match="unique_spill_dir"):
             ProfilerConfig(exact_distinct=True)
 
-    def test_storage_abort_preserves_settled_dup(self, tmp_path):
-        """A settled DUP verdict survives counting-storage aborts (spill
-        failure, hashless batch, kind clash): opting into exact counts
-        must never downgrade an exact claim to OVERFLOW (review r4)."""
+    def test_storage_abort_preserves_dup_in_evidence(self, tmp_path):
+        """A DUP verdict already IN EVIDENCE survives counting-storage
+        aborts (spill failure, hashless batch, kind clash): opting into
+        exact counts must never downgrade a claim the data on hand
+        settles (review r4).  The lazy tier settles claims at resolve,
+        so the abort pays one best-effort walk over the buffered rows."""
         t = self._tracker(tmp_path)
         t.update("c", np.array([5, 5], dtype=np.uint64))
-        assert t.status["c"] == kunique.DUP and t._counting["c"]
+        assert t._counting["c"]
         t.deactivate("c")                      # e.g. a hashless batch
-        assert t.status["c"] == kunique.DUP    # claim kept
+        assert t.status["c"] == kunique.DUP    # dup in buffer => final
         assert not t._counting["c"]
         assert t.distinct_counts() == {}       # count honestly dropped
-        # kind clash path
+        # kind clash path: the dup was observed within ONE kind's rows
         t2 = self._tracker(tmp_path)
         t2.update("c", np.array([5, 5], dtype=np.uint64),
                   hash_kind="native")
         t2.update("c", np.array([9], dtype=np.uint64),
                   hash_kind="pandas")
         assert t2.status["c"] == kunique.DUP
-        # a UNIQUE-status column still demotes to OVERFLOW as before
+        # a cross-EPOCH duplicate (buffer + spilled run) also counts as
+        # evidence: the walk unions runs with the live buffer
+        t4 = self._tracker(tmp_path)
+        t4.update("c", np.arange(0, 401, dtype=np.uint64))   # spills
+        assert t4._runs["c"]
+        t4.update("c", np.array([7], dtype=np.uint64))       # dup vs run
+        t4.deactivate("c")
+        assert t4.status["c"] == kunique.DUP
+        # a genuinely all-unique column still demotes to OVERFLOW: the
+        # claim is not refuted, but future coverage is gone
         t3 = self._tracker(tmp_path)
         t3.update("c", np.arange(10, dtype=np.uint64))
         t3.deactivate("c")
         assert t3.status["c"] == kunique.OVERFLOW
+
+    def test_dup_heavy_column_compacts_in_memory_without_spilling(
+            self, tmp_path):
+        """Low-cardinality columns must not shed one tiny run file per
+        budget of raw rows: the lazy tier dedups the buffer in memory
+        first and only spills what stays large (review r5)."""
+        rng = np.random.default_rng(17)
+        t = self._tracker(tmp_path)            # budget=400
+        vals = rng.integers(0, 2, 10_000).astype(np.uint64)
+        for i in range(0, vals.size, 500):
+            t.update("c", vals[i:i + 500])
+        assert t._runs["c"] == [], "2-distinct column wrote spill runs"
+        assert t.distinct_counts()["c"] == 2
+        assert t.resolve()["c"] == kunique.DUP
+        # distinct-heavy columns still spill (disk is the point there)
+        t2 = self._tracker(tmp_path)
+        t2.update("c", np.arange(0, 401, dtype=np.uint64))
+        assert t2._runs["c"]
+
+    def test_lost_runs_on_resume_never_fake_a_dup(self, tmp_path):
+        """Resume where the spill dir is invisible: the best-effort
+        claim walk must NOT run against the partial union (live buffer
+        only) — an all-unique column degrades to OVERFLOW, never to a
+        false 'exact' DUP (review r5)."""
+        import pickle
+        t = self._tracker(tmp_path)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))     # spills
+        t.update("c", np.arange(1000, 1099, dtype=np.uint64))  # buffered
+        t.persistent = True
+        blob = pickle.dumps(t)
+        for p, _rows in t._runs["c"]:
+            import os
+            os.remove(p)
+        t2 = pickle.loads(blob)
+        assert t2.status["c"] == kunique.OVERFLOW
+        assert t2.resolve()["c"] == kunique.OVERFLOW
+
+    def test_merge_counting_mismatch_keeps_dup_evidence(self, tmp_path):
+        """Counting x non-counting merge flips counting off; the lazy
+        tier's raw buffer must be normalized on the way out — a dup
+        already buffered settles DUP, and a cross-tracker dup is still
+        caught by the probe (review r5)."""
+        # in-buffer dup on the counting side
+        a = self._tracker(tmp_path)
+        a.update("c", np.array([900, 450, 800, 450], dtype=np.uint64))
+        b = kunique.UniqueTracker(["c"], 400, 1 << 30,
+                                  spill_dir=str(tmp_path / "sp2"))
+        b.update("c", np.array([1], dtype=np.uint64))
+        a.merge(b)
+        assert a.resolve()["c"] == kunique.DUP
+        # cross-tracker dup against the (normalized) buffer
+        a2 = self._tracker(tmp_path)
+        a2.update("c", np.array([900, 450, 800], dtype=np.uint64))
+        b2 = kunique.UniqueTracker(["c"], 400, 1 << 30,
+                                   spill_dir=str(tmp_path / "sp3"))
+        b2.update("c", np.array([450], dtype=np.uint64))
+        a2.merge(b2)
+        assert a2.resolve()["c"] == kunique.DUP
+
+    def test_vanished_run_keeps_settled_dup_and_is_stable(self, tmp_path):
+        """A DUP claim already in evidence survives a vanished run, and
+        resolve() answers the SAME verdict on every call (review r5)."""
+        import os
+        t = self._tracker(tmp_path)
+        t.update("c", np.arange(0, 401, dtype=np.uint64))     # spills
+        t.status["c"] = kunique.DUP       # e.g. merged-in peer verdict
+        for p, _rows in list(t._runs["c"]):
+            os.remove(p)
+        first = t.resolve()["c"]
+        second = t.resolve()["c"]
+        assert first == second == kunique.DUP
+        # without the settled claim the same loss is an honest OVERFLOW
+        t2 = self._tracker(tmp_path)
+        t2.update("c", np.arange(0, 401, dtype=np.uint64))
+        for p, _rows in list(t2._runs["c"]):
+            os.remove(p)
+        assert t2.resolve()["c"] == kunique.OVERFLOW
+        assert t2.resolve()["c"] == kunique.OVERFLOW
 
     def test_streaming_exact_distinct(self, tmp_path):
         """StreamingProfiler inherits exact counting: snapshots carry

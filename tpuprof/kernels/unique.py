@@ -79,11 +79,14 @@ class UniqueTracker:
 
     Counting mode (config.exact_distinct; needs a spill dir): instead of
     demoting a column on its first duplicate, the tracker keeps folding
-    — every batch is deduplicated against the live chunks (so in-memory
-    storage is per-epoch-distinct, not per-row), epochs spill to sorted
-    runs as usual, and ``distinct_counts()`` k-way-merges runs + chunks
-    by hash range to count the union exactly.  This exceeds the
-    sanctioned HLL deviation (SURVEY §7.2): the reference's
+    — LAZILY.  Batches append raw (unsorted, dup-included) to the live
+    buffer; spills np.unique the buffer into a sorted dup-free run; and
+    ``distinct_counts()`` k-way-merges runs + unique'd buffers by hash
+    range to count the union exactly.  The UNIQUE/DUP claim is settled
+    at resolve by the count-vs-rows-fed comparison (``_fed``), not by
+    per-batch probes — dropping the per-batch sort+probe cut the
+    wide-numeric (200-column) overhead ~3x (PERF.md round 5).  This
+    exceeds the sanctioned HLL deviation (SURVEY §7.2): the reference's
     ``countDistinct`` exactness is restored for every tracked column,
     up to 64-bit hash collisions (~n²/2⁶⁵ — the same collision contract
     the UNIQUE/DUP claims already carry)."""
@@ -138,6 +141,9 @@ class UniqueTracker:
         counting = bool(count_exact) and spill_dir is not None \
             and not disabled
         self._counting: Dict[str, bool] = {}
+        # raw valid rows ever fed per counting column (duplicates
+        # included): the lazy tier's UNIQUE claim is count == fed
+        self._fed: Dict[str, int] = {}
         for n in names:
             self.status[n] = OVERFLOW if disabled else UNIQUE
             self._chunks[n] = []
@@ -145,6 +151,7 @@ class UniqueTracker:
             self._kind[n] = ""
             self._runs[n] = []
             self._counting[n] = counting
+            self._fed[n] = 0
 
     def active(self, name: str) -> bool:
         """True while this column's hashes must keep flowing in: either
@@ -162,11 +169,22 @@ class UniqueTracker:
     def _demote(self, name: str, status: str) -> None:
         """Stop tracking a column and free its storage.  Counting always
         stops here (every demote path loses count coverage), and a
-        SETTLED DUP verdict survives a storage abort: demoting a
-        DUP-status counting column to OVERFLOW (spill failure, hashless
-        batch, kind clash, lost runs) would discard an exact-and-final
-        claim the non-counting mode preserves — opting into MORE
-        exactness must never report less."""
+        DUP verdict ALREADY IN EVIDENCE survives a storage abort:
+        demoting a counting column to OVERFLOW (spill failure, hashless
+        batch, kind clash) would discard a claim the data on hand
+        settles — opting into MORE exactness must never report less.
+        The lazy tier settles claims only at resolve, so an abort pays
+        one best-effort walk over what is buffered/spilled: a duplicate
+        found there is final regardless of the lost future coverage."""
+        if status == OVERFLOW and self._counting.get(name, False) \
+                and self.status.get(name) == UNIQUE \
+                and (self._chunks.get(name) or self._runs.get(name)):
+            try:
+                _st, cnt = self._resolve_spilled(name, count=True)
+                if cnt is not None and cnt < self._fed.get(name, cnt):
+                    status = DUP
+            except Exception:
+                pass        # best-effort only; OVERFLOW stays honest
         self._counting[name] = False
         if status == OVERFLOW and self.status.get(name) == DUP:
             status = DUP
@@ -245,10 +263,31 @@ class UniqueTracker:
                 except OSError:
                     pass
 
-    def _spill(self, name: str) -> bool:
-        """Write the column's consolidated in-memory chunk to a disk run
-        and free the memory; tracking continues in a fresh epoch."""
-        merged = np.sort(np.concatenate(self._chunks[name]))
+    def _compact_or_spill(self, name: str) -> bool:
+        """Budget relief for the lazy tier: dedup the raw buffer in
+        memory FIRST — a dup-heavy column shrinks far below budget and
+        never touches disk (matching the probed tier's near-zero spill
+        footprint, instead of one tiny run per budget of raw rows); only
+        a still-large distinct-heavy buffer pays a spill run."""
+        u = np.unique(np.concatenate(self._chunks[name]))
+        freed = self._rows[name] - int(u.size)
+        self._chunks[name] = [u]
+        self._rows[name] = int(u.size)
+        self._live -= freed
+        if self._rows[name] <= self.budget // 2 \
+                and self._live <= self.total_budget:
+            return True
+        return bool(self.spill_dir and self._spill(name, merged=u))
+
+    def _spill(self, name: str,
+               merged: Optional[np.ndarray] = None) -> bool:
+        """Write the column's consolidated in-memory chunks to a disk
+        run (sorted, internally dup-free — np.unique also dedups the
+        lazy tier's raw buffers) and free the memory; tracking continues
+        in a fresh epoch.  ``merged`` skips the re-dedup when the caller
+        just computed it (_compact_or_spill)."""
+        if merged is None:
+            merged = np.unique(np.concatenate(self._chunks[name]))
         path = os.path.join(
             self.spill_dir,
             f"tpuprof-uniq-{self._spill_token}-{self._spill_seq}.u64")
@@ -305,9 +344,30 @@ class UniqueTracker:
                 self._demote(name, OVERFLOW)
                 return
             self._kind[name] = hash_kind
+        if counting:
+            # LAZY exact-count tier (round 5): append the raw hashes and
+            # defer every sort/dedup to spill time and the resolve walk.
+            # Counting mode never benefits from incremental duplicate
+            # detection — the count AND the UNIQUE/DUP claim both fall
+            # out of the union count (claim == no-dup <=> count equals
+            # rows fed, tracked in _fed).  The per-batch sort+probe this
+            # replaces made wide-numeric exact_distinct 14x the sketch
+            # tier (PERF.md round 5).
+            if h.base is not None:
+                h = h.copy()    # own the memory: a view pins its parent
+            self._fed[name] += h.size
+            self._chunks[name].append(h)
+            self._rows[name] += h.size      # RAW rows buffered (lazy
+            self._live += h.size            # tier), not distinct rows
+            if self._rows[name] > self.budget \
+                    or self._live > self.total_budget:
+                if not self._compact_or_spill(name):
+                    self._overflow_warn(name)
+                    self._demote(name, OVERFLOW)
+            return
         sh = np.sort(h)
-        # within-batch dedup (counting stores per-epoch DISTINCT values,
-        # so memory tracks cardinality, not row count)
+        # within-batch dedup (chunks store DISTINCT values, so memory
+        # tracks cardinality, not row count)
         dup = False
         if sh.size > 1:
             keep = np.empty(sh.size, dtype=bool)
@@ -328,10 +388,8 @@ class UniqueTracker:
                 dup = True
                 sh = sh[~hit]
         if dup:
-            if not counting:
-                self._demote(name, DUP)
-                return
-            self.status[name] = DUP     # claim settled; count continues
+            self._demote(name, DUP)
+            return
         if not sh.size:
             return
         self._chunks[name].append(sh)
@@ -339,15 +397,7 @@ class UniqueTracker:
         self._live += sh.size
         if self._rows[name] > self.budget or self._live > self.total_budget:
             if not (self.spill_dir and self._spill(name)):
-                if not self.spill_dir:
-                    import logging
-                    logging.getLogger("tpuprof").warning(
-                        "column %r exceeded the exact-UNIQUE tracking "
-                        "budget (unique_track_rows=%d): its distinct "
-                        "count falls back to the HLL estimate.  Set "
-                        "unique_spill_dir (CLI: --unique-spill-dir) to "
-                        "keep the classification exact at any size "
-                        "(disk cost: 8 bytes/row)", name, self.budget)
+                self._overflow_warn(name)
                 self._demote(name, OVERFLOW)
             return
         if len(self._chunks[name]) > 8:
@@ -355,6 +405,17 @@ class UniqueTracker:
             # one sorted array (amortized O(n log n) per column)
             self._chunks[name] = [np.sort(np.concatenate(
                 self._chunks[name]))]
+
+    def _overflow_warn(self, name: str) -> None:
+        if not self.spill_dir:
+            import logging
+            logging.getLogger("tpuprof").warning(
+                "column %r exceeded the exact-UNIQUE tracking "
+                "budget (unique_track_rows=%d): its distinct "
+                "count falls back to the HLL estimate.  Set "
+                "unique_spill_dir (CLI: --unique-spill-dir) to "
+                "keep the classification exact at any size "
+                "(disk cost: 8 bytes/row)", name, self.budget)
 
     def resolve(self) -> Dict[str, str]:
         """Final per-column statuses, with spilled columns decided
@@ -369,34 +430,41 @@ class UniqueTracker:
         self.touch_runs()       # liveness signal: keep runs sweep-safe
         out = {}
         for name, st in self.status.items():
-            if st == UNIQUE and self._runs.get(name):
-                # counting columns want the count anyway — one disk walk
-                # serves both (the early DUP break would otherwise force
-                # distinct_counts() to re-read every run)
-                out[name] = self._resolve_spilled(
-                    name, count=self._counting.get(name, False))[0]
+            if self._counting.get(name, False) and st != OVERFLOW:
+                # lazy tier: the claim IS the count comparison — no dup
+                # was ever folded iff the union count equals the raw
+                # rows fed.  One walk serves claim and count (memoized
+                # for distinct_counts).
+                cnt = self._resolve_spilled(name, count=True)[1]
+                if cnt is None:
+                    # a run vanished — the exact COUNT is gone, but a
+                    # DUP claim already in evidence (merged-in peer,
+                    # restored artifact) is final and survives
+                    out[name] = DUP if st == DUP else OVERFLOW
+                elif st == DUP or cnt < self._fed.get(name, cnt):
+                    out[name] = DUP
+                else:
+                    out[name] = UNIQUE
+            elif st == UNIQUE and self._runs.get(name):
+                out[name] = self._resolve_spilled(name, count=False)[0]
             else:
                 out[name] = st
         return out
 
     def distinct_counts(self) -> Dict[str, int]:
         """EXACT distinct counts for columns still in counting mode
-        (count_exact), at any n.  Live chunks are mutually dup-free (the
-        update probe discards already-stored values), so a column with
-        no spilled runs counts as its live row total; spilled columns
-        count the union via the same hash-range k-way merge resolve()
-        uses.  Non-destructive and memoized alongside the status."""
+        (count_exact), at any n: the union of the (dup-free) spilled
+        runs and the np.unique of the lazy tier's raw live buffers, via
+        the hash-range k-way merge.  Non-destructive and memoized
+        alongside the claim."""
         self.touch_runs()       # liveness signal: keep runs sweep-safe
         out: Dict[str, int] = {}
         for name, counting in self._counting.items():
             if not counting or self.status.get(name) == OVERFLOW:
                 continue
-            if not self._runs.get(name):
-                out[name] = self._rows[name]
-            else:
-                _st, count = self._resolve_spilled(name, count=True)
-                if count is not None:
-                    out[name] = count
+            _st, count = self._resolve_spilled(name, count=True)
+            if count is not None:
+                out[name] = count
         return out
 
     def _resolve_spilled(self, name: str, count: bool = False
@@ -414,12 +482,22 @@ class UniqueTracker:
                                         shape=(rows,)))
             except (OSError, ValueError):
                 # a run vanished (tmp cleaner, resume on another box):
-                # the exact claim is gone — honest fallback
+                # the exact claim is gone — honest fallback.  Demote
+                # fully: the lazy tier's raw buffers must not survive
+                # into the probed paths, whose invariants (sorted,
+                # dup-free chunks) they violate (counting is flipped
+                # off FIRST so _demote skips its best-effort walk —
+                # a partial union would settle false DUPs)
                 self._counting[name] = False
                 self._resolve_memo[name] = (key, OVERFLOW, None)
+                self._demote(name, OVERFLOW)
                 return OVERFLOW, None
         if self._chunks[name]:
-            arrays.append(np.sort(np.concatenate(self._chunks[name])))
+            # np.unique: the lazy tier's live buffers hold raw rows —
+            # the walk's per-array invariant is sorted AND internally
+            # dup-free (probed-path chunks already are; unique is then
+            # equivalent to the old sort)
+            arrays.append(np.unique(np.concatenate(self._chunks[name])))
         total = sum(a.size for a in arrays)
         n_slices = max(1, -(-total // RESOLVE_SLICE_ROWS))
         step = (1 << 64) // n_slices
@@ -546,6 +624,15 @@ class UniqueTracker:
         self._spill_seq = 0
         if not hasattr(self, "_counting"):      # pre-counting artifacts
             self._counting = {n: False for n in self.status}
+        if not hasattr(self, "_fed"):
+            # pre-lazy artifacts (probed counting): chunks and runs are
+            # dup-free, so for a still-UNIQUE column the stored distinct
+            # total IS the raw total (no duplicate was ever folded);
+            # DUP-status columns' claims are already settled and their
+            # resolve never consults _fed
+            self._fed = {n: self._rows.get(n, 0)
+                         + sum(r for _p, r in self._runs.get(n, ()))
+                         for n in self.status}
         self._last_touch = 0.0
         lost = []
         for name, runs in list(self._runs.items()):
@@ -560,8 +647,12 @@ class UniqueTracker:
                     # the run list BEFORE demoting: an unpickled copy
                     # owns none of these files, and _drop_runs deleting
                     # the survivors would destroy state a still-live
-                    # writer references
+                    # writer references.  Counting flips off FIRST:
+                    # _demote's best-effort claim walk would otherwise
+                    # see only the live buffer (the runs are gone) and
+                    # settle a FALSE DUP from the partial union
                     self._runs[name] = []
+                    self._counting[name] = False
                     self._demote(name, OVERFLOW)
                     lost.append(name)
                     break
@@ -603,6 +694,28 @@ class UniqueTracker:
         self._owned = [p for runs in self._runs.values()
                        for p, _rows in runs]
 
+    def _end_counting(self, name: str) -> None:
+        """Flip a column out of lazy counting, restoring the probed
+        paths' chunk invariant (each chunk sorted and mutually
+        dup-free).  A duplicate ALREADY in the raw buffer settles the
+        claim DUP on the way out — never silently forgotten."""
+        if not self._counting.get(name, False):
+            return
+        self._counting[name] = False
+        chunks = self._chunks.get(name) or []
+        if not chunks:
+            return
+        raw = sum(int(c.size) for c in chunks)
+        u = np.unique(np.concatenate(chunks))
+        if u.size < raw:
+            # counting is already off, so _demote runs no walk; the
+            # sticky-DUP rule keeps this verdict through later demotes
+            self._demote(name, DUP)
+            return
+        self._live -= self._rows[name] - int(u.size)
+        self._rows[name] = int(u.size)
+        self._chunks[name] = [u]
+
     def seed_resolution(self, statuses: Dict[str, str],
                         counts: Optional[Dict[str, int]] = None) -> None:
         """Adopt another process's resolve() verdicts (and exact
@@ -630,7 +743,10 @@ class UniqueTracker:
             counting = self._counting.get(name, False) \
                 and other._counting.get(name, False)
             if not counting:
-                self._counting[name] = False
+                # leaving counting mode: the lazy tier's raw buffers
+                # violate the probed paths' invariants (sorted, dup-free
+                # chunks) — normalize, settling any dup already buffered
+                self._end_counting(name)
             if counting and not kind_clash \
                     and OVERFLOW not in (self.status[name], ost):
                 # counting survives a DUP on either side: adopt the
@@ -642,8 +758,13 @@ class UniqueTracker:
                     self._kind[name] = okind
                 if DUP in (self.status[name], ost):
                     self.status[name] = DUP
+                fed_before = self._fed.get(name, 0)
                 for c in other._chunks[name]:
                     self.update(name, c, hash_kind=okind)
+                # the folds above counted only the peer's LIVE rows;
+                # its spilled rows are part of its fed total too.  The
+                # claim law stays count == fed across the merge.
+                self._fed[name] = fed_before + other._fed.get(name, 0)
                 continue
             if DUP in (self.status[name], ost):
                 self._demote(name, DUP)
