@@ -56,7 +56,7 @@ def _ensure_fixture(name: str, rows: int, workdir: str) -> str:
 
 
 def run_table_scenario(name: str, scale: float, workdir: str,
-                       backend: str) -> dict:
+                       backend: str, exact_distinct: bool = False) -> dict:
     from tpuprof import ProfileReport, ProfilerConfig
 
     from benchmarks import scenarios
@@ -64,8 +64,16 @@ def run_table_scenario(name: str, scale: float, workdir: str,
     _, nominal = scenarios.GENERATORS[name]
     rows = max(int(nominal * scale), 10_000)
     path = _ensure_fixture(name, rows, workdir)
+    kw = {}
+    if exact_distinct:
+        kw = {"exact_distinct": True,
+              "unique_spill_dir": os.path.join(workdir, "uniq_spill")}
+
+    def _config():
+        return ProfilerConfig(backend=backend, **kw)
+
     t0 = time.perf_counter()
-    report = ProfileReport(path, config=ProfilerConfig(backend=backend))
+    report = ProfileReport(path, config=_config())
     out = os.path.join(workdir, f"{name}_report.html")
     report.to_file(out)
     cold = time.perf_counter() - t0
@@ -79,8 +87,7 @@ def run_table_scenario(name: str, scale: float, workdir: str,
     best = None
     for _ in range(2):
         t0 = time.perf_counter()
-        report = ProfileReport(path,
-                               config=ProfilerConfig(backend=backend))
+        report = ProfileReport(path, config=_config())
         report.to_file(out)
         el = time.perf_counter() - t0
         if el < warm:
@@ -132,7 +139,11 @@ def run_wide1b(scale: float, workdir: str, backend: str) -> dict:
     if on_cpu:
         state = runner.step_a(state, batches[0], 0)   # compile
         jax.block_until_ready(state)
-        steps = max(total_rows // runner.rows, 4)
+        # smoke cap: the CPU-mesh rate is flat after a few dozen steps,
+        # and the regression harness only needs the round-over-round
+        # DELTA — 10M rows of per-step-synced fake-device folds would
+        # spend 3 minutes measuring nothing extra
+        steps = min(max(total_rows // runner.rows, 4), 64)
         t0 = time.perf_counter()
         for i in range(steps):
             state = runner.step_a(state, batches[i % 4], i + 1)
@@ -250,24 +261,26 @@ n = best.description["table"]["n"]
 phases = {k: round(v, 2) for k, v in sorted(
     (best.description.get("_phases") or {}).items())}
 
-# streaming leg: same rows, 10k-row micro-batches, single-pass.  Warm
-# split scales with the fixture so smoke-sized runs (--scale 0.01)
-# still time a real stream
+# streaming leg: IDENTICAL feed and denominator to the single-pass
+# comparand (VERDICT r4 #9): compiles warm on a THROWAWAY profiler over
+# a head slice (persistent cache carries the executables), then a fresh
+# profiler streams the full table and the rate divides by the same n
+# the batch leg profiles
 warm_rows = min(200_000, (n // 5) // 10_000 * 10_000) or 10_000
 tbl = pq.read_table(fixture)
+warmer = StreamingProfiler(tbl.schema, config=cfg(exact_passes=False))
+for pos in range(0, warm_rows, 10_000):
+    warmer.update(tbl.slice(pos, 10_000))
+warmer.stats()
 prof = StreamingProfiler(tbl.schema, config=cfg(exact_passes=False))
-for pos in range(0, warm_rows, 10_000):         # warm compiles
-    prof.update(tbl.slice(pos, 10_000))
-prof.stats()
 t0 = time.perf_counter()
-for pos in range(warm_rows, n, 10_000):
+for pos in range(0, n, 10_000):
     prof.update(tbl.slice(pos, 10_000))
 prof.stats()
 stream_el = time.perf_counter() - t0
-stream_rows = n - warm_rows
 # single-pass batch profile over the SAME in-memory table = streaming's
-# apples-to-apples comparand (both legs memory-fed; the ratio isolates
-# the micro-batch glue, not parquet decode)
+# apples-to-apples comparand (both legs memory-fed, full n; the ratio
+# isolates the micro-batch glue, not parquet decode)
 ProfileReport(tbl, config=cfg(exact_passes=False))      # warm this shape
 t0 = time.perf_counter()
 ProfileReport(tbl, config=cfg(exact_passes=False))
@@ -276,10 +289,9 @@ print(json.dumps({
     "scenario": "hostfed", "rows": n, "cols": 50,
     "seconds": round(warm, 3), "rows_per_sec": round(n / warm, 1),
     "cold_seconds": round(cold, 3), "phases_warm": phases,
-    "stream_rows_per_sec": round(stream_rows / stream_el, 1),
+    "stream_rows_per_sec": round(n / stream_el, 1),
     "singlepass_rows_per_sec": round(n / single, 1),
-    "stream_vs_singlepass": round((stream_rows / stream_el)
-                                  / (n / single), 3)}))
+    "stream_vs_singlepass": round((n / stream_el) / (n / single), 3)}))
 """
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
     env["JAX_PLATFORMS"] = "cpu"
@@ -294,15 +306,96 @@ print(json.dumps({
     return json.loads(line)
 
 
+REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
+                        "hostfed")
+
+
+def run_regression(scale: float, workdir: str) -> None:
+    """ALL five BASELINE scenarios (+ hostfed), each in a CPU-pinned
+    subprocess on an 8-fake-device mesh, one diffable table out
+    (VERDICT r4 #6): small-scale rates whose round-over-round DELTAS —
+    not absolute values — are the regression signal.  Tunnel weather
+    cannot touch any number here.  Also measures the exact_distinct
+    overhead at the criteo (mixed) shape via a second criteo leg with
+    --parity-style settings, since that tier's cost lives on the host.
+
+    Writes ``REGRESSION.json`` into --workdir and prints one JSON line
+    per scenario plus a markdown table the next round can diff."""
+    import subprocess
+
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    here = os.path.abspath(__file__)
+    results = []
+
+    def _leg(display_name, argv):
+        # a failed leg must leave a diffable FAILED row, never a silent
+        # omission the next round could misread as "never ran"; a child
+        # that exits 0 without a JSON line is a failure too
+        try:
+            proc = subprocess.run(argv, env=env, capture_output=True,
+                                  text=True, timeout=3600)
+        except subprocess.TimeoutExpired:
+            results.append({"scenario": display_name,
+                            "error": "timeout after 3600s"})
+            print(json.dumps(results[-1]), flush=True)
+            return
+        lines = [l for l in proc.stdout.splitlines()
+                 if l.startswith("{")]
+        if proc.returncode != 0 or not lines:
+            err = (proc.stderr.strip().splitlines() or ["no output"])[-1]
+            results.append({"scenario": display_name, "error": err})
+            print(json.dumps(results[-1]), flush=True)
+            return
+        entry = json.loads(lines[-1])
+        entry["scenario"] = display_name
+        results.append(entry)
+        print(json.dumps(entry), flush=True)
+
+    for name in REGRESSION_SCENARIOS:
+        _leg(name, [sys.executable, here, name, "--scale", str(scale),
+                    "--workdir", workdir])
+    # exact_distinct overhead leg at the mixed (criteo) shape
+    _leg("criteo+exact",
+         [sys.executable, here, "criteo", "--scale", str(scale),
+          "--workdir", workdir, "--exact-distinct"])
+    out_path = os.path.join(workdir, "REGRESSION.json")
+    with open(out_path, "w") as fh:
+        json.dump({"scale": scale, "results": results}, fh, indent=2)
+    print(f"\n| scenario | rows | warm rows/s | notes |")
+    print(f"|---|---|---|---|")
+    for r in results:
+        if "error" in r:
+            print(f"| {r['scenario']} | — | FAILED | {r['error'][:60]} |")
+            continue
+        notes = ""
+        if "stream_vs_singlepass" in r:
+            notes = f"stream:single {r['stream_vs_singlepass']}"
+        print(f"| {r['scenario']} | {r.get('rows', '—'):,} | "
+              f"{r.get('rows_per_sec', float('nan')):,.0f} | {notes} |")
+    print(f"\nwritten: {out_path}")
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("scenario", choices=["taxi", "tpch", "criteo",
                                              "wide1b", "streaming",
-                                             "hostfed", "all"])
+                                             "hostfed", "regression",
+                                             "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
     parser.add_argument("--backend", default="tpu")
+    parser.add_argument("--exact-distinct", action="store_true",
+                        help="profile with exact distinct counting "
+                             "(spill dir under --workdir) — the "
+                             "regression harness uses this to track the "
+                             "exact tier's host cost")
     args = parser.parse_args()
+
+    if args.scenario == "regression":
+        run_regression(args.scale, args.workdir)
+        return
 
     # Persistent compilation cache: each ProfileReport builds a fresh
     # MeshRunner whose jit wrappers are new instances, so without this
@@ -323,7 +416,8 @@ def main() -> None:
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
             result = run_table_scenario(name, args.scale, args.workdir,
-                                        args.backend)
+                                        args.backend,
+                                        exact_distinct=args.exact_distinct)
         elif name == "wide1b":
             result = run_wide1b(args.scale, args.workdir, args.backend)
         elif name == "hostfed":

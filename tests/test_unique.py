@@ -744,6 +744,49 @@ class TestExactDistinct:
         t2.update("c", np.arange(0, 401, dtype=np.uint64))
         assert t2._runs["c"]
 
+    def test_merge_counting_mismatch_keeps_collapsed_dup_evidence(
+            self, tmp_path):
+        """Dup evidence that survives ONLY in _fed (the compaction/spill
+        collapsed the duplicate rows) must still settle DUP when a
+        counting x non-counting merge ends counting mode (review r5)."""
+        a = self._tracker(tmp_path)            # budget=400
+        a.update("c", np.array([5, 5], dtype=np.uint64))
+        a.update("c", np.arange(1000, 1400, dtype=np.uint64))  # spills,
+        # collapsing the buffered [5,5] duplicate into the run
+        b = kunique.UniqueTracker(["c"], 400, 1 << 30,
+                                  spill_dir=str(tmp_path / "sp4"))
+        b.update("c", np.array([9], dtype=np.uint64))
+        a.merge(b)
+        assert a.resolve()["c"] == kunique.DUP
+
+    def test_snapshot_memo_survives_compaction(self, tmp_path):
+        """The resolve memo must not serve a stale count when an
+        in-memory compaction shrinks the raw-row counter back onto a
+        previously-memoized value — _fed (monotone) is in the key
+        (review r5)."""
+        t = self._tracker(tmp_path)            # budget=400
+        first = np.concatenate([np.arange(250), np.arange(50)]
+                               ).astype(np.uint64)     # 300 raw, 250 dst
+        t.update("c", first)
+        assert t.distinct_counts()["c"] == 250
+        second = np.concatenate([np.arange(250, 300), np.zeros(150)]
+                                ).astype(np.uint64)    # 50 new values
+        t.update("c", second)
+        assert t.distinct_counts()["c"] == 300
+        assert t.resolve()["c"] == kunique.DUP
+
+    def test_mid_cardinality_column_stays_in_memory(self, tmp_path):
+        """A column whose DISTINCT count fits the budget must never
+        spill, however many raw rows stream through — the probed tier's
+        spill policy, kept by compact-then-decide (review r5)."""
+        t = self._tracker(tmp_path)            # budget=400
+        vals = np.arange(350, dtype=np.uint64)
+        for _ in range(10):                    # 3,500 raw rows
+            t.update("c", vals)
+        assert t._runs["c"] == [], "mid-cardinality column hit disk"
+        assert t.distinct_counts()["c"] == 350
+        assert t.resolve()["c"] == kunique.DUP
+
     def test_lost_runs_on_resume_never_fake_a_dup(self, tmp_path):
         """Resume where the spill dir is invisible: the best-effort
         claim walk must NOT run against the partial union (live buffer

@@ -144,6 +144,12 @@ class UniqueTracker:
         # raw valid rows ever fed per counting column (duplicates
         # included): the lazy tier's UNIQUE claim is count == fed
         self._fed: Dict[str, int] = {}
+        # per-column raw-row threshold for the next in-memory compaction
+        # (_compact_or_spill); absent => the per-column budget
+        self._next_compact: Dict[str, int] = {}
+        # counting columns whose live buffer is currently one sorted
+        # dup-free chunk (post-compaction): resolve skips the re-unique
+        self._clean: set = set()
         for n in names:
             self.status[n] = OVERFLOW if disabled else UNIQUE
             self._chunks[n] = []
@@ -265,19 +271,39 @@ class UniqueTracker:
 
     def _compact_or_spill(self, name: str) -> bool:
         """Budget relief for the lazy tier: dedup the raw buffer in
-        memory FIRST — a dup-heavy column shrinks far below budget and
-        never touches disk (matching the probed tier's near-zero spill
-        footprint, instead of one tiny run per budget of raw rows); only
-        a still-large distinct-heavy buffer pays a spill run."""
-        u = np.unique(np.concatenate(self._chunks[name]))
-        freed = self._rows[name] - int(u.size)
-        self._chunks[name] = [u]
-        self._rows[name] = int(u.size)
-        self._live -= freed
-        if self._rows[name] <= self.budget // 2 \
+        memory FIRST and spill ONLY when the column's DISTINCT count
+        exceeds the per-column budget (or global memory pressure
+        demands it) — exactly the probed tier's spill policy, so dup-
+        and mid-cardinality columns keep its near-zero disk footprint
+        instead of shedding a mostly-redundant run per budget of raw
+        rows.  A kept buffer re-compacts only after growing budget//2
+        raw rows past its distinct size (_next_compact), bounding the
+        re-sort churn at amortized O(log) per value."""
+        u = self._compact_buffer(name)
+        if self._rows[name] <= self.budget \
                 and self._live <= self.total_budget:
             return True
+        self._next_compact[name] = self.budget
         return bool(self.spill_dir and self._spill(name, merged=u))
+
+    def _compact_buffer(self, name: str) -> Optional[np.ndarray]:
+        """np.unique the live buffer into ONE sorted dup-free chunk,
+        maintaining the _rows/_live/_clean/_next_compact bookkeeping —
+        the single home for this bookkeeping (compaction, the canonical
+        memo key, and spill staging all route through here)."""
+        chunks = self._chunks.get(name) or []
+        if not chunks:
+            return None
+        if len(chunks) == 1 and name in self._clean:
+            return chunks[0]
+        u = np.unique(np.concatenate(chunks))
+        self._live -= self._rows[name] - int(u.size)
+        self._rows[name] = int(u.size)
+        self._chunks[name] = [u]
+        self._next_compact[name] = int(u.size) + \
+            max(self.budget // 2, 1)
+        self._clean.add(name)
+        return u
 
     def _spill(self, name: str,
                merged: Optional[np.ndarray] = None) -> bool:
@@ -357,9 +383,11 @@ class UniqueTracker:
                 h = h.copy()    # own the memory: a view pins its parent
             self._fed[name] += h.size
             self._chunks[name].append(h)
+            self._clean.discard(name)
             self._rows[name] += h.size      # RAW rows buffered (lazy
             self._live += h.size            # tier), not distinct rows
-            if self._rows[name] > self.budget \
+            if self._rows[name] > self._next_compact.get(name,
+                                                         self.budget) \
                     or self._live > self.total_budget:
                 if not self._compact_or_spill(name):
                     self._overflow_warn(name)
@@ -467,9 +495,28 @@ class UniqueTracker:
                 out[name] = count
         return out
 
+    def _canonical_key(self, name: str) -> Tuple:
+        """Compact the lazy buffer to its canonical dedup'd form and
+        return the memo key describing the column's state.
+
+        Compaction first: the memo key must describe the canonical
+        state, or a walk would memoize under a pre-compaction key that
+        never matches again.  _fed is in the key because the lazy tier
+        broke _rows's monotonicity — a compaction can shrink _rows back
+        onto a value an earlier snapshot memoized with fewer values
+        seen, and (runs, rows) alone would serve that stale count; _fed
+        is monotone, so any new data invalidates.  Deterministic across
+        hosts after a merge (chunks fold in a fixed order), which is
+        what lets seed_resolution's injected verdicts match peers'
+        locally-computed keys."""
+        if self._counting.get(name, False):
+            self._compact_buffer(name)
+        return (tuple(self._runs[name]), self._rows[name],
+                self._fed.get(name, 0))
+
     def _resolve_spilled(self, name: str, count: bool = False
                          ) -> Tuple[str, Optional[int]]:
-        key = (tuple(self._runs[name]), self._rows[name])
+        key = self._canonical_key(name)
         memo = self._resolve_memo.get(name)
         if memo is not None and memo[0] == key \
                 and not (count and memo[2] is None
@@ -490,14 +537,24 @@ class UniqueTracker:
                 # a partial union would settle false DUPs)
                 self._counting[name] = False
                 self._resolve_memo[name] = (key, OVERFLOW, None)
+                # detach the SURVIVING runs before demoting: a restored
+                # copy / cross-host gather owns none of these files, and
+                # _drop_runs deleting them would destroy state a live
+                # writer's artifact references (the same hazard
+                # __setstate__ documents)
+                self._runs[name] = []
                 self._demote(name, OVERFLOW)
                 return OVERFLOW, None
         if self._chunks[name]:
-            # np.unique: the lazy tier's live buffers hold raw rows —
-            # the walk's per-array invariant is sorted AND internally
-            # dup-free (probed-path chunks already are; unique is then
-            # equivalent to the old sort)
-            arrays.append(np.unique(np.concatenate(self._chunks[name])))
+            # counting columns arrive pre-compacted to one sorted
+            # dup-free chunk (_canonical_key); probed-path chunk lists
+            # are sorted and mutually dup-free, so unique == the old
+            # sort-concatenate
+            if len(self._chunks[name]) == 1 and name in self._clean:
+                arrays.append(self._chunks[name][0])
+            else:
+                arrays.append(np.unique(np.concatenate(
+                    self._chunks[name])))
         total = sum(a.size for a in arrays)
         n_slices = max(1, -(-total // RESOLVE_SLICE_ROWS))
         step = (1 << 64) // n_slices
@@ -624,6 +681,10 @@ class UniqueTracker:
         self._spill_seq = 0
         if not hasattr(self, "_counting"):      # pre-counting artifacts
             self._counting = {n: False for n in self.status}
+        if not hasattr(self, "_next_compact"):
+            self._next_compact = {}
+        # restored buffers are conservatively dirty (re-unique once)
+        self._clean = set()
         if not hasattr(self, "_fed"):
             # pre-lazy artifacts (probed counting): chunks and runs are
             # dup-free, so for a still-UNIQUE column the stored distinct
@@ -696,25 +757,26 @@ class UniqueTracker:
 
     def _end_counting(self, name: str) -> None:
         """Flip a column out of lazy counting, restoring the probed
-        paths' chunk invariant (each chunk sorted and mutually
-        dup-free).  A duplicate ALREADY in the raw buffer settles the
-        claim DUP on the way out — never silently forgotten."""
+        paths' chunk invariant (the walk leaves the buffer as one
+        sorted dup-free chunk).  The claim is settled from EVERYTHING
+        counted so far — dup evidence may survive only in _fed
+        (compactions collapse buffered dups, spills collapse run dups),
+        so checking the live buffer alone would forget real duplicates
+        (review r5)."""
         if not self._counting.get(name, False):
             return
+        dup = False
+        if self.status.get(name) == UNIQUE:
+            try:
+                _st, cnt = self._resolve_spilled(name, count=True)
+                dup = cnt is not None and cnt < self._fed.get(name, cnt)
+            except Exception:
+                pass        # best-effort; the vanish path demotes itself
         self._counting[name] = False
-        chunks = self._chunks.get(name) or []
-        if not chunks:
-            return
-        raw = sum(int(c.size) for c in chunks)
-        u = np.unique(np.concatenate(chunks))
-        if u.size < raw:
+        if dup:
             # counting is already off, so _demote runs no walk; the
             # sticky-DUP rule keeps this verdict through later demotes
             self._demote(name, DUP)
-            return
-        self._live -= self._rows[name] - int(u.size)
-        self._rows[name] = int(u.size)
-        self._chunks[name] = [u]
 
     def seed_resolution(self, statuses: Dict[str, str],
                         counts: Optional[Dict[str, int]] = None) -> None:
@@ -730,8 +792,8 @@ class UniqueTracker:
             if self._runs.get(name) and (
                     self.status.get(name) == UNIQUE
                     or self._counting.get(name)):
-                key = (tuple(self._runs[name]), self._rows[name])
-                self._resolve_memo[name] = (key, st, counts.get(name))
+                self._resolve_memo[name] = (self._canonical_key(name),
+                                            st, counts.get(name))
 
     def merge(self, other: "UniqueTracker") -> None:
         for name, ost in other.status.items():
