@@ -142,6 +142,70 @@ def test_scan_a_matches_sequential_steps():
                                   np.asarray(r2["hll"]))
 
 
+def test_packed_finalize_matches_per_leaf_path():
+    """finalize_a's packed single-transfer gather must return exactly
+    the per-leaf device_get tree (same values, shapes, dtypes)."""
+    import jax
+    from tpuprof.config import ProfilerConfig
+    from tpuprof.ingest.arrow import HostBatch
+    from tpuprof.runtime.mesh import MeshRunner
+
+    rng = np.random.default_rng(3)
+    config = ProfilerConfig(batch_rows=64)
+    runner = MeshRunner(config, n_num=5, n_hash=0,
+                        devices=jax.devices()[:8])
+    x = np.asfortranarray(
+        rng.normal(3.0, 2.0, (runner.rows, 5)).astype(np.float32))
+    rv = np.ones(runner.rows, dtype=bool)
+    hb = HostBatch(nrows=runner.rows, x=x, row_valid=rv,
+                   hll=np.zeros((runner.rows, 0), np.uint16),
+                   cat_codes={}, date_ints={})
+    state = runner.step_a(runner.init_pass_a(), hb, 0)
+    packed = runner.finalize_a(state)
+    naive = jax.device_get(
+        jax.tree.map(lambda a: a[0], runner._merge_a(state)))
+    flat_p, tdef_p = jax.tree_util.tree_flatten(packed)
+    flat_n, tdef_n = jax.tree_util.tree_flatten(naive)
+    assert tdef_p == tdef_n
+    for p, n in zip(flat_p, flat_n):
+        assert np.asarray(p).dtype == np.asarray(n).dtype
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(n))
+
+
+def test_bounds_b_device_matches_host_recipe():
+    """bounds_b_device is the device twin of histogram.pass_b_bounds:
+    identical lo/hi and mean within f32-vs-f64 rounding."""
+    import jax
+    from tpuprof.config import ProfilerConfig
+    from tpuprof.ingest.arrow import HostBatch
+    from tpuprof.kernels import histogram as khistogram
+    from tpuprof.kernels import moments as kmoments
+    from tpuprof.runtime.mesh import MeshRunner
+
+    rng = np.random.default_rng(4)
+    config = ProfilerConfig(batch_rows=64)
+    runner = MeshRunner(config, n_num=6, n_hash=0,
+                        devices=jax.devices()[:8])
+    x = np.asfortranarray(
+        rng.normal(3.0, 2.0, (runner.rows, 6)).astype(np.float32))
+    x[rng.random((runner.rows, 6)) < 0.1] = np.nan
+    x[:, 5] = np.nan                       # all-NaN column: clamps to 0
+    rv = np.ones(runner.rows, dtype=bool)
+    rv[-3:] = False
+    hb = HostBatch(nrows=runner.rows - 3, x=x, row_valid=rv,
+                   hll=np.zeros((runner.rows, 0), np.uint16),
+                   cat_codes={}, date_ints={})
+    state = runner.step_a(runner.init_pass_a(), hb, 0)
+    lo_d, hi_d, mean_d = (np.asarray(a)
+                          for a in runner.bounds_b_device(state))
+    momf = kmoments.finalize(runner.finalize_a(state)["mom"])
+    lo_h, hi_h, mean_h = khistogram.pass_b_bounds(momf)
+    np.testing.assert_array_equal(lo_d, lo_h.astype(np.float32))
+    np.testing.assert_array_equal(hi_d, hi_h.astype(np.float32))
+    np.testing.assert_allclose(mean_d, mean_h.astype(np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_scan_b_matches_sequential_steps():
     """The multi-batch scan_b dispatch must fold histograms+MAD exactly
     like repeated step_b calls, on a full 8-device mesh."""

@@ -759,6 +759,20 @@ class TestExactDistinct:
         a.merge(b)
         assert a.resolve()["c"] == kunique.DUP
 
+    def test_merge_keeps_peer_collapsed_dup_evidence(self, tmp_path):
+        """The REVERSE direction: a non-counting self merging a counting
+        peer whose dup evidence survives only in the peer's _fed must
+        still settle DUP (review r5)."""
+        a = kunique.UniqueTracker(["c"], 400, 1 << 30,
+                                  spill_dir=str(tmp_path / "sp5"))
+        a.update("c", np.array([9], dtype=np.uint64))
+        b = self._tracker(tmp_path)            # counting
+        b.update("c", np.array([5, 5], dtype=np.uint64))
+        b.update("c", np.arange(1000, 1400, dtype=np.uint64))  # spills,
+        # collapsing the buffered duplicate into the run
+        a.merge(b)
+        assert a.resolve()["c"] == kunique.DUP
+
     def test_snapshot_memo_survives_compaction(self, tmp_path):
         """The resolve memo must not serve a stale count when an
         in-memory compaction shrinks the raw-row counter back onto a

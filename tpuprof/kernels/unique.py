@@ -771,7 +771,13 @@ class UniqueTracker:
                 _st, cnt = self._resolve_spilled(name, count=True)
                 dup = cnt is not None and cnt < self._fed.get(name, cnt)
             except Exception:
-                pass        # best-effort; the vanish path demotes itself
+                # the settle walk failed for an unforeseen reason: the
+                # claim can no longer be AFFIRMED (dup evidence may be
+                # collapsed in _fed) — degrade to the honest OVERFLOW,
+                # never to a wrong exact UNIQUE
+                self._counting[name] = False
+                self._demote(name, OVERFLOW)
+                return
         self._counting[name] = False
         if dup:
             # counting is already off, so _demote runs no walk; the
@@ -807,8 +813,13 @@ class UniqueTracker:
             if not counting:
                 # leaving counting mode: the lazy tier's raw buffers
                 # violate the probed paths' invariants (sorted, dup-free
-                # chunks) — normalize, settling any dup already buffered
+                # chunks) — normalize BOTH sides, settling dup evidence
+                # either tracker holds only in its _fed (the peer's
+                # collapsed duplicate must not vanish just because THIS
+                # side never counted)
                 self._end_counting(name)
+                other._end_counting(name)
+                ost = other.status[name]
             if counting and not kind_clash \
                     and OVERFLOW not in (self.status[name], ost):
                 # counting survives a DUP on either side: adopt the

@@ -114,6 +114,8 @@ class MeshRunner:
         self._sh_rows = NamedSharding(self.mesh, P("data"))
         self._sh_cols_rows = NamedSharding(self.mesh, P(None, "data"))
         self._sh_rep = NamedSharding(self.mesh, P())
+        self._gather_cache: Dict[str, tuple] = {}   # _gather_merged jits
+        self._bounds_b = None                       # bounds_b_device jit
         self._build_programs()
 
     # -- explicit host->device placement ------------------------------------
@@ -514,10 +516,86 @@ class MeshRunner:
 
     def finalize_a(self, state: Pytree) -> Dict[str, Any]:
         """Collective merge on-device, then pull ONE replica to host."""
-        merged = jax.device_get(
-            jax.tree.map(lambda a: a[0], self._merge_a(state)))
-        return merged
+        return self._gather_merged("a", self._merge_a, state)
 
     def finalize_b(self, state: Pytree) -> Dict[str, Any]:
-        return jax.device_get(
-            jax.tree.map(lambda a: a[0], self._merge_b(state)))
+        return self._gather_merged("b", self._merge_b, state)
+
+    def _gather_merged(self, key: str, merge_fn, state: Pytree):
+        """Merge on-device and fetch replica 0 as ONE dispatch + ONE
+        transfer.
+
+        The naive ``device_get(tree.map(a[0], merged))`` launches a tiny
+        slice program and a separate transfer PER LEAF — ~20 dispatches
+        for the pass-A state, each paying the device-link latency
+        (measured 0.2-0.6 s/finalize through the tunnel, pure latency:
+        the payload is 0.65 MB).  Here a single jitted program slices
+        every leaf, bitcasts non-f32 leaves to f32 (same width — i32
+        histogram counts, HLL registers are upcast-packed separately by
+        their own path), and concatenates into one flat array; the host
+        splits it back by a cached (treedef, shapes, dtypes) spec.
+        Falls back to the per-leaf path for dtypes with no 32-bit
+        bitcast (none in the current states)."""
+        cached = self._gather_cache.get(key)
+        if cached is None:
+            merged_shape = jax.eval_shape(merge_fn, state)
+            sliced = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(s.shape[1:], s.dtype),
+                merged_shape)
+            leaves, treedef = jax.tree_util.tree_flatten(sliced)
+            spec = [(l.shape, np.dtype(l.dtype)) for l in leaves]
+            if any(d.itemsize != 4 for _s, d in spec):
+                self._gather_cache[key] = (None, None, None)
+            else:
+                def packed(st):
+                    m = merge_fn(st)
+                    flat = []
+                    for leaf in jax.tree_util.tree_leaves(m):
+                        one = leaf[0].reshape(-1)
+                        if one.dtype != jnp.int32:
+                            # int32 carrier, NOT f32: small ints bitcast
+                            # to f32 denormals, which backends may flush
+                            # to zero mid-pipeline; integer lanes are
+                            # never canonicalized
+                            one = jax.lax.bitcast_convert_type(
+                                one, jnp.int32)
+                        flat.append(one)
+                    if not flat:
+                        return jnp.zeros((0,), dtype=jnp.int32)
+                    return jnp.concatenate(flat)
+                self._gather_cache[key] = (jax.jit(packed), treedef, spec)
+            cached = self._gather_cache[key]
+        fn, treedef, spec = cached
+        if fn is None:      # non-32-bit dtype somewhere: per-leaf path
+            return jax.device_get(
+                jax.tree.map(lambda a: a[0], merge_fn(state)))
+        buf = np.asarray(jax.device_get(fn(state)))
+        leaves, pos = [], 0
+        for shape, dtype in spec:
+            size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            chunk = buf[pos:pos + size]
+            pos += size
+            leaves.append(chunk.view(dtype).reshape(shape))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def bounds_b_device(self, state: Pytree):
+        """(lo, hi, mean) for pass B computed ON DEVICE from the pass-A
+        state — the device twin of ``kernels.histogram.pass_b_bounds``
+        (identical recipe; parity-pinned by tests).  Lets pass B
+        dispatch with NO host round trip after pass A, so finalize_a's
+        device->host transfer overlaps pass B's execution instead of
+        serializing before it."""
+        if self._bounds_b is None:
+            def f(st):
+                mom = jax.tree.map(lambda a: a[0], self._merge_a(st)["mom"])
+                n = mom["n"].astype(jnp.float32)
+                lo = jnp.where(jnp.isfinite(mom["fmin"]), mom["fmin"], 0.0)
+                hi = jnp.where(jnp.isfinite(mom["fmax"]), mom["fmax"], 0.0)
+                mean = jnp.where(
+                    n > 0, mom["shift"] + mom["s1"] / jnp.maximum(n, 1.0),
+                    0.0)
+                return (lo.astype(jnp.float32), hi.astype(jnp.float32),
+                        mean.astype(jnp.float32))
+            self._bounds_b = jax.jit(
+                f, out_shardings=(self._sh_rep,) * 3)
+        return self._bounds_b(state)
