@@ -150,18 +150,27 @@ def test_packed_finalize_matches_per_leaf_path():
     from tpuprof.ingest.arrow import HostBatch
     from tpuprof.runtime.mesh import MeshRunner
 
+    from tpuprof.kernels import hll as khll
+
     rng = np.random.default_rng(3)
-    config = ProfilerConfig(batch_rows=64)
-    runner = MeshRunner(config, n_num=5, n_hash=0,
+    config = ProfilerConfig(batch_rows=64, hll_precision=6)
+    # n_hash=2 exercises the 16-bit (HLL register) pair-packing lane —
+    # the PRODUCTION finalize shape, not just the all-32-bit bench shape
+    runner = MeshRunner(config, n_num=5, n_hash=2,
                         devices=jax.devices()[:8])
     x = np.asfortranarray(
         rng.normal(3.0, 2.0, (runner.rows, 5)).astype(np.float32))
     rv = np.ones(runner.rows, dtype=bool)
+    h64 = rng.integers(0, 1 << 64, (runner.rows, 2), dtype=np.uint64)
+    packed_hll = np.asfortranarray(
+        khll.pack(h64, np.ones((runner.rows, 2), bool), 6))
     hb = HostBatch(nrows=runner.rows, x=x, row_valid=rv,
-                   hll=np.zeros((runner.rows, 0), np.uint16),
-                   cat_codes={}, date_ints={})
+                   hll=packed_hll, cat_codes={}, date_ints={},
+                   hll_precision=6)
     state = runner.step_a(runner.init_pass_a(), hb, 0)
     packed = runner.finalize_a(state)
+    assert runner._gather_cache["a"][0] is not None, \
+        "production finalize shape fell off the packed path"
     naive = jax.device_get(
         jax.tree.map(lambda a: a[0], runner._merge_a(state)))
     flat_p, tdef_p = jax.tree_util.tree_flatten(packed)
@@ -184,12 +193,15 @@ def test_bounds_b_device_matches_host_recipe():
 
     rng = np.random.default_rng(4)
     config = ProfilerConfig(batch_rows=64)
-    runner = MeshRunner(config, n_num=6, n_hash=0,
+    runner = MeshRunner(config, n_num=8, n_hash=0,
                         devices=jax.devices()[:8])
     x = np.asfortranarray(
-        rng.normal(3.0, 2.0, (runner.rows, 6)).astype(np.float32))
-    x[rng.random((runner.rows, 6)) < 0.1] = np.nan
+        rng.normal(3.0, 2.0, (runner.rows, 8)).astype(np.float32))
+    x[rng.random((runner.rows, 8)) < 0.1] = np.nan
     x[:, 5] = np.nan                       # all-NaN column: clamps to 0
+    x[0, 6] = np.inf                       # +inf: s1 -> inf mean clamps
+    x[0, 7] = np.inf                       # +inf AND -inf: s1 -> NaN
+    x[1, 7] = -np.inf
     rv = np.ones(runner.rows, dtype=bool)
     rv[-3:] = False
     hb = HostBatch(nrows=runner.rows - 3, x=x, row_valid=rv,

@@ -153,6 +153,43 @@ def _nested_str(x):
     return str(x.tolist() if isinstance(x, np.ndarray) else x)
 
 
+def _is_unhashable_col(s: pd.Series) -> bool:
+    """True for columns holding unhashable cells (lists/dicts/arrays —
+    nested parquet data lands here).  ``infer_dtype`` (one C pass)
+    screens first; only mixed/unknown columns pay the per-cell probe."""
+    hashable_kinds = frozenset((
+        "string", "unicode", "bytes", "empty", "boolean", "integer",
+        "floating", "mixed-integer-float", "decimal", "complex",
+        "categorical", "date", "datetime", "datetime64", "time",
+        "timedelta", "timedelta64", "period", "interval"))
+    return s.dtype == object \
+        and pd.api.types.infer_dtype(s, skipna=True) \
+        not in hashable_kinds \
+        and any(issubclass(t, _UNHASHABLE) for t in set(s.map(type)))
+
+
+def _opaque_stub(series: pd.Series, n: int) -> Dict[str, Any]:
+    """nested="opaque" stats for one column: count/missing/memory only,
+    cardinality declared unknown — mirrors the TPU backend's opaque
+    assembly field-for-field (tests/test_parity-style cross-backend
+    agreement)."""
+    count = int(series.count())
+    return {
+        "type": schema.CAT,
+        "count": count,
+        "n_missing": n - count,
+        "p_missing": (n - count) / n if n else 0.0,
+        "distinct_count": None,
+        "p_unique": None,
+        "is_unique": False,
+        "distinct_approx": True,
+        "memorysize": float(series.memory_usage(index=False, deep=True)),
+        "mode": None,
+        "top": None,
+        "freq": 0,
+    }
+
+
 def _stringify_unhashable(df: pd.DataFrame) -> pd.DataFrame:
     """Columns holding unhashable values (lists/dicts/arrays — nested
     parquet data lands here) profile as their string form: one exotic
@@ -163,23 +200,11 @@ def _stringify_unhashable(df: pd.DataFrame) -> pd.DataFrame:
     hashable still crashes nunique otherwise); NaN/None stay missing
     (na_action) instead of becoming the string "nan".
 
-    Cost control: ``infer_dtype`` (one C pass) screens each object
-    column first — ordinary string/numeric object columns skip the
-    per-cell Python type map entirely; only columns pandas reports as
-    mixed/unknown pay the full probe."""
-    # inferred kinds that cannot contain list/dict/ndarray cells
-    hashable_kinds = frozenset((
-        "string", "unicode", "bytes", "empty", "boolean", "integer",
-        "floating", "mixed-integer-float", "decimal", "complex",
-        "categorical", "date", "datetime", "datetime64", "time",
-        "timedelta", "timedelta64", "period", "interval"))
+    Cost control: see ``_is_unhashable_col``."""
     out = {}
     for col in df.columns:
         s = df[col]
-        if s.dtype == object \
-                and pd.api.types.infer_dtype(s, skipna=True) \
-                not in hashable_kinds \
-                and any(issubclass(t, _UNHASHABLE) for t in set(s.map(type))):
+        if _is_unhashable_col(s):
             s = s.map(_nested_str, na_action="ignore")
         out[col] = s
     return pd.DataFrame(out, index=df.index)
@@ -221,9 +246,22 @@ class CPUStatsBackend:
     def collect(self, source: Any, config: ProfilerConfig) -> Dict[str, Any]:
         # _as_pandas owns the projection (the reference's df.select
         # idiom): unknown names raise BEFORE any file-backed read
-        df = _stringify_unhashable(_as_pandas(source,
-                                              columns=config.columns))
-        n = len(df)
+        raw = _as_pandas(source, columns=config.columns)
+        n = len(raw)
+        order = list(raw.columns)
+        opaque_stubs: Dict[Any, Dict[str, Any]] = {}
+        if config.nested == "opaque":
+            keep = []
+            for col in raw.columns:
+                if _is_unhashable_col(raw[col]):
+                    opaque_stubs[col] = _opaque_stub(raw[col], n)
+                else:
+                    keep.append(col)
+            # every kept column was just probed hashable, so the
+            # stringify pass would be the identity — skip its re-probe
+            df = raw[keep]
+        else:
+            df = _stringify_unhashable(raw)
 
         base_kinds: Dict[str, str] = {}
         commons: Dict[str, Dict[str, Any]] = {}
@@ -270,19 +308,34 @@ class CPUStatsBackend:
             stats["type"] = kind
             variables[col] = stats
 
-        table = schema.make_table_stats(
-            n, variables, memorysize=float(df.memory_usage(deep=True).sum()))
+        if opaque_stubs:
+            # stubs slot back into the SOURCE column order
+            variables = {c: (opaque_stubs[c] if c in opaque_stubs
+                             else variables[c]) for c in order}
+        # table total = sum of what each column REPORTS: the profiled
+        # frame's (possibly stringified) bytes plus the opaque columns'
+        # raw bytes — keeps table vs per-column memory consistent in
+        # both modes
+        mem_total = float(df.memory_usage(deep=True).sum()) + sum(
+            s["memorysize"] for s in opaque_stubs.values())
+        table = schema.make_table_stats(n, variables, memorysize=mem_total)
         messages = schema.derive_messages(variables, config)
         correlations = {"pearson": corr_matrix}
         if config.spearman and len(numeric_cols) >= 2:
             correlations["spearman"] = df[numeric_cols].corr(method="spearman")
+        if opaque_stubs:
+            # the sample keeps the opaque columns (5 head rows of raw
+            # values — the reference's sample section, not a decode)
+            sample = raw.head(config.sample_rows)
+        else:
+            sample = df.head(config.sample_rows)
         return {
             "table": table,
             "variables": variables,
             "freq": freq,
             "correlations": correlations,
             "messages": messages,
-            "sample": df.head(config.sample_rows),
+            "sample": sample,
         }
 
 

@@ -82,9 +82,11 @@ class HostAgg:
         # lanes feed their full 64-bit hash streams (HostBatch.num_hashes)
         # so the reference's countDistinct exactness holds with no HLL
         # estimate anywhere, not just for string/categorical columns
+        # opaque nested columns have no hash stream — nothing to track
         self.unique = UniqueTracker(
             (s.name for s in (plan.specs if config.exact_distinct
-                              else plan.by_role("cat"))),
+                              else plan.by_role("cat"))
+             if not s.opaque),
             config.unique_track_rows, config.unique_track_total_rows,
             spill_dir=config.unique_spill_dir,
             count_exact=config.exact_distinct,
@@ -155,6 +157,10 @@ class HostAgg:
                         hash_kind="native")
             if first:
                 self.first_values[name] = arr[:5].to_pylist()
+        for name, nulls in (hb.opaque_nulls or {}).items():
+            # opaque nested columns (config.nested): the null count is
+            # their only per-batch statistic
+            self.cat_null[name] += int(nulls)
         for name, (ints, valid) in hb.date_ints.items():
             ints, valid = ints[: hb.nrows], valid[: hb.nrows]
             self.date_null[name] += int((~valid).sum())
@@ -255,7 +261,7 @@ class _CollectCheckpoint:
     _META_KEYS = ("n_num", "n_hash", "batch_rows", "hll_precision",
                   "native_hash", "source_fp", "quantile_sketch_size",
                   "topk_capacity", "seed", "process_id", "process_count",
-                  "batch_enum", "exact_distinct")
+                  "batch_enum", "exact_distinct", "nested")
 
     def __init__(self, config: ProfilerConfig, plan, runner, pshard,
                  source_fp: str, table_source: bool = False):
@@ -299,7 +305,10 @@ class _CollectCheckpoint:
                 # the tracker's column set and hash coverage differ by
                 # mode — resuming across a flip would silently drop or
                 # hollow the exact counts
-                "exact_distinct": self.config.exact_distinct}
+                "exact_distinct": self.config.exact_distinct,
+                # the batch stream's CONTENT differs per policy (opaque
+                # columns carry no value stream) — no cross-policy resume
+                "nested": self.config.nested}
 
     def save(self, state, sampler, hostagg, host_hll, cursor,
              frag_pos=None) -> None:
@@ -339,7 +348,7 @@ class _CollectCheckpoint:
         # enumeration really did differ (window-v2), so absent != "v2"
         # must reject; for parquet sources both sides stamp None anyway.
         absent_defaults = {"process_id": 0, "process_count": 1,
-                           "exact_distinct": False}
+                           "exact_distinct": False, "nested": "stringify"}
         from tpuprof.errors import InputError
         for key in self._META_KEYS:
             if meta.get(key, absent_defaults.get(key)) != mine[key]:
@@ -465,7 +474,7 @@ class TPUStatsBackend:
         # adopts them — kernels/unique.py merge law); host-local dirs
         # degrade honestly to OVERFLOW at merge time, not up front
         ingest = ArrowIngest(source, config.batch_rows, process_shard=pshard,
-                             columns=config.columns)
+                             columns=config.columns, nested=config.nested)
         plan = ingest.plan
         if not plan.specs:
             return _empty_stats(config)
@@ -676,6 +685,15 @@ class TPUStatsBackend:
             # instead of rescanning; cleared only after assembly
             resume.save(state, sampler, hostagg, host_hll, cursor,
                         frag_pos=last_frag)
+        # single-host pass-B bounds come off the DEVICE (the twin of
+        # khistogram.pass_b_bounds, parity-pinned): the bounds jit
+        # enqueues BEFORE the merged-state fetch, so pass B never waits
+        # on a host round trip — the same orchestration bench.py times.
+        # Multi-host keeps the host recipe: bin edges must come from the
+        # GLOBALLY merged moments or each host would bin differently.
+        bounds_d = None
+        if pshard[1] == 1 and config.exact_passes and plan.n_num > 0:
+            bounds_d = runner.bounds_b_device(state)
         with phase_timer("merge"):
             res_a = runner.finalize_a(state)
             # cross-host: each host's device sketches merged over ICI by
@@ -714,10 +732,13 @@ class TPUStatsBackend:
                 and hostagg.n_rows > 0:
             recounter = Recounter(hostagg)
             state_b = runner.init_pass_b()
-            lo, hi, mean_c = khistogram.pass_b_bounds(momf)
-            lo_d = runner.put_replicated(lo, dtype=np.float32)
-            hi_d = runner.put_replicated(hi, dtype=np.float32)
-            mean_d = runner.put_replicated(mean_c, dtype=np.float32)
+            if bounds_d is not None:
+                lo_d, hi_d, mean_d = bounds_d
+            else:
+                lo, hi, mean_c = khistogram.pass_b_bounds(momf)
+                lo_d = runner.put_replicated(lo, dtype=np.float32)
+                hi_d = runner.put_replicated(hi, dtype=np.float32)
+                mean_d = runner.put_replicated(mean_c, dtype=np.float32)
             spear_state = None
             if config.spearman:
                 spear_state = runner.init_spearman()
@@ -922,6 +943,24 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
                 distinct = int(round(hll_est[spec.hash_lane]))
                 distinct = max(min(distinct, count), 1 if count else 0)
                 distinct_approx = count > 0
+        elif spec.opaque:
+            # nested="opaque": count/missing/memory only — there is no
+            # value stream, so cardinality is declared unknown (None)
+            # rather than estimated
+            n_missing = hostagg.cat_null[spec.name]
+            count = n - n_missing
+            commons[spec.name] = {
+                "count": count,
+                "n_missing": n_missing,
+                "p_missing": n_missing / n if n else 0.0,
+                "distinct_count": None,
+                "p_unique": None,
+                "is_unique": False,
+                "distinct_approx": True,
+                "memorysize": hostagg.memorysize(spec.name),
+            }
+            kinds[spec.name] = schema.CAT
+            continue
         else:
             n_missing = hostagg.cat_null[spec.name]
             count = n - n_missing
@@ -1007,14 +1046,23 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
             stats["top"] = stats["mode"]
             stats["freq"] = int(vc.iloc[0]) if common["count"] else 0
         elif kind == schema.CAT:
-            vc = (recounter.value_counts(name) if recounter is not None
-                  else pd.Series({v: c for v, c in
-                                  hostagg.mg[name].top(config.topk_capacity)}))
-            vc = vc.sort_values(ascending=False)
-            stats["mode"] = vc.index[0] if len(vc) else np.nan
-            stats["top"] = stats["mode"]
-            stats["freq"] = int(vc.iloc[0]) if len(vc) else 0
-            freq[name] = vc.head(config.top_freq)
+            if spec.opaque:
+                # no value stream: the reference fields exist (contract)
+                # but carry "unknown", and no freq table renders
+                stats["mode"] = None
+                stats["top"] = None
+                stats["freq"] = 0
+            else:
+                vc = (recounter.value_counts(name)
+                      if recounter is not None
+                      else pd.Series({v: c for v, c in
+                                      hostagg.mg[name].top(
+                                          config.topk_capacity)}))
+                vc = vc.sort_values(ascending=False)
+                stats["mode"] = vc.index[0] if len(vc) else np.nan
+                stats["top"] = stats["mode"]
+                stats["freq"] = int(vc.iloc[0]) if len(vc) else 0
+                freq[name] = vc.head(config.top_freq)
         elif kind == schema.DATE:
             lo = hostagg.date_min.get(name)
             hi = hostagg.date_max.get(name)

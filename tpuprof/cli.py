@@ -50,6 +50,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "escape hatch for nested (list/struct/map) "
                         "columns, whose stringified ingest is ~200x "
                         "slower.  Unknown names error.")
+    p.add_argument("--nested", default="stringify",
+                   choices=["stringify", "opaque"],
+                   help="nested (list/struct/map) column policy: "
+                        "'stringify' profiles the str() form (exact, "
+                        "but ~200x slower ingest for that column); "
+                        "'opaque' reports count/missing/memory only "
+                        "with no decode at all")
     p.add_argument("--stats-json", metavar="PATH",
                    help="also dump the FULL stats dict as JSON (table, "
                         "variables, freq, correlations, messages, sample)")
@@ -180,7 +187,7 @@ def cmd_profile(args: argparse.Namespace) -> int:
 
     try:
         config = ProfilerConfig(
-            backend=args.backend, columns=columns,
+            backend=args.backend, columns=columns, nested=args.nested,
             bins=args.bins, corr_reject=args.corr_reject,
             batch_rows=args.batch_rows, scan_batches=args.scan_batches,
             prepare_workers=args.prepare_workers,

@@ -22,6 +22,16 @@ class ProfilerConfig:
     sample_rows: int = 5            # head rows shown in the report
     top_freq: int = 10              # value-count rows shown per CAT column
     correlation_overrides: Optional[Sequence[str]] = None  # never reject these
+    nested: str = "stringify"   # nested (list/struct/map) column policy:
+                                # "stringify" profiles the str() form
+                                # (exact cross-backend parity, but an
+                                # O(rows) Python loop — ~200x slower
+                                # ingest, PERF.md); "opaque" reports
+                                # count/missing/memory only (no decode,
+                                # no stringification — the column's
+                                # values never materialize).  Excluding
+                                # the column via `columns=` stays the
+                                # zero-cost option.
     columns: Optional[Sequence[str]] = None  # profile ONLY these columns,
                                              # in this order (the reference's
                                              # ``df.select(...)`` idiom —
@@ -185,6 +195,10 @@ class ProfilerConfig:
     def __post_init__(self) -> None:
         if self.bins < 1:
             raise ValueError("bins must be >= 1")
+        if self.nested not in ("stringify", "opaque"):
+            raise ValueError(
+                f"nested={self.nested!r} — use 'stringify' (profile the "
+                "str() form) or 'opaque' (count/missing only)")
         if self.columns is not None:
             cols = tuple(self.columns)
             if not cols:

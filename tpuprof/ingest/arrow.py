@@ -44,6 +44,9 @@ class ColumnSpec:
     num_lane: int = -1        # lane in the x matrix ("num" role only)
     hash_lane: int = -1       # lane in the hash matrices (every column)
     arrow_type: Optional[pa.DataType] = None
+    opaque: bool = False      # nested column under config.nested="opaque":
+                              # count/missing/memory only — prepare never
+                              # decodes, stringifies, or hashes its values
 
 
 @dataclasses.dataclass
@@ -56,21 +59,30 @@ class ColumnPlan:
 
     @property
     def n_hash(self) -> int:
-        return len(self.specs)
+        # opaque nested columns carry no hash lane (hash_lane == -1):
+        # no HLL plane bytes, no device registers for them
+        return sum(1 for s in self.specs if s.hash_lane >= 0)
 
     def by_role(self, role: str) -> List[ColumnSpec]:
         return [s for s in self.specs if s.role == role]
 
     @classmethod
-    def from_schema(cls, arrow_schema: pa.Schema) -> "ColumnPlan":
+    def from_schema(cls, arrow_schema: pa.Schema,
+                    nested: str = "stringify") -> "ColumnPlan":
         specs: List[ColumnSpec] = []
         num_lane = 0
-        for i, field in enumerate(arrow_schema):
+        hash_lane = 0
+        for field in arrow_schema:
             t = field.type
             if isinstance(t, pa.DictionaryType):
                 t_inner = t.value_type
             else:
                 t_inner = t
+            if nested == "opaque" and pa.types.is_nested(t_inner):
+                # no hash lane: nothing about the column ships to device
+                specs.append(ColumnSpec(field.name, "cat", schema.CAT,
+                                        arrow_type=t, opaque=True))
+                continue
             if pa.types.is_boolean(t_inner):
                 spec = ColumnSpec(field.name, "num", schema.BOOL,
                                   num_lane=num_lane, arrow_type=t)
@@ -86,7 +98,8 @@ class ColumnPlan:
                                   arrow_type=t)
             else:
                 spec = ColumnSpec(field.name, "cat", schema.CAT, arrow_type=t)
-            spec.hash_lane = i
+            spec.hash_lane = hash_lane
+            hash_lane += 1
             specs.append(spec)
         return cls(specs)
 
@@ -127,6 +140,9 @@ class HostBatch:
     # hashes down to 16 bits, so exact distinct counting of num/date
     # columns needs the unpacked stream retained
     num_hashes: Optional[Dict[str, Tuple[np.ndarray, np.ndarray]]] = None
+    # per-batch null counts of opaque nested columns (config.nested=
+    # "opaque"): the ONLY statistic prepared for them — no decode
+    opaque_nulls: Optional[Dict[str, int]] = None
     # (fragment ordinal, batch ordinal within fragment) when the batch
     # came from the positioned per-fragment stream — the checkpoint
     # records it so resume can skip whole fragments' I/O
@@ -337,6 +353,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     cat_hashed: Dict[str, Tuple] = {}   # payload valid=None ⇒ no nulls
     date_ints: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
     num_hashes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    opaque_nulls: Dict[str, int] = {}
 
     col_nbytes: Dict[str, int] = {}
     col_dict_nbytes: Dict[str, int] = {}
@@ -390,6 +407,12 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                     num_hashes[spec.name] = (_hash64(keys), valid)
             date_ints[spec.name] = (ints, valid)
         else:  # cat
+            if spec.opaque:
+                # count/missing/memory only: the null count is Arrow
+                # metadata (O(1)) and the buffer sizes were recorded
+                # above — the values never decode (config.nested docs)
+                opaque_nulls[spec.name] = int(arr.null_count)
+                return
             if pa.types.is_nested(arr.type):
                 # nested values (list/struct/map) have no
                 # dictionary_encode kernel and no string cast; profile
@@ -502,6 +525,7 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
                      cat_hashed=cat_hashed if hashes else None,
                      num_hashes=num_hashes if hashes and full_hashes
                      else None,
+                     opaque_nulls=opaque_nulls or None,
                      hll_precision=hll_precision, col_nbytes=col_nbytes,
                      col_dict_nbytes=col_dict_nbytes, frag_pos=frag_pos)
 
@@ -716,7 +740,8 @@ class ArrowIngest:
 
     def __init__(self, source: Any, batch_rows: int, max_retries: int = 2,
                  process_shard: Tuple[int, int] = (0, 1),
-                 columns: Optional[Sequence[str]] = None):
+                 columns: Optional[Sequence[str]] = None,
+                 nested: str = "stringify"):
         self.batch_rows = int(batch_rows)
         self.max_retries = int(max_retries)
         # (process_index, process_count): multi-host runs stripe dataset
@@ -766,7 +791,7 @@ class ArrowIngest:
         arrow_schema = (self._table.schema if self._table is not None
                         else full_schema)
         self.arrow_schema = arrow_schema
-        self.plan = ColumnPlan.from_schema(arrow_schema)
+        self.plan = ColumnPlan.from_schema(arrow_schema, nested=nested)
         self.rescannable = True
         self.fragments_opened = 0   # observability: I/O units touched
                                     # (checkpoint-resume tests assert it)

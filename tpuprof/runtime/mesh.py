@@ -544,7 +544,18 @@ class MeshRunner:
                 merged_shape)
             leaves, treedef = jax.tree_util.tree_flatten(sliced)
             spec = [(l.shape, np.dtype(l.dtype)) for l in leaves]
-            if any(d.itemsize != 4 for _s, d in spec):
+
+            def _packable(shape, dtype):
+                if dtype.itemsize == 4:
+                    return True
+                if dtype.itemsize == 2:
+                    # 16-bit leaves (HLL registers) ride as int32 PAIRS;
+                    # odd element counts would need padding bookkeeping
+                    size = int(np.prod(shape, dtype=np.int64))
+                    return size % 2 == 0
+                return False
+
+            if not all(_packable(s, d) for s, d in spec):
                 self._gather_cache[key] = (None, None, None)
             else:
                 def packed(st):
@@ -552,7 +563,10 @@ class MeshRunner:
                     flat = []
                     for leaf in jax.tree_util.tree_leaves(m):
                         one = leaf[0].reshape(-1)
-                        if one.dtype != jnp.int32:
+                        if one.dtype.itemsize == 2:
+                            one = jax.lax.bitcast_convert_type(
+                                one.reshape(-1, 2), jnp.int32)
+                        elif one.dtype != jnp.int32:
                             # int32 carrier, NOT f32: small ints bitcast
                             # to f32 denormals, which backends may flush
                             # to zero mid-pipeline; integer lanes are
@@ -572,9 +586,10 @@ class MeshRunner:
         buf = np.asarray(jax.device_get(fn(state)))
         leaves, pos = [], 0
         for shape, dtype in spec:
-            size = int(np.prod(shape, dtype=np.int64)) if shape else 1
-            chunk = buf[pos:pos + size]
-            pos += size
+            n_elems = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            n_words = n_elems * dtype.itemsize // 4     # carrier int32s
+            chunk = buf[pos:pos + n_words]
+            pos += n_words
             leaves.append(chunk.view(dtype).reshape(shape))
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
@@ -594,6 +609,10 @@ class MeshRunner:
                 mean = jnp.where(
                     n > 0, mom["shift"] + mom["s1"] / jnp.maximum(n, 1.0),
                     0.0)
+                # match the host twin's non-finite clamp (histogram.
+                # pass_b_bounds): +-inf values make s1 inf/NaN, and the
+                # MAD kernel must get a defined 0 center, not garbage
+                mean = jnp.where(jnp.isfinite(mean), mean, 0.0)
                 return (lo.astype(jnp.float32), hi.astype(jnp.float32),
                         mean.astype(jnp.float32))
             self._bounds_b = jax.jit(

@@ -109,7 +109,8 @@ class StreamingProfiler:
                                        arrow_schema.names)
             arrow_schema = pa.schema([arrow_schema.field(c) for c in cols])
         self.arrow_schema = arrow_schema
-        self.plan = ColumnPlan.from_schema(arrow_schema)
+        self.plan = ColumnPlan.from_schema(arrow_schema,
+                                           nested=self.config.nested)
         self.runner = MeshRunner(self.config, self.plan.n_num,
                                  self.plan.n_hash, devices=devices)
         from tpuprof.backends.tpu import HostAgg

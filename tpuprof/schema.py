@@ -179,14 +179,16 @@ def derive_messages(
             msgs.append(Message(MSG_CORR, name,
                                 (v.get("correlation_var"), v.get("correlation"))))
         elif kind == CAT:
-            if v.get("distinct_count", 0) > config.high_cardinality_threshold:
-                msgs.append(Message(MSG_HIGH_CARDINALITY, name,
-                                    v["distinct_count"]))
-            if v.get("distinct_approx"):
+            # distinct_count None = nested="opaque" declared it unknown
+            # (a policy, not an estimator overflow) — neither message
+            distinct = v.get("distinct_count")
+            if distinct is not None \
+                    and distinct > config.high_cardinality_threshold:
+                msgs.append(Message(MSG_HIGH_CARDINALITY, name, distinct))
+            if v.get("distinct_approx") and distinct is not None:
                 # only CAT warns: approximate distincts can change the
                 # UNIQUE/CAT call there, and only past both exact tiers
-                msgs.append(Message(MSG_APPROX_DISTINCT, name,
-                                    v["distinct_count"]))
+                msgs.append(Message(MSG_APPROX_DISTINCT, name, distinct))
         elif kind == NUM:
             skew = v.get("skewness")
             if skew is not None and np.isfinite(skew) and \
