@@ -357,3 +357,47 @@ def test_exact_distinct_merge_law(seed, n_a, n_b, budget):
         assert (ta.resolve()["c"] == kunique.DUP) == has_dup
         ta.cleanup()
         tb.cleanup()
+
+
+@given(st.integers(0, 2**31 - 1), st.booleans(), st.booleans(),
+       st.integers(8, 96), st.booleans())
+@settings(**SETTINGS)
+def test_unique_claim_soundness_across_mixed_merges(
+        seed, a_counts, b_counts, budget, snapshot):
+    """The law the round-5 review bugs violated: whatever the counting
+    modes, spill boundaries, compactions, or snapshot interleavings, a
+    merged tracker's final claim is SOUND — resolve() == UNIQUE only if
+    the union truly has no duplicate, and == DUP only if it truly has
+    one (OVERFLOW is always an honest answer; a false exact claim never
+    is).  Exercises counting x probed merges in BOTH directions, where
+    dup evidence can survive only in the counting side's fed counter."""
+    rng = np.random.default_rng(seed)
+    sa = rng.choice(400, size=rng.integers(1, 150), replace=True
+                    ).astype(np.uint64)
+    sb = rng.choice(400, size=rng.integers(1, 150), replace=True
+                    ).astype(np.uint64)
+    with tempfile.TemporaryDirectory() as d:
+        ta = kunique.UniqueTracker(["c"], budget, 1 << 30, spill_dir=d,
+                                   count_exact=a_counts)
+        tb = kunique.UniqueTracker(["c"], budget, 1 << 30, spill_dir=d,
+                                   count_exact=b_counts)
+        for chunk in np.array_split(sa, rng.integers(1, 4)):
+            ta.update("c", chunk)
+        for chunk in np.array_split(sb, rng.integers(1, 4)):
+            tb.update("c", chunk)
+        if snapshot:                      # mid-life snapshot walks
+            ta.resolve()
+            tb.resolve()
+        ta.merge(tb)
+        union = np.concatenate([sa, sb])
+        has_dup = len(np.unique(union)) < union.size
+        verdict = ta.resolve()["c"]
+        if verdict == kunique.UNIQUE:
+            assert not has_dup, "claimed exact UNIQUE over a duplicate"
+        elif verdict == kunique.DUP:
+            assert has_dup, "claimed exact DUP with no duplicate"
+        # counting x counting additionally promises the exact count
+        if a_counts and b_counts:
+            assert ta.distinct_counts()["c"] == len(np.unique(union))
+        ta.cleanup()
+        tb.cleanup()
