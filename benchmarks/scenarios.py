@@ -90,6 +90,38 @@ def wide_batch(rng: np.random.Generator, rows: int,
     return rng.normal(50.0, 10.0, (rows, cols)).astype(np.float32)
 
 
+def mixed23_batch(rng: np.random.Generator, rows: int) -> pd.DataFrame:
+    """The 23-mixed-column host-prep fixture (PERF.md cost model): 6 f32
+    + 3 nullable f64 + 4 i64 + i8 + bool + 3 low-card cats + 1 hicard
+    string + 2 dates + nullable f32 + nullable cat — every decode path
+    prepare_batch has (zero-copy numerics, dictionary hashing, the
+    row-hash fast path, date ints, null masks) is on the clock."""
+    d = {}
+    for i in range(6):
+        d[f"f32_{i}"] = rng.normal(50, 10, rows).astype(np.float32)
+    for i in range(3):
+        v = rng.normal(0, 1, rows)
+        v[rng.random(rows) < 0.1] = np.nan
+        d[f"f64_{i}"] = v
+    for i in range(4):
+        d[f"i64_{i}"] = rng.integers(0, 1_000_000, rows)
+    d["i8"] = rng.integers(0, 100, rows).astype(np.int8)
+    d["flag"] = rng.random(rows) < 0.5
+    for i in range(3):
+        d[f"cat_{i}"] = rng.choice(["a", "bb", "ccc", "dddd", "eeeee"],
+                                   rows)
+    d["hicard"] = np.char.add("id",
+                              rng.integers(0, 10**9, rows).astype(str))
+    for i in range(2):
+        d[f"date_{i}"] = pd.Timestamp("2020-01-01") + pd.to_timedelta(
+            rng.integers(0, 10**7, rows), unit="s")
+    v = rng.normal(0, 1, rows)
+    v[rng.random(rows) < 0.3] = np.nan
+    d["nullable"] = v.astype(np.float32)
+    d["cat_null"] = pd.Series(rng.choice(["x", "y", "z", None], rows))
+    return pd.DataFrame(d)
+
+
 GENERATORS = {
     "taxi": (taxi_batch, 7_000_000),
     "tpch": (tpch_lineitem_batch, 600_000_000),

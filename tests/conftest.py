@@ -32,6 +32,38 @@ import numpy as np
 import pandas as pd
 import pytest
 
+# TPUPROF_PREP_WORKERS-style env overrides must round-trip through
+# config.py — the resolvers are the single home for worker-count
+# resolution (ingest, stream, CLI all route through them), so a rename
+# or a stale duplicate would silently strand deployments' tuning.
+# Asserted once at session start, with the environment restored.
+from tpuprof.config import resolve_prep_workers, resolve_prepare_workers
+
+for _var, _fn in (("TPUPROF_PREP_WORKERS", resolve_prep_workers),
+                  ("TPUPROF_PREPARE_WORKERS", resolve_prepare_workers)):
+    _prev = os.environ.get(_var)
+    os.environ[_var] = "3"
+    assert _fn(None) == 3, \
+        f"{_var} does not round-trip through config.py"
+    assert _fn(7) == 7, \
+        f"explicit config value must beat the {_var} env override"
+    if _prev is None:
+        del os.environ[_var]
+    else:
+        os.environ[_var] = _prev
+# the pre-round-6 intra-batch name stays honored (deployed tuning)
+_prev = {k: os.environ.get(k) for k in ("TPUPROF_DECODE_THREADS",
+                                        "TPUPROF_PREP_WORKERS")}
+os.environ.pop("TPUPROF_PREP_WORKERS", None)
+os.environ["TPUPROF_DECODE_THREADS"] = "5"
+assert resolve_prep_workers(None) == 5, \
+    "TPUPROF_DECODE_THREADS back-compat alias broken"
+for _k, _v in _prev.items():
+    if _v is None:
+        os.environ.pop(_k, None)
+    else:
+        os.environ[_k] = _v
+
 
 def pytest_collection_modifyitems(config, items):
     if _TPU_LANE:

@@ -9,6 +9,12 @@ import tempfile
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
+
+# hypothesis is an optional test dependency (pyproject [test]); an
+# environment without it skips the property suite instead of erroring
+# the whole collection
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from tpuprof.ingest.sample import RowSampler

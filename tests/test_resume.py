@@ -435,3 +435,69 @@ def test_resume_preserves_exact_distinct_counts(tmp_path, monkeypatch):
                    unique_spill_dir=str(tmp_path / "spill"))
     with pytest.raises(ValueError, match="exact_distinct"):
         TPUStatsBackend().collect(path, flipped)
+
+
+def test_parallel_prep_never_reorders_checkpoint_cursors(
+        tmp_path, parquet_source, monkeypatch):
+    """Flush-boundary contract under the parallel preparer: prepare
+    workers race ahead of the device fold, but checkpoint cursors must
+    still advance strictly monotonically at the configured cadence and
+    the final artifact-equals-fold invariant must hold — a reordered
+    cursor would resume into double-counted batches."""
+    from tpuprof.runtime import checkpoint as ckpt
+
+    monkeypatch.setenv("TPUPROF_PREPARE_WORKERS", "4")
+    cursors = []
+    real_save = ckpt.save
+
+    def tracking_save(path, state, host_blob, cursor, meta):
+        cursors.append(cursor)
+        return real_save(path, state, host_blob, cursor, meta)
+
+    monkeypatch.setattr(ckpt, "save", tracking_save)
+    cfg = _cfg(tmp_path)        # 256-row batches, checkpoint every 3
+    stats = TPUStatsBackend().collect(parquet_source, cfg)
+    assert stats["table"]["n"] == 4000
+    # strictly increasing — never a rewind, never a duplicate
+    assert cursors == sorted(set(cursors))
+    # every mid-scan save lands ON a due boundary (the forced flush),
+    # and the final save covers the whole 16-batch stream
+    assert all(c % 3 == 0 for c in cursors[:-1])
+    assert cursors[-1] == 16
+
+
+def test_crash_resume_with_parallel_prep_matches_uninterrupted(
+        tmp_path, parquet_source, monkeypatch):
+    """The round-4 crash/resume contract, re-pinned with the parallel
+    preparer racing (4 workers): resumed stats equal the uninterrupted
+    profile's."""
+    monkeypatch.setenv("TPUPROF_PREPARE_WORKERS", "4")
+    control = TPUStatsBackend().collect(
+        parquet_source, ProfilerConfig(backend="tpu", batch_rows=256))
+
+    cfg = _cfg(tmp_path)
+    calls = {"n": 0}
+    real_update = HostAgg.update
+
+    def crashing_update(self, hb):
+        calls["n"] += 1
+        if calls["n"] == 8:
+            raise RuntimeError("injected crash mid-scan")
+        return real_update(self, hb)
+
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        TPUStatsBackend().collect(parquet_source, cfg)
+    monkeypatch.setattr(HostAgg, "update", real_update)
+    resumed = TPUStatsBackend().collect(parquet_source, cfg)
+    assert resumed["table"]["n"] == 4000
+    ctrl, got = _key_stats(control), _key_stats(resumed)
+    for name in ctrl:
+        for field, expect in ctrl[name].items():
+            value = got[name][field]
+            if isinstance(expect, float) and np.isfinite(expect):
+                assert value == pytest.approx(expect, rel=1e-5), \
+                    (name, field)
+            else:
+                assert value == expect or (
+                    value != value and expect != expect), (name, field)

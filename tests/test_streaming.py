@@ -172,3 +172,73 @@ def test_checkpoint_shape_mismatch_rejected(tmp_path):
     with pytest.raises(ValueError, match="quantile_sketch_size"):
         StreamingProfiler.restore(
             path, config=_cfg(quantile_sketch_size=128))
+
+
+def test_prefetch_prepared_overlap_contract():
+    """The depth-2 prefetcher's overlap contract under a slow fake
+    device: prep for batch N+1 runs AHEAD of the consumer's scan of
+    batch N (genuine overlap), while raw readahead stays bounded by the
+    queue depth plus the one in-flight put — host RAM never holds an
+    unbounded prefix of prepared batches."""
+    import time
+
+    from tpuprof.ingest.arrow import ArrowIngest, prefetch_prepared
+
+    df = pd.DataFrame({
+        "x": np.arange(4096.0),
+        "s": np.char.add("v", (np.arange(4096) % 7).astype(str)),
+    })
+    ing = ArrowIngest(df, batch_rows=256)           # 16 raw batches
+    pulled = []
+    real = ing.raw_batches_positioned
+
+    def tracked(skip_fragments=0):
+        for fi, bi, rb in real(skip_fragments=skip_fragments):
+            pulled.append(bi)
+            yield fi, bi, rb
+
+    ing.raw_batches_positioned = tracked
+    depth = 2
+    consumed = 0
+    max_ahead = 0
+    got_ahead = False
+    for hb in prefetch_prepared(ing, ing.plan, 256, 11, depth=depth,
+                                workers=1, positions=True):
+        time.sleep(0.03)                            # slow fake device
+        # snapshot AFTER the sleep: the reader thread had a full scan's
+        # worth of time to run ahead
+        ahead = len(pulled) - consumed - 1
+        max_ahead = max(max_ahead, ahead)
+        if ahead >= depth:
+            got_ahead = True
+        consumed += 1
+    assert consumed == 16
+    assert got_ahead, "prefetcher never ran ahead of the slow device"
+    # depth queued + 1 blocked in _put + 1 being prepared
+    assert max_ahead <= depth + 2, max_ahead
+
+
+def test_drain_pipelines_slices_in_order(monkeypatch):
+    """A bursty stream (many device batches buffered before one drain)
+    must fold slices in stream order even when the drain pipelines
+    their prep across workers — cursor increments and sampler state
+    match the serial drain exactly."""
+    batches = _micro_batches(n_batches=16, rows=250, seed=3)
+
+    def run(workers):
+        monkeypatch.setenv("TPUPROF_PREPARE_WORKERS", str(workers))
+        prof = StreamingProfiler.for_example(
+            batches[0], config=_cfg(batch_rows=256,
+                                    stream_flush_rows=4000))
+        for b in batches:                   # buffers all 4000 rows,
+            prof.update(b)                  # then one 15-slice drain
+        stats = prof.stats()
+        return (prof.cursor, stats["table"]["n"],
+                prof.sampler.values.tobytes(),
+                stats["variables"]["x"]["mean"],
+                str(stats["variables"]["cat"]["freq"]))
+
+    serial = run(1)
+    piped = run(4)
+    assert serial == piped
+    assert serial[1] == 4000

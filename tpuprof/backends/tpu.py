@@ -637,6 +637,7 @@ class TPUStatsBackend:
                 skip_batches=0 if use_positions else skip,
                 positions=use_positions, resume_pos=resume_pos,
                 workers=config.prepare_workers,
+                prep_workers=config.prep_workers,
                 full_hashes=config.exact_distinct)
             first_hb = next(batches, None)
             if state is None:
@@ -822,7 +823,8 @@ class TPUStatsBackend:
                                             config.hll_precision,
                                             depth=max(2, min(scan_s, 8)),
                                             hashes=False,
-                                            workers=config.prepare_workers):
+                                            workers=config.prepare_workers,
+                                            prep_workers=config.prep_workers):
                     recounter.update(hb)
                     pending_b.append(hb)
                     if len(pending_b) >= scan_s:
@@ -858,7 +860,8 @@ class TPUStatsBackend:
             recounter = Recounter(hostagg)
             for hb in prefetch_prepared(ingest, plan, pad,
                                         config.hll_precision, hashes=False,
-                                        workers=config.prepare_workers):
+                                        workers=config.prepare_workers,
+                                        prep_workers=config.prep_workers):
                 recounter.update(hb)
             # each host recounts only its own fragment stripe
             recounter.counts = merge_recount_arrays(recounter.counts)

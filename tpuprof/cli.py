@@ -34,6 +34,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host-prep pipeline width: decode/hash/pack of W "
                         "batches in parallel (default: half the cores, "
                         "capped at 4)")
+    p.add_argument("--prep-workers", type=int, default=None, metavar="W",
+                   help="intra-batch prep parallelism: per-column (and "
+                        "per-row-chunk) decode/hash/pack tasks of one "
+                        "batch on W shared threads (default: "
+                        "TPUPROF_PREP_WORKERS env, else all cores; 1 = "
+                        "the serial reference path, byte-identical "
+                        "output at any width)")
     p.add_argument("--sketch-size", type=int, default=4096,
                    help="quantile sample-sketch size K")
     p.add_argument("--hll-precision", type=int, default=11)
@@ -191,6 +198,7 @@ def cmd_profile(args: argparse.Namespace) -> int:
             bins=args.bins, corr_reject=args.corr_reject,
             batch_rows=args.batch_rows, scan_batches=args.scan_batches,
             prepare_workers=args.prepare_workers,
+            prep_workers=args.prep_workers,
             quantile_sketch_size=args.sketch_size,
             hll_precision=args.hll_precision,
             exact_passes=not args.single_pass,

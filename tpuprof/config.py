@@ -10,7 +10,42 @@ selection) have a single home with sane defaults.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Sequence
+
+
+def resolve_prep_workers(value: Optional[int] = None) -> int:
+    """Intra-batch prep parallelism: how many per-column / per-row-chunk
+    tasks of ONE batch run concurrently (ingest/prep.run_tasks).  An
+    explicit config value wins; else ``TPUPROF_PREP_WORKERS``; else
+    ``TPUPROF_DECODE_THREADS`` (the pre-round-6 name, honored so
+    existing deployments keep their tuning); else every core the host
+    has, capped at 16 (the task split saturates well before that and
+    a 100-core host should not spawn 100 threads per prepare)."""
+    if value is not None:
+        return max(int(value), 1)
+    for var in ("TPUPROF_PREP_WORKERS", "TPUPROF_DECODE_THREADS"):
+        env = os.environ.get(var)
+        if env:
+            return max(int(env), 1)
+    return min(os.cpu_count() or 1, 16)
+
+
+def resolve_prepare_workers(value: Optional[int] = None) -> int:
+    """Cross-batch prep pipeline width: how many DIFFERENT batches
+    decode/hash/pack concurrently (ingest/arrow.prefetch_prepared).
+    Each prepare already fans out across columns internally
+    (:func:`resolve_prep_workers`), so this tier mainly covers the
+    per-column serial portions and the tail; half the cores capped at 4
+    saturates hosts up to ~8 cores, and ``TPUPROF_PREPARE_WORKERS``
+    raises it on bigger ones.  1 on a single-core host — the pipeline
+    then degenerates to exactly the one-reader behavior."""
+    if value is not None:
+        return max(int(value), 1)
+    env = os.environ.get("TPUPROF_PREPARE_WORKERS")
+    if env:
+        return max(int(env), 1)
+    return max(1, min(4, (os.cpu_count() or 1) // 2))
 
 
 @dataclasses.dataclass
@@ -165,6 +200,18 @@ class ProfilerConfig:
                                             # else half the cores capped
                                             # at 4 (1 on a 1-core host =
                                             # the serial path exactly)
+    prep_workers: Optional[int] = None      # intra-batch prep parallelism:
+                                            # per-column (and, for wide
+                                            # numeric planes, per-row-
+                                            # chunk) tasks of ONE batch on
+                                            # the shared thread pool, GIL
+                                            # released in the hot paths.
+                                            # None = auto:
+                                            # TPUPROF_PREP_WORKERS env,
+                                            # else os.cpu_count() (cap
+                                            # 16).  1 = the serial
+                                            # reference path, byte-
+                                            # identical to any width
     seed: int = 0                   # PRNG seed for the sample sketch
     use_pallas: Optional[bool] = None   # None = auto (on for real TPU):
                                         # dense pallas histogram kernel vs
@@ -217,6 +264,8 @@ class ProfilerConfig:
             raise ValueError("stream_flush_rows must be >= 1 (or None)")
         if self.prepare_workers is not None and self.prepare_workers < 1:
             raise ValueError("prepare_workers must be >= 1 (or None)")
+        if self.prep_workers is not None and self.prep_workers < 1:
+            raise ValueError("prep_workers must be >= 1 (or None)")
         if self.parity:
             if not self.exact_passes:
                 raise ValueError(

@@ -20,6 +20,18 @@ Hashing: ``pandas.util.hash_array`` (vectorized SipHash-like, C speed).
 String columns are dictionary-encoded once per batch, only the
 dictionary is hashed, and codes gather the hashes — O(distinct) hashing
 instead of O(rows) (SURVEY §7.2's vectorize-before-C++ guidance).
+
+Parallelism (round 6): prep is a two-tier pipeline.  Within a batch,
+per-column tasks (and per-row-chunk tasks for tall numeric planes) run
+on a process-wide shared pool (ingest/prep.py) — the hot paths (Arrow
+decode, numpy casts into the preallocated F-order planes, the native
+fused hash+pack) all release the GIL, so real hosts overlap them across
+cores.  Across batches, ``prefetch_prepared`` pipelines whole prepares
+with in-order delivery so prep for batch N+1 hides under the device
+scan of batch N.  Both tiers are BYTE-DETERMINISTIC: tasks write
+disjoint plane slices, and every order-sensitive fold (row sampler,
+Misra-Gries, HLL register folds) consumes completed batches in stream
+order in the consumer.
 """
 
 from __future__ import annotations
@@ -205,6 +217,72 @@ def _num_keys(values: np.ndarray) -> np.ndarray:
     return values.astype(np.int64, copy=False).view(np.uint64)
 
 
+# numeric/date columns split into per-row-chunk prep tasks (disjoint
+# plane slices, elementwise math) once a batch is tall enough that the
+# split's task overhead is noise; below this, one task per column
+ROW_CHUNK_ROWS = 16384
+
+
+def _fill_num_rows(arr: pa.Array, spec: "ColumnSpec", x: np.ndarray,
+                   hll_packed: np.ndarray, hashes: bool,
+                   hll_precision: int, lo: int,
+                   nh: Optional[Tuple[np.ndarray, np.ndarray]]
+                   ) -> np.ndarray:
+    """Decode one numeric/bool Arrow slice into plane rows
+    [lo, lo+len(arr)) — every operation is elementwise, so any row
+    partition of a column produces byte-identical planes (the parallel
+    preparer's determinism contract rests on this).
+
+    Zero-copy fast paths when the column has no nulls: f64 values view
+    the Arrow buffer directly and downcast in ONE pass straight into the
+    F-order f32 plane (the cast→astype route pays two extra full-column
+    copies for the same bytes), and integers view (64-bit) or widen in
+    one numpy pass instead of the cast→fill_null→to_numpy Arrow chain.
+    Null-carrying columns keep the exact decode the oracle parity tests
+    pin.  Returns the chunk's valid mask."""
+    n = len(arr)
+    hi = lo + n
+    t = arr.type
+    no_nulls = arr.null_count == 0
+    if pa.types.is_floating(t) and t.bit_width == 32:
+        vals = arr.to_numpy(zero_copy_only=False)   # f32, NaN=null
+        x[lo:hi, spec.num_lane] = vals
+        valid = ~np.isnan(vals)
+    elif pa.types.is_floating(t) and t.bit_width == 64 and no_nulls:
+        vals = arr.to_numpy()                       # zero-copy view
+        x[lo:hi, spec.num_lane] = vals              # fused f64→f32 write
+        valid = ~np.isnan(vals)
+    elif pa.types.is_floating(t) or pa.types.is_decimal(t):
+        vals = arr.cast(pa.float64(), safe=False).to_numpy(
+            zero_copy_only=False)
+        x[lo:hi, spec.num_lane] = vals.astype(np.float32)
+        valid = ~np.isnan(vals)
+    elif no_nulls and not pa.types.is_boolean(t):
+        # ints: stay in int64 so ids > 2^53 hash exactly
+        vals = arr.to_numpy().astype(np.int64, copy=False)
+        x[lo:hi, spec.num_lane] = vals.astype(np.float32)
+        valid = np.ones(n, dtype=bool)
+    else:                           # bools, and ints carrying nulls
+        valid = (arr.is_valid().to_numpy(zero_copy_only=False)
+                 if arr.null_count else np.ones(n, dtype=bool))
+        vals = arr.cast(pa.int64(), safe=False).fill_null(0) \
+            .to_numpy(zero_copy_only=False)
+        xf = vals.astype(np.float32)
+        if arr.null_count:
+            xf = np.where(valid, xf, np.nan)
+        x[lo:hi, spec.num_lane] = xf
+    if hashes:
+        keys = _num_keys(vals)
+        hll_packed[lo:hi, spec.hash_lane] = _packed_obs(
+            keys, valid, hll_precision)
+        if nh is not None:
+            # exact distinct counting needs the unpacked 64-bit stream
+            # (the HLL plane keeps only 16 packed bits)
+            nh[0][lo:hi] = _hash64(keys)
+            nh[1][lo:hi] = valid
+    return valid
+
+
 def _packed_obs(keys: np.ndarray, valid: np.ndarray,
                 precision: int) -> np.ndarray:
     """Packed HLL observations from canonical uint64 keys: one fused
@@ -325,9 +403,19 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     categorical codes.  ``col_stats`` (owned by the ingest, like
     ``dict_cache``) carries each column's last observed per-batch
     distinct count, steering plain-string columns onto the row-hash
-    path once they prove high-cardinality.  ``decode_threads`` caps this
-    batch's per-column thread pool (the cross-batch pipeline divides the
-    host's cores between concurrent prepares)."""
+    path once they prove high-cardinality.  ``decode_threads`` sets this
+    batch's prep-task parallelism (None = config.resolve_prep_workers:
+    TPUPROF_PREP_WORKERS, else cpu count); concurrent prepares share
+    one process-wide task pool, so total prep threads stay bounded.
+
+    Parallel decomposition: one task per column, and — when the batch is
+    tall enough that columns alone can't fill the pool — numeric columns
+    split further into per-row-chunk tasks (every numeric op is
+    elementwise, see _fill_num_rows).  Tasks write disjoint plane slices
+    and disjoint dict keys, so the produced planes are BYTE-IDENTICAL at
+    any worker count (tests/test_ingest.py pins 1 vs 2 vs 8); ordered
+    folds (sampler, Misra-Gries, HLL registers) run on the COMPLETED
+    batch in the consumer, never inside racing workers."""
     from tpuprof import native
     from tpuprof.kernels import hll as khll
     if dict_cache is None:
@@ -360,40 +448,11 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
 
     def decode_column(i: int, spec: ColumnSpec) -> None:
         arr = batch.column(i)
-        # distinct keys per column: thread-safe dict writes
-        if isinstance(arr, pa.DictionaryArray):
-            col_nbytes[spec.name] = arr.indices.nbytes
-            col_dict_nbytes[spec.name] = arr.dictionary.nbytes
-        else:
-            col_nbytes[spec.name] = arr.nbytes
         if spec.role == "num":
-            t = arr.type
-            if pa.types.is_floating(t) and t.bit_width == 32:
-                vals = arr.to_numpy(zero_copy_only=False)   # f32, NaN=null
-                x[:n, spec.num_lane] = vals
-                valid = ~np.isnan(vals)
-            elif pa.types.is_floating(t) or pa.types.is_decimal(t):
-                vals = arr.cast(pa.float64(), safe=False).to_numpy(
-                    zero_copy_only=False)
-                x[:n, spec.num_lane] = vals.astype(np.float32)
-                valid = ~np.isnan(vals)
-            else:                       # ints / bools: stay in int64 so
-                valid = (arr.is_valid().to_numpy(zero_copy_only=False)
-                         if arr.null_count else np.ones(n, dtype=bool))
-                vals = arr.cast(pa.int64(), safe=False).fill_null(0) \
-                    .to_numpy(zero_copy_only=False)         # ids > 2^53
-                xf = vals.astype(np.float32)                # hash exactly
-                if arr.null_count:
-                    xf = np.where(valid, xf, np.nan)
-                x[:n, spec.num_lane] = xf
-            if hashes:
-                keys = _num_keys(vals)
-                hll_packed[:n, spec.hash_lane] = _packed_obs(
-                    keys, valid, hll_precision)
-                if full_hashes:
-                    # exact distinct counting needs the unpacked 64-bit
-                    # stream (the HLL plane keeps only 16 packed bits)
-                    num_hashes[spec.name] = (_hash64(keys), valid)
+            nh = num_hashes.get(spec.name) if hashes and full_hashes \
+                else None
+            _fill_num_rows(arr, spec, x, hll_packed, hashes,
+                           hll_precision, 0, nh)
         elif spec.role == "date":
             valid = arr.is_valid().to_numpy(zero_copy_only=False)
             ints = arr.cast(pa.timestamp("ns"), safe=False) \
@@ -506,17 +565,46 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
 
     # Column decode is embarrassingly parallel (disjoint output columns)
     # and numpy/arrow/ctypes all release the GIL, so on multi-core hosts
-    # a thread pool overlaps the work; single-core stays serial.
-    workers = min(decode_threads if decode_threads is not None
-                  else _decode_threads(), len(plan.specs))
-    if workers > 1:
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            list(pool.map(lambda iv: decode_column(*iv),
-                          enumerate(plan.specs)))
-    else:
-        for i, spec in enumerate(plan.specs):
-            decode_column(i, spec)
+    # the shared pool overlaps the work; single-core stays serial.  Tall
+    # batches additionally split their numeric columns into row-chunk
+    # subtasks so a narrow-but-deep table still fills the pool.
+    from tpuprof.config import resolve_prep_workers
+    from tpuprof.ingest import prep
+    workers = resolve_prep_workers(decode_threads)
+    num_split = 1
+    if workers > 1 and n >= 2 * ROW_CHUNK_ROWS and plan.specs:
+        # enough chunks that ~workers tasks exist in total, but never
+        # chunks smaller than ROW_CHUNK_ROWS (task overhead would eat
+        # the overlap they buy)
+        num_split = min(-(-workers // len(plan.specs)) + 1,
+                        n // ROW_CHUNK_ROWS)
+    tasks = []
+    for i, spec in enumerate(plan.specs):
+        arr = batch.column(i)
+        # byte accounting is O(1) metadata — do it here, off the pool
+        if isinstance(arr, pa.DictionaryArray):
+            col_nbytes[spec.name] = arr.indices.nbytes
+            col_dict_nbytes[spec.name] = arr.dictionary.nbytes
+        else:
+            col_nbytes[spec.name] = arr.nbytes
+        if spec.role == "num" and hashes and full_hashes:
+            # chunk tasks fill disjoint slices of one preallocated pair;
+            # the whole-column path fills the same pair in one go
+            num_hashes[spec.name] = (np.empty(n, dtype=np.uint64),
+                                     np.empty(n, dtype=bool))
+        if spec.role == "num" and num_split > 1:
+            nh = num_hashes.get(spec.name) if hashes and full_hashes \
+                else None
+            step = -(-n // num_split)
+            for lo in range(0, n, step):
+                tasks.append(
+                    lambda lo=lo, m=min(step, n - lo), arr=arr,
+                    spec=spec, nh=nh: _fill_num_rows(
+                        arr.slice(lo, m), spec, x, hll_packed, hashes,
+                        hll_precision, lo, nh))
+        else:
+            tasks.append(lambda i=i, spec=spec: decode_column(i, spec))
+    prep.run_tasks(tasks, workers)
 
     return HostBatch(nrows=n, x=x, row_valid=row_valid, hll=hll_packed,
                      cat_codes=cat_codes, date_ints=date_ints,
@@ -536,7 +624,8 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                       positions: bool = False,
                       resume_pos: Optional[Tuple[int, int]] = None,
                       workers: Optional[int] = None,
-                      full_hashes: bool = False):
+                      full_hashes: bool = False,
+                      prep_workers: Optional[int] = None):
     """Yield prepared HostBatches with decode/hash/pack of DIFFERENT
     batches pipelined across a small thread pool (``workers``, default
     ``_prepare_workers()``), so one process can saturate its cores
@@ -579,13 +668,13 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
     # (wide-numeric tables would otherwise multiply by the readahead).
     if full_hashes:
         depth = w
-    # concurrent prepares split the host's cores: each batch's internal
-    # per-column pool gets its share instead of all of them (w batches
-    # times 8 column threads would thrash a smaller host)
-    col_threads = None
-    if w > 1:
-        import os
-        col_threads = max(1, (os.cpu_count() or 1) // w)
+    # intra-batch width: the column/row-chunk tasks of ALL concurrent
+    # prepares share ONE process-wide pool (ingest/prep.py), so the
+    # host's total prep threads stay bounded by the resolved width no
+    # matter how many batches are in flight — no per-prepare core
+    # division, no thread thrash
+    from tpuprof.config import resolve_prep_workers
+    col_threads = resolve_prep_workers(prep_workers)
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     sentinel = object()
     failure = []
@@ -656,18 +745,11 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
 
 
 def _prepare_workers() -> int:
-    """Cross-batch prepare parallelism.  Each prepare already fans out
-    across columns internally (``_decode_threads``), so the cross-batch
-    tier mainly covers the per-column serial portions and the tail;
-    half the cores capped at 4 saturates hosts up to ~8 cores, and
-    ``TPUPROF_PREPARE_WORKERS`` raises it on bigger ones.  1 on a
-    single-core host — the pipeline then degenerates to exactly the
-    old one-reader behavior."""
-    import os
-    env = os.environ.get("TPUPROF_PREPARE_WORKERS")
-    if env:
-        return max(int(env), 1)
-    return max(1, min(4, (os.cpu_count() or 1) // 2))
+    """Cross-batch prepare parallelism (see config.resolve_prepare_workers
+    — env resolution lives in config.py so overrides round-trip through
+    one place; conftest.py asserts that contract)."""
+    from tpuprof.config import resolve_prepare_workers
+    return resolve_prepare_workers(None)
 
 
 def _open_path_dataset(path: str) -> pads.Dataset:
@@ -708,11 +790,10 @@ def _open_path_dataset(path: str) -> pads.Dataset:
 
 
 def _decode_threads() -> int:
-    import os
-    env = os.environ.get("TPUPROF_DECODE_THREADS")
-    if env:
-        return max(int(env), 1)
-    return min(os.cpu_count() or 1, 8)
+    """Intra-batch prep parallelism (pre-round-6 name, kept for callers;
+    env resolution lives in config.resolve_prep_workers)."""
+    from tpuprof.config import resolve_prep_workers
+    return resolve_prep_workers(None)
 
 
 def validate_projection(columns: Sequence[str],

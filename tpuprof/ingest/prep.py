@@ -1,0 +1,106 @@
+"""Shared thread pools for parallel host-side batch prep.
+
+Two tiers, two pools, no nesting:
+
+* the **column pool** runs the intra-batch leaf tasks of
+  ``prepare_batch`` — per-column decode/hash/pack, and per-row-chunk
+  subtasks for wide numeric planes.  Leaf tasks never submit work, so
+  any number of concurrent prepares can share one pool without a
+  saturation deadlock.  Sized by :func:`tpuprof.config.resolve_prep_workers`.
+* the **batch pool** runs whole-batch prepares for the ordered
+  cross-batch pipelines (``prefetch_prepared``, the streaming drain).
+  Batch tasks DO fan out — onto the column pool, never onto their own —
+  so the two tiers form a DAG and cannot wait on themselves.
+
+Both pools are process-wide and lazily built: spawning threads per batch
+costs more than the work they'd overlap at small shapes, and the hot
+paths (Arrow decode, numpy casts/copies, the native xxh64 hash+pack)
+all release the GIL, so one shared pool keeps the host's cores busy
+without thread thrash.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+_LOCK = threading.Lock()
+_COL_POOL: Optional[ThreadPoolExecutor] = None
+_COL_WORKERS = 0
+_BATCH_POOL: Optional[ThreadPoolExecutor] = None
+_BATCH_WORKERS = 0
+
+
+def _shared(kind: str, workers: int) -> ThreadPoolExecutor:
+    """The shared pool of one tier, grown (never shrunk) to ``workers``.
+    A replaced pool drains its queued tasks before dying — futures from
+    it stay valid, so a grow mid-pipeline loses nothing."""
+    global _COL_POOL, _COL_WORKERS, _BATCH_POOL, _BATCH_WORKERS
+    with _LOCK:
+        if kind == "col":
+            if _COL_POOL is None or _COL_WORKERS < workers:
+                _COL_POOL = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="tpuprof-col")
+                _COL_WORKERS = workers
+            return _COL_POOL
+        if _BATCH_POOL is None or _BATCH_WORKERS < workers:
+            _BATCH_POOL = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="tpuprof-batch")
+            _BATCH_WORKERS = workers
+        return _BATCH_POOL
+
+
+def run_tasks(tasks: Sequence[Callable[[], None]], workers: int) -> None:
+    """Run intra-batch leaf tasks, on the column pool when it helps.
+
+    Tasks write into disjoint output slices, so completion order is
+    irrelevant to the result — the caller's planes are byte-identical
+    at any width.  All tasks are awaited even on failure (a late writer
+    into a freed plane would corrupt a NEIGHBORING batch); the first
+    exception in submission order re-raises, matching what the serial
+    loop would have raised first."""
+    if workers <= 1 or len(tasks) <= 1:
+        for t in tasks:
+            t()
+        return
+    futs = [_shared("col", workers).submit(t) for t in tasks]
+    first: Optional[BaseException] = None
+    for f in futs:
+        try:
+            f.result()
+        except BaseException as exc:    # noqa: BLE001 — re-raised below
+            if first is None:
+                first = exc
+    if first is not None:
+        raise first
+
+
+def ordered_map(items: Iterable, fn: Callable, workers: int,
+                depth: int = 2) -> Iterator:
+    """Map ``fn`` over ``items`` on the batch pool with IN-ORDER
+    delivery: up to ``depth`` results are in flight ahead of the
+    consumer, so prep for item N+1 overlaps whatever the consumer does
+    with item N (a device fold, typically).  ``workers <= 1`` runs
+    serially — the degenerate case is exactly a for loop.
+
+    Unlike ``prefetch_prepared`` this is for a KNOWN worklist (e.g. the
+    streaming drain's device-batch slices); the enumeration itself is
+    assumed cheap and runs in the caller's thread."""
+    if workers <= 1:
+        for it in items:
+            yield fn(it)
+        return
+    pool = _shared("batch", workers)
+    pending: List = []
+    depth = max(depth, 1)
+    try:
+        for it in items:
+            pending.append(pool.submit(fn, it))
+            while len(pending) > depth:
+                yield pending.pop(0).result()
+        while pending:
+            yield pending.pop(0).result()
+    finally:
+        for f in pending:       # consumer bailed: don't leak queued work
+            f.cancel()

@@ -11,6 +11,14 @@ Format: a single ``.npz``-style numpy archive for the device pytree
 dicts hold arbitrary python values — strings, timestamps).  Not a
 wire-portable format; it is a crash-recovery artifact, same machine
 class in and out.
+
+Cursor contract under parallel ingest: prepare workers race batches
+ahead of the device fold, but the cursor saved here counts DELIVERED
+(in-order) batches only — the prefetch pipeline yields in raw-stream
+order, and a due checkpoint forces a device flush first, so the saved
+cursor always equals the device-folded batch count regardless of prep
+parallelism (tests/test_resume.py pins monotonicity and the final
+artifact-equals-fold invariant at 4 workers).
 """
 
 from __future__ import annotations

@@ -29,7 +29,18 @@ from typing import Any, Dict, NamedTuple, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+
+try:                                    # jax >= 0.6: public API
+    from jax import shard_map
+except ImportError:                     # jax < 0.6: experimental twin —
+    # same semantics, but the replication-check kwarg is still called
+    # check_rep there (renamed to check_vma with the public promotion)
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuprof.kernels import corr, fused, histogram, hll, moments

@@ -143,6 +143,10 @@ class StreamingProfiler:
         # reuse) — the same per-scan caches ArrowIngest owns
         self._col_stats: Dict[str, int] = {}
         self._dict_cache: Dict[str, Dict[str, object]] = {}
+        # intra-batch prep width (None = auto); prepare_batch resolves
+        # it via config.resolve_prep_workers, and the shared column pool
+        # bounds the process's total prep threads either way
+        self._prep_width = self.config.prep_workers
 
     @classmethod
     def for_example(cls, example: Any, **kwargs) -> "StreamingProfiler":
@@ -188,17 +192,27 @@ class StreamingProfiler:
                   rows=self.hostagg.n_rows + self._buf_rows,
                   buffered=self._buf_rows)
 
-    def _fold(self, tbl: pa.Table) -> None:
-        """Fold one <=device-batch slice of buffered rows."""
+    def _prepare_slice(self, tbl: pa.Table) -> Optional["object"]:
+        """Decode one <=device-batch slice into a HostBatch (host-only
+        work — safe off-thread; the intra-batch budget splits across
+        concurrent prepares like prefetch_prepared's does)."""
         combined = tbl.combine_chunks()
         rbs = combined.to_batches()
         if not rbs:
+            return None
+        return prepare_batch(rbs[0], self.plan, self.runner.rows,
+                             self.config.hll_precision,
+                             dict_cache=self._dict_cache,
+                             col_stats=self._col_stats,
+                             decode_threads=self._prep_width,
+                             full_hashes=self.config.exact_distinct)
+
+    def _fold_prepared(self, hb) -> None:
+        """Fold one prepared batch — the ORDERED half: device step,
+        sampler, HLL registers, Misra-Gries all consume completed
+        batches in stream order, never inside racing prep workers."""
+        if hb is None:
             return
-        hb = prepare_batch(rbs[0], self.plan, self.runner.rows,
-                           self.config.hll_precision,
-                           dict_cache=self._dict_cache,
-                           col_stats=self._col_stats,
-                           full_hashes=self.config.exact_distinct)
         if self.state is None:
             from tpuprof.backends.tpu import estimate_shift
             self.state = self.runner.init_pass_a(estimate_shift(hb))
@@ -213,21 +227,35 @@ class StreamingProfiler:
     def _drain(self, force: bool) -> None:
         """Fold buffered rows: full device batches always; the partial
         remainder only when forced (snapshot/checkpoint) or when the
-        user chose a flush quantum below the device batch size."""
+        user chose a flush quantum below the device batch size.
+
+        With multiple full batches buffered (a bursty stream, a large
+        force-drain) prep of slice N+1 runs on the shared batch pool
+        while the device folds slice N — depth-2 in flight, in-order
+        delivery, so cursor order and sampler state are exactly the
+        serial stream's."""
         if not self._buf_rows:
             return
         rows = self.runner.rows
         tbl = pa.Table.from_batches(self._buf)
         n, pos = tbl.num_rows, 0
+        slices = []
         while n - pos >= rows:
-            self._fold(tbl.slice(pos, rows))
+            slices.append(tbl.slice(pos, rows))
             pos += rows
         if pos < n and (force or self._flush_rows < rows):
-            self._fold(tbl.slice(pos))
+            slices.append(tbl.slice(pos))
             pos = n
         rem = tbl.slice(pos)
         self._buf = rem.to_batches() if rem.num_rows else []
         self._buf_rows = rem.num_rows
+        from tpuprof.config import resolve_prepare_workers
+        from tpuprof.ingest import prep
+        w = resolve_prepare_workers(self.config.prepare_workers) \
+            if len(slices) > 1 else 1
+        for hb in prep.ordered_map(slices, self._prepare_slice,
+                                   workers=w, depth=2):
+            self._fold_prepared(hb)
 
     # -- snapshots ---------------------------------------------------------
 
