@@ -172,6 +172,31 @@ def _measure_e2e(runner, staged):
     }
 
 
+def _measure_render() -> float:
+    """HTML render seconds of a small mixed profile (CPU oracle — no
+    device anywhere), warmed once so the jinja env compile is excluded.
+    This is the ``render`` stage a production profile pays once at the
+    end; benched here so BENCH rounds can see a template regression."""
+    import pandas as pd
+
+    from tpuprof import ProfileReport, ProfilerConfig
+    from tpuprof.obs.spans import span
+
+    rng = np.random.default_rng(0)
+    n = 2_000 if _SMOKE else 20_000
+    df = pd.DataFrame({
+        "a": rng.normal(size=n), "b": rng.integers(0, 50, size=n),
+        "c": rng.choice(["x", "y", "z"], size=n),
+        "d": rng.random(size=n) > 0.5})
+    report = ProfileReport(df, config=ProfilerConfig(backend="cpu"))
+    from tpuprof.report.render import to_standalone_html
+    to_standalone_html(report.description, report.config)   # warm jinja
+    t0 = time.perf_counter()
+    with span("render"):
+        to_standalone_html(report.description, report.config)
+    return time.perf_counter() - t0
+
+
 def _measure_host_prep() -> dict:
     """Host-side batch-prep rate (Arrow → F-order f32/hash planes) on
     the 23-mixed-col cost-model fixture — the true end-to-end ceiling on
@@ -188,10 +213,20 @@ def _measure_host_prep() -> dict:
 def main() -> None:
     import jax
 
+    from tpuprof import obs
     from tpuprof.config import ProfilerConfig
+    from tpuprof.obs.spans import span
     from tpuprof.runtime.mesh import MeshRunner
 
-    host_prep = _measure_host_prep()      # before any device traffic
+    # per-stage attribution (ISSUE 2): the spans below feed
+    # get_phase_report, and the registry counters ride the "obs" block —
+    # a BENCH regression can then be blamed on a STAGE, not re-derived
+    obs.configure(enabled=True)
+    obs.get_phase_report(reset=True)
+
+    with span("prep"):
+        host_prep = _measure_host_prep()  # before any device traffic
+    render_s = _measure_render()          # host-only, before the device
 
     devices = jax.devices()[:1]           # single-chip measurement
     config = ProfilerConfig(batch_rows=BATCH_ROWS, quantile_sketch_size=4096)
@@ -199,7 +234,12 @@ def main() -> None:
     staged = _stage(runner)
 
     rate_a = _measure_pass_a(runner, staged)
-    e2e = _measure_e2e(runner, staged)
+    with span("fold"):
+        e2e = _measure_e2e(runner, staged)
+
+    phases = obs.get_phase_report()
+    snap = obs.registry().snapshot()
+    disp = snap["counters"].get("tpuprof_device_dispatch_total", {})
 
     print(json.dumps({
         "metric": "profile_e2e_rows_per_sec_per_chip",
@@ -227,6 +267,20 @@ def main() -> None:
         "host_prepare_speedup": host_prep["speedup"],
         "host_prepare_workers": host_prep["workers"],
         "host_prepare_cpus": host_prep["cpus"],
+        # per-stage breakdown (obs spans; NEW keys only — existing keys
+        # above keep their names so BENCH_r* comparisons stay valid)
+        "stage_prep_s": round(phases.get("prep", 0.0), 3),
+        "stage_fold_s": round(phases.get("fold", 0.0), 3),
+        "stage_render_s": round(phases.get("render", render_s), 4),
+        "obs": {
+            "phases_s": {k: round(v, 4) for k, v in sorted(phases.items())},
+            "device_dispatches": {k or "total": int(v)
+                                  for k, v in sorted(disp.items())},
+            "rows_ingested": int(snap["counters"].get(
+                "tpuprof_ingest_rows_total", {}).get("", 0)),
+            "prep_tasks": int(sum(snap["counters"].get(
+                "tpuprof_prep_tasks_total", {}).values())),
+        },
     }))
 
 

@@ -1,0 +1,404 @@
+"""tpuprof/obs — metrics registry, span tracing, heartbeat, and the
+trace.py satellites (ISSUE 2)."""
+
+import json
+import logging
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import obs
+from tpuprof.obs import events, metrics
+from tpuprof.obs.metrics import MetricsRegistry
+from tpuprof.obs.progress import RateEMA
+from tpuprof.utils import trace
+
+
+@pytest.fixture
+def obs_enabled():
+    """Enable recording on the process registry for one test, restoring
+    the disabled default (and a clean slate) afterwards."""
+    prev = metrics.enabled()
+    metrics.registry().reset()
+    metrics.set_enabled(True)
+    yield metrics.registry()
+    metrics.set_enabled(prev)
+    metrics.registry().reset()
+    events.set_sink(None)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_roundtrip():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("c_total", "a counter")
+    c.inc()
+    c.inc(2, program="x")
+    g = reg.gauge("g")
+    g.set(3.5)
+    g.inc(0.5)
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    assert c.value() == 1
+    assert c.value(program="x") == 2
+    assert c.total() == 3
+    assert g.value() == 4.0
+    s = h.summary()
+    assert s["count"] == 3
+    assert s["sum"] == pytest.approx(5.55)
+
+    snap = reg.snapshot()
+    assert snap["counters"]["c_total"][""] == 1
+    assert snap["counters"]["c_total"]['{program="x"}'] == 2
+    assert snap["gauges"]["g"][""] == 4.0
+    assert snap["histograms"]["h_seconds"][""]["count"] == 3
+    json.dumps(snap)    # must be JSON-clean as-is
+
+
+def test_render_text_prometheus_shape():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("c_total", "things").inc(4, kind="a")
+    reg.histogram("h_seconds", buckets=(0.1, 1.0)).observe(0.5)
+    reg.gauge("never_fired")
+    text = reg.render_text()
+    assert "# TYPE c_total counter" in text
+    assert "# HELP c_total things" in text
+    assert 'c_total{kind="a"} 4' in text
+    # cumulative buckets + sum/count
+    assert 'h_seconds_bucket{le="0.1"} 0' in text
+    assert 'h_seconds_bucket{le="1"} 1' in text
+    assert 'h_seconds_bucket{le="+Inf"} 1' in text
+    assert "h_seconds_count 1" in text
+    # a registered-but-silent instrument renders an honest zero
+    assert "never_fired 0" in text
+
+
+def test_disabled_registry_records_nothing():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("c_total")
+    h = reg.histogram("h_seconds")
+    c.inc(100)
+    h.observe(1.0)
+    assert c.total() == 0
+    assert h.summary()["count"] == 0
+    reg.enabled = True
+    c.inc()
+    assert c.total() == 1
+
+
+def test_kind_mismatch_raises():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x")
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("c_total")
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.total() == 8000
+
+
+# ---------------------------------------------------------------------------
+# spans / phase report
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_phase_report(obs_enabled):
+    obs.get_phase_report(reset=True)
+    with obs.span("outer"):
+        assert obs.current_path() == "outer"
+        with obs.span("inner"):
+            assert obs.current_path() == "outer.inner"
+    report = obs.get_phase_report(reset=True)
+    assert set(report) >= {"outer", "inner"}
+    assert report["outer"] >= report["inner"]
+    # the metrics twin recorded both leaf names
+    text = obs_enabled.render_text()
+    assert 'tpuprof_span_seconds_count{name="outer"} 1' in text
+
+
+def test_span_records_on_exception(obs_enabled):
+    obs.get_phase_report(reset=True)
+    with pytest.raises(RuntimeError):
+        with obs.span("doomed"):
+            raise RuntimeError("boom")
+    assert "doomed" in obs.get_phase_report(reset=True)
+
+
+def test_phase_timer_alias_still_works():
+    """Existing call sites import phase_timer from utils.trace; it must
+    keep feeding get_phase_report (the report-footer contract)."""
+    trace.get_phase_report(reset=True)
+    with trace.phase_timer("legacy"):
+        pass
+    assert "legacy" in trace.get_phase_report(reset=True)
+
+
+def test_phase_report_concurrent_accumulation(obs_enabled):
+    """Satellite: parallel phase_timer contexts from a prep-pool-like
+    fan-out must not lose or double-count totals, including under a
+    concurrent reset=True reader."""
+    trace.get_phase_report(reset=True)
+    n_threads, n_iters = 8, 50
+    barrier = threading.Barrier(n_threads)
+    harvested = []
+
+    def worker():
+        barrier.wait()
+        for _ in range(n_iters):
+            with trace.phase_timer("concurrent"):
+                pass
+
+    def harvester():
+        # races get_phase_report(reset=True) against the timers; every
+        # close must land in exactly one harvest
+        for _ in range(200):
+            harvested.append(
+                trace.get_phase_report(reset=True).get("concurrent", 0.0))
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    hv = threading.Thread(target=harvester)
+    for t in threads:
+        t.start()
+    hv.start()
+    for t in threads:
+        t.join()
+    hv.join()
+    final = trace.get_phase_report(reset=True).get("concurrent", 0.0)
+    total_time = sum(harvested) + final
+    assert total_time > 0
+    # the metrics twin counts every single close — none lost, none
+    # double-counted (the registry is independent of the reset races)
+    count = metrics.registry().histogram(
+        "tpuprof_span_seconds").summary(name="concurrent")["count"]
+    assert count == n_threads * n_iters
+
+
+def test_span_stacks_are_per_thread(obs_enabled):
+    """A span opened on a worker thread must not nest under (or pop)
+    the main thread's stack."""
+    paths = []
+
+    def worker():
+        with obs.span("w"):
+            paths.append(obs.current_path())
+
+    with obs.span("main"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert obs.current_path() == "main"
+    assert paths == ["w"]
+
+
+# ---------------------------------------------------------------------------
+# trace.py satellites
+# ---------------------------------------------------------------------------
+
+def test_trace_to_logs_even_when_body_raises(caplog, tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    with caplog.at_level(logging.INFO, logger="tpuprof"):
+        with pytest.raises(RuntimeError):
+            with trace.trace_to(trace_dir):
+                raise RuntimeError("mid-trace crash")
+    assert any("trace written" in r.message for r in caplog.records), \
+        "the 'trace written' line must survive a raising body"
+
+
+def test_trace_to_noop_without_dir():
+    with trace.trace_to(None):
+        pass
+    with trace.trace_to(""):
+        pass
+
+
+def test_log_event_numpy_fields(caplog):
+    """Satellite regression: numpy scalars in log_event fields must not
+    crash serialization (json can't encode them natively)."""
+    with caplog.at_level(logging.DEBUG, logger="tpuprof"):
+        trace.log_event("numpy_fields", n=np.int64(7), x=np.float32(1.5),
+                        flag=np.bool_(True), arr_elem=np.arange(3)[1])
+    msgs = [r.message for r in caplog.records
+            if "numpy_fields" in r.message]
+    assert msgs, "event was not logged at all"
+    decoded = json.loads(msgs[-1])   # the line is valid JSON
+    assert decoded["event"] == "numpy_fields"
+    assert decoded["n"] in (7, "7")
+
+
+# ---------------------------------------------------------------------------
+# events / JSONL
+# ---------------------------------------------------------------------------
+
+def test_jsonl_sink_spans_and_snapshot(tmp_path, obs_enabled):
+    path = str(tmp_path / "m.jsonl")
+    events.set_sink(path)
+    with obs.span("stage", cols=np.int64(3)):   # numpy meta must coerce
+        pass
+    obs.counter("tpuprof_sink_test_total").inc(2)
+    obs.finalize(reason="test")
+    events.set_sink(None)
+
+    lines = [json.loads(l) for l in open(path)]
+    kinds = {l["kind"] for l in lines}
+    assert {"span", "metric"} <= kinds
+    span_ev = next(l for l in lines if l["kind"] == "span")
+    assert span_ev["name"] == "stage"
+    assert span_ev["seconds"] >= 0
+    assert all("ts" in l for l in lines)
+    metric_ev = [l for l in lines if l["kind"] == "metric"]
+    assert any(l["name"] == "tpuprof_sink_test_total" and l["value"] == 2
+               for l in metric_ev)
+
+
+# ---------------------------------------------------------------------------
+# progress / EMA
+# ---------------------------------------------------------------------------
+
+def test_rate_ema_tracks_and_decays():
+    t = [0.0]
+    ema = RateEMA(halflife=1.0, clock=lambda: t[0])
+    assert ema.rate() == 0.0
+    ema.update(0)           # starts the clock
+    for _ in range(20):     # 1000 rows/s steady for 20s
+        t[0] += 1.0
+        ema.update(1000)
+    steady = ema.rate()
+    assert steady == pytest.approx(1000, rel=0.01)
+    t[0] += 10.0            # 10 halflives of silence
+    assert ema.rate() < steady / 500
+
+
+def test_rate_ema_same_instant_updates_coalesce():
+    t = [0.0]
+    ema = RateEMA(halflife=1.0, clock=lambda: t[0])
+    ema.update(0)
+    ema.update(500)         # same instant: accumulate, no div-by-zero
+    t[0] += 1.0
+    ema.update(500)
+    assert ema.rate() > 0
+
+
+# ---------------------------------------------------------------------------
+# streaming acceptance: heartbeat + metrics end to end
+# ---------------------------------------------------------------------------
+
+def _mixed_frame(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "f": rng.normal(size=n).astype(np.float64),
+        "i": rng.integers(0, 1000, size=n),
+        "b": rng.random(size=n) > 0.5,
+        "c": rng.choice(["alpha", "beta", "gamma"], size=n),
+        "t": pd.Timestamp("2026-01-01")
+             + pd.to_timedelta(rng.integers(0, 10_000, size=n), unit="s"),
+    })
+
+
+def test_streaming_metrics_and_heartbeat(tmp_path, obs_enabled):
+    from tpuprof.config import ProfilerConfig
+    from tpuprof.runtime.stream import StreamingProfiler
+
+    jsonl = str(tmp_path / "stream.jsonl")
+    events.set_sink(jsonl)
+    cfg = ProfilerConfig(batch_rows=1 << 10, metrics_enabled=True)
+    df = _mixed_frame(3000)
+    with StreamingProfiler.for_example(df, config=cfg) as prof:
+        for lo in range(0, 3000, 1000):
+            prof.update(df.iloc[lo:lo + 1000])
+        hb = prof.heartbeat()
+        prof.checkpoint(str(tmp_path / "s.ckpt"))
+        stats = prof.stats()
+
+    # heartbeat shape + progress line
+    assert hb["rows_folded"] + hb["rows_buffered"] >= 2000
+    assert hb["batches_folded"] >= 1
+    assert "rows folded" in prof.progress()
+
+    # acceptance: render_text holds rows-ingested counters, span
+    # timings, and checkpoint durations
+    text = obs_enabled.render_text()
+    assert "tpuprof_ingest_rows_total 3000" in text
+    assert 'tpuprof_span_seconds_count{name="drain"}' in text
+    assert "tpuprof_checkpoint_save_seconds_count 1" in text
+    assert "tpuprof_stream_batches_folded_total" in text
+
+    # snapshot rode the stats dict for the report footer
+    assert stats["_obs"]["counters"]["tpuprof_ingest_rows_total"][""] \
+        == 3000
+
+    # the JSONL trail has spans and checkpoint events
+    lines = [json.loads(l) for l in open(jsonl)]
+    kinds = {l["kind"] for l in lines}
+    assert {"span", "heartbeat", "checkpoint_save"} <= kinds
+
+
+def test_report_footer_pipeline_stats(obs_enabled):
+    from tpuprof.report.render import _pipeline_stats_line
+    line = _pipeline_stats_line({"_obs": {
+        "counters": {
+            "tpuprof_ingest_rows_total": {"": 1234},
+            "tpuprof_ingest_batches_total": {"": 3},
+            "tpuprof_device_dispatch_total": {'{program="step_a"}': 5},
+            "tpuprof_prep_numeric_path_total": {
+                '{path="zero_copy"}': 3, '{path="slow"}': 1},
+        },
+        "histograms": {
+            "tpuprof_checkpoint_save_seconds": {
+                "": {"count": 2, "sum": 0.5, "mean": 0.25}},
+        },
+    }})
+    assert "1,234 rows ingested" in line
+    assert "5 device dispatches" in line
+    assert "75% zero-copy decodes" in line
+    assert "2 checkpoints" in line
+    # and without a snapshot the line is empty (footer omits it)
+    assert _pipeline_stats_line({}) == ""
+
+
+def test_metrics_disabled_is_default_and_inert():
+    """With nothing configured, a prepare records no metrics — the
+    disabled path is the production default."""
+    import pyarrow as pa
+
+    from tpuprof.ingest.arrow import ArrowIngest, prepare_batch
+    metrics.registry().reset()
+    assert not metrics.enabled()
+    tbl = pa.Table.from_pandas(_mixed_frame(256), preserve_index=False)
+    ing = ArrowIngest(tbl, batch_rows=256)
+    for _, _, rb in ing.raw_batches_positioned():
+        prepare_batch(rb, ing.plan, 256, 11, dict_cache=ing._dict_cache,
+                      col_stats=ing._col_stats)
+    assert metrics.registry().counter(
+        "tpuprof_ingest_rows_total").total() == 0
+
+
+def test_resolve_metrics_enabled_env(monkeypatch):
+    from tpuprof.config import resolve_metrics_enabled
+    monkeypatch.delenv("TPUPROF_METRICS", raising=False)
+    assert resolve_metrics_enabled(None, None) is False
+    assert resolve_metrics_enabled(None, "m.jsonl") is True
+    assert resolve_metrics_enabled(True, None) is True
+    monkeypatch.setenv("TPUPROF_METRICS", "1")
+    assert resolve_metrics_enabled(None, None) is True
+    monkeypatch.setenv("TPUPROF_METRICS", "0")
+    assert resolve_metrics_enabled(None, None) is False
+    # explicit config beats the env either way
+    assert resolve_metrics_enabled(True, None) is True

@@ -1,0 +1,117 @@
+"""CI smoke: the CLI's --metrics-json on a tiny fixture, end to end in
+a real subprocess (``python -m tpuprof``), with the emitted JSONL
+validated line by line against EVENT_SCHEMA (the contract documented in
+OBSERVABILITY.md — hand-rolled validation, no jsonschema dependency)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+# kind -> {field: (types, required)}; fields outside the schema are
+# allowed (span metadata is open), unknown kinds are not
+EVENT_SCHEMA = {
+    "span": {"ts": ((int, float), True), "name": ((str,), True),
+             "seconds": ((int, float), True), "path": ((str,), True),
+             "depth": ((int,), True)},
+    "metric": {"ts": ((int, float), True), "name": ((str,), True),
+               "type": ((str,), True), "labels": ((str,), True),
+               "reason": ((str,), True),
+               "value": ((int, float), False),
+               "count": ((int,), False), "sum": ((int, float), False),
+               "mean": ((int, float), False)},
+    "checkpoint_save": {"ts": ((int, float), True), "path": ((str,), True),
+                        "cursor": ((int,), True),
+                        "seconds": ((int, float), True)},
+    "checkpoint_restore": {"ts": ((int, float), True),
+                           "path": ((str,), True), "cursor": ((int,), True),
+                           "seconds": ((int, float), True)},
+    "heartbeat": {"ts": ((int, float), True),
+                  "rows_folded": ((int,), True)},
+}
+
+
+def validate_event(ev: dict) -> None:
+    assert isinstance(ev, dict), f"event is not an object: {ev!r}"
+    kind = ev.get("kind")
+    assert kind in EVENT_SCHEMA, f"unknown event kind {kind!r}: {ev}"
+    spec = EVENT_SCHEMA[kind]
+    for field, (types, required) in spec.items():
+        if field not in ev:
+            assert not required, f"{kind} event missing {field!r}: {ev}"
+            continue
+        # bool is an int subclass — reject it where a number is expected
+        val = ev[field]
+        assert not isinstance(val, bool) or bool in types, \
+            f"{kind}.{field} is a bool, expected {types}: {ev}"
+        assert isinstance(val, types), \
+            f"{kind}.{field} = {val!r} not of {types}: {ev}"
+    if kind == "metric":
+        has_value = "value" in ev
+        has_hist = "count" in ev and "sum" in ev
+        assert has_value or has_hist, \
+            f"metric event carries neither value nor count/sum: {ev}"
+
+
+@pytest.mark.smoke
+def test_cli_metrics_json_smoke(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 1500
+    df = pd.DataFrame({
+        "a": rng.normal(10, 2, n),
+        "b": rng.integers(0, 100, n),
+        "c": rng.choice(["x", "y", "z"], n),
+    })
+    src = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), src)
+    out = str(tmp_path / "r.html")
+    mpath = str(tmp_path / "m.jsonl")
+    ckpt = str(tmp_path / "c.ckpt")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("TPUPROF_METRICS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuprof", "profile", src, "-o", out,
+         "--backend", "tpu", "--batch-rows", "1024",
+         "--metrics-json", mpath, "--checkpoint", ckpt,
+         "--checkpoint-every", "1", "--no-compile-cache"],
+        env=env, capture_output=True, text=True, timeout=420,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    # every line validates against the schema
+    lines = [json.loads(l) for l in open(mpath)]
+    assert lines, "metrics JSONL is empty"
+    for ev in lines:
+        validate_event(ev)
+
+    kinds = {l["kind"] for l in lines}
+    assert "span" in kinds and "metric" in kinds
+    assert "checkpoint_save" in kinds      # --checkpoint-every 1 fired
+    span_names = {l["name"] for l in lines if l["kind"] == "span"}
+    # the pipeline's stages appear as spans (scan_b only with 2 passes)
+    assert {"scan_a", "merge", "render", "profile"} <= span_names
+    metric_names = {l["name"] for l in lines if l["kind"] == "metric"}
+    assert "tpuprof_ingest_rows_total" in metric_names
+    assert "tpuprof_checkpoint_save_seconds" in metric_names
+    rows = [l["value"] for l in lines
+            if l["kind"] == "metric"
+            and l["name"] == "tpuprof_ingest_rows_total"]
+    # two passes over 1500 rows: the final snapshot counts both scans
+    assert max(rows) >= n
+
+    # the Prometheus twin landed next to the JSONL and parses as
+    # exposition text
+    prom = open(mpath + ".prom").read()
+    assert "# TYPE tpuprof_ingest_rows_total counter" in prom
+    assert "tpuprof_span_seconds" in prom
+
+    # the report footer carries the pipeline line
+    page = open(out).read()
+    assert "pipeline:" in page and "rows ingested" in page

@@ -48,8 +48,10 @@ from tpuprof.kernels import histogram as khistogram
 from tpuprof.kernels import unique as kunique
 from tpuprof.kernels.topk import MisraGries
 from tpuprof.kernels.unique import UniqueTracker
+from tpuprof import obs
+from tpuprof.obs.spans import span
 from tpuprof.runtime.mesh import MeshRunner
-from tpuprof.utils.trace import log_event, phase_timer
+from tpuprof.utils.trace import log_event, phase_timer  # noqa: F401 — phase_timer kept for any external caller; new code uses span
 
 
 def estimate_shift(hb: HostBatch) -> np.ndarray:
@@ -459,6 +461,7 @@ class TPUStatsBackend:
         # this profile's timings are snapshotted onto ITS stats dict at
         # the end of collect, so a report's footer can never describe a
         # different profile's scan
+        obs.configure_from_config(config)   # metrics/JSONL sink, if asked
         if config.compile_cache_dir:
             _enable_compile_cache(config.compile_cache_dir)
         from tpuprof.runtime.distributed import (merge_corr_states,
@@ -557,7 +560,9 @@ class TPUStatsBackend:
             # already pinned its artifact to this (stripe, source,
             # config), so a mixed fleet is CORRECT (a fresh host just
             # rescans its own stripe) but worth saying out loud
-            peers = allgather_objects((pshard[0], restored, skip))
+            with span("resume_barrier", rank=pshard[0],
+                      restored=restored):
+                peers = allgather_objects((pshard[0], restored, skip))
             log_event("multihost_resume_barrier", peers=peers)
             flags = {r for _, r, _ in peers}
             if flags == {True, False}:
@@ -624,7 +629,8 @@ class TPUStatsBackend:
         def flush_a(pending):
             flush_group(pending, _staged_a, _one_a)
 
-        with phase_timer("scan_a"):
+        with span("scan_a", cols=len(plan.specs), n_num=plan.n_num,
+                  n_hash=plan.n_hash):
             # centering shift from the first batch's prefix — any value
             # near the data scale conditions the f32 sums equally well.
             # The estimate is agreed ACROSS hosts (deadlock-safe even for
@@ -695,7 +701,7 @@ class TPUStatsBackend:
         bounds_d = None
         if pshard[1] == 1 and config.exact_passes and plan.n_num > 0:
             bounds_d = runner.bounds_b_device(state)
-        with phase_timer("merge"):
+        with span("merge", hosts=pshard[1]):
             res_a = runner.finalize_a(state)
             # cross-host: each host's device sketches merged over ICI by
             # the mesh collectives; the finalized states and host-side
@@ -815,7 +821,7 @@ class TPUStatsBackend:
             def flush_b(pending):
                 flush_group(pending, _staged_b, _one_b)
 
-            with phase_timer("scan_b"):
+            with span("scan_b", spearman=config.spearman):
                 # hashes=False: pass B never reads the HLL plane, so the
                 # host hash loop is skipped on the second scan
                 pending_b: List[HostBatch] = []
@@ -858,13 +864,15 @@ class TPUStatsBackend:
             # hashes=False: the recount reads categorical codes only, so
             # the host hash + HLL-packing loop is skipped on this scan.
             recounter = Recounter(hostagg)
-            for hb in prefetch_prepared(ingest, plan, pad,
-                                        config.hll_precision, hashes=False,
-                                        workers=config.prepare_workers,
-                                        prep_workers=config.prep_workers):
-                recounter.update(hb)
-            # each host recounts only its own fragment stripe
-            recounter.counts = merge_recount_arrays(recounter.counts)
+            with span("scan_b", recount_only=True):
+                for hb in prefetch_prepared(
+                        ingest, plan, pad,
+                        config.hll_precision, hashes=False,
+                        workers=config.prepare_workers,
+                        prep_workers=config.prep_workers):
+                    recounter.update(hb)
+                # each host recounts only its own fragment stripe
+                recounter.counts = merge_recount_arrays(recounter.counts)
 
         stats = _assemble(plan, config, ingest.sample(config.sample_rows),
                           hostagg, momf, rho_all, quants, sample_vals,
@@ -888,6 +896,13 @@ class TPUStatsBackend:
         # footer reads them from there — global state would attribute
         # another profile's scan to this report)
         stats["_phases"] = get_phase_report(reset=True)
+        # likewise the metrics snapshot (counters/spans/checkpoint
+        # durations) for the report's pipeline-stats footer, plus a
+        # final snapshot into the JSONL sink for offline reads
+        snap = obs.snapshot_if_enabled()
+        if snap is not None:
+            stats["_obs"] = snap
+        obs.finalize(reason="collect")
         return stats
 
 

@@ -69,6 +69,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "variables, freq, correlations, messages, sample)")
     p.add_argument("--trace", metavar="DIR",
                    help="capture a jax.profiler trace into DIR")
+    p.add_argument("--metrics-json", metavar="PATH",
+                   help="enable pipeline telemetry (tpuprof/obs) and "
+                        "stream JSONL events here: span timings as they "
+                        "close, checkpoint saves, and metric snapshots "
+                        "(see OBSERVABILITY.md).  Also dumps the final "
+                        "Prometheus text exposition next to PATH "
+                        "(PATH + '.prom')")
+    p.add_argument("--metrics-interval", type=float, default=0.0,
+                   metavar="SEC",
+                   help="with --metrics-json: emit a metrics snapshot "
+                        "every SEC seconds while the profile runs "
+                        "(default: one final snapshot only)")
+    p.add_argument("--progress", action="store_true",
+                   help="print a one-line pipeline status (rows, "
+                        "batches, dispatches, recent rows/s) to stderr "
+                        "every few seconds (implies metrics; interval = "
+                        "--metrics-interval, default 5s)")
     p.add_argument("--unique-spill-dir", metavar="DIR",
                    help="spill sorted hash runs here so exact UNIQUE "
                         "classification never falls back to an estimate "
@@ -208,12 +225,29 @@ def cmd_profile(args: argparse.Namespace) -> int:
                if args.unique_track_rows is not None else {}),
             checkpoint_path=args.checkpoint,
             checkpoint_every_batches=args.checkpoint_every,
+            metrics_enabled=True if (args.metrics_json or args.progress)
+            else None,
+            metrics_path=args.metrics_json,
+            metrics_interval=args.metrics_interval,
             compile_cache_dir=cache_dir)
     except ValueError as exc:
         # config validation (duplicate --columns, bad thresholds, ...)
         # speaks the CLI's error convention, not a traceback
         print(f"tpuprof: error: {exc}", file=sys.stderr)
         return 2
+
+    # observability: configure up front so the ticker (and any code
+    # before collect) records; the backend's configure is then a no-op
+    ticker = None
+    if config.metrics_enabled or args.metrics_json or args.progress:
+        from tpuprof import obs
+        obs.configure_from_config(config)
+        interval = args.metrics_interval \
+            or (5.0 if args.progress else 0.0)
+        if interval > 0:
+            from tpuprof.obs.progress import Ticker
+            ticker = Ticker(interval, progress=args.progress,
+                            snapshots=bool(args.metrics_json)).start()
 
     t0 = time.perf_counter()
     with trace_to(args.trace):
@@ -238,6 +272,17 @@ def cmd_profile(args: argparse.Namespace) -> int:
             with phase_timer("render"):
                 report.to_file(args.output)
     elapsed = time.perf_counter() - t0
+
+    if ticker is not None:
+        ticker.stop()
+    if args.metrics_json:
+        # final snapshot includes the render span the collect-time one
+        # could not see; the .prom twin is the same registry in the
+        # text exposition format (OBSERVABILITY.md "reading the dump")
+        from tpuprof import obs
+        obs.finalize(reason="cli")
+        with open(args.metrics_json + ".prom", "w") as fh:
+            fh.write(obs.registry().render_text())
 
     table = report.description["table"]
     rate = table["n"] / elapsed if elapsed > 0 else float("nan")

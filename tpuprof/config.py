@@ -48,6 +48,20 @@ def resolve_prepare_workers(value: Optional[int] = None) -> int:
     return max(1, min(4, (os.cpu_count() or 1) // 2))
 
 
+def resolve_metrics_enabled(value: Optional[bool] = None,
+                            metrics_path: Optional[str] = None) -> bool:
+    """Observability switch (tpuprof/obs): an explicit config value
+    wins; else ``TPUPROF_METRICS`` ("0"/"" = off, anything else = on);
+    else on exactly when a JSONL sink path was requested (asking for a
+    metrics file implies wanting metrics in it)."""
+    if value is not None:
+        return bool(value)
+    env = os.environ.get("TPUPROF_METRICS")
+    if env is not None:
+        return env not in ("", "0", "false", "no")
+    return metrics_path is not None
+
+
 @dataclasses.dataclass
 class ProfilerConfig:
     # ---- parity knobs (reference constructor kwargs) ----------------------
@@ -212,6 +226,33 @@ class ProfilerConfig:
                                             # 16).  1 = the serial
                                             # reference path, byte-
                                             # identical to any width
+    metrics_enabled: Optional[bool] = None  # pipeline telemetry (tpuprof/
+                                            # obs): counters/gauges/span
+                                            # histograms on the process
+                                            # registry.  None = auto:
+                                            # TPUPROF_METRICS env, else on
+                                            # iff metrics_path is set.
+                                            # Off costs one branch per
+                                            # batch-level site (<2% on the
+                                            # prepare bench — PERF.md)
+    metrics_path: Optional[str] = None      # JSONL event sink (span events
+                                            # as they close + metric
+                                            # snapshots; OBSERVABILITY.md).
+                                            # CLI: --metrics-json.  Also
+                                            # honored via
+                                            # TPUPROF_METRICS_PATH
+    metrics_interval: float = 0.0           # seconds between periodic
+                                            # snapshot events into the
+                                            # sink (0 = final snapshot
+                                            # only; CLI --metrics-interval)
+    metrics_block_sample: int = 0           # time every Nth device
+                                            # dispatch with
+                                            # jax.block_until_ready
+                                            # (kernels/fused.py).  0 =
+                                            # never sync for telemetry;
+                                            # small N costs real overlap —
+                                            # it is a debugging rate, not
+                                            # a production default
     seed: int = 0                   # PRNG seed for the sample sketch
     use_pallas: Optional[bool] = None   # None = auto (on for real TPU):
                                         # dense pallas histogram kernel vs
@@ -266,6 +307,11 @@ class ProfilerConfig:
             raise ValueError("prepare_workers must be >= 1 (or None)")
         if self.prep_workers is not None and self.prep_workers < 1:
             raise ValueError("prep_workers must be >= 1 (or None)")
+        if self.metrics_interval < 0:
+            raise ValueError("metrics_interval must be >= 0")
+        if self.metrics_block_sample < 0:
+            raise ValueError("metrics_block_sample must be >= 0 "
+                             "(0 disables block-timing sampling)")
         if self.parity:
             if not self.exact_passes:
                 raise ValueError(

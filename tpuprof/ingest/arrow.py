@@ -46,6 +46,26 @@ import pyarrow.compute as pc
 import pyarrow.dataset as pads
 
 from tpuprof import schema
+from tpuprof.obs import metrics as _obs_metrics
+
+# ---- ingest telemetry (OBSERVABILITY.md; off = one branch per batch) ----
+_ROWS_INGESTED = _obs_metrics.counter(
+    "tpuprof_ingest_rows_total", "rows decoded into HostBatch planes")
+_BYTES_INGESTED = _obs_metrics.counter(
+    "tpuprof_ingest_bytes_total",
+    "Arrow buffer bytes decoded (indices + dictionaries)")
+_BATCHES_INGESTED = _obs_metrics.counter(
+    "tpuprof_ingest_batches_total", "record batches prepared")
+_NUM_PATHS = _obs_metrics.counter(
+    "tpuprof_prep_numeric_path_total",
+    "numeric column-chunk decodes by path: zero_copy (no-null f64/int "
+    "view) vs slow (cast/fill_null chain)")
+_PREP_SECONDS = _obs_metrics.histogram(
+    "tpuprof_prep_batch_seconds", "wall seconds per prepare_batch call")
+_QUEUE_DEPTH = _obs_metrics.gauge(
+    "tpuprof_prep_queue_depth",
+    "prepared batches (futures) buffered ahead of the consumer in "
+    "prefetch_prepared")
 
 
 @dataclasses.dataclass
@@ -248,20 +268,24 @@ def _fill_num_rows(arr: pa.Array, spec: "ColumnSpec", x: np.ndarray,
         vals = arr.to_numpy(zero_copy_only=False)   # f32, NaN=null
         x[lo:hi, spec.num_lane] = vals
         valid = ~np.isnan(vals)
+        _NUM_PATHS.inc(path="zero_copy" if no_nulls else "slow")
     elif pa.types.is_floating(t) and t.bit_width == 64 and no_nulls:
         vals = arr.to_numpy()                       # zero-copy view
         x[lo:hi, spec.num_lane] = vals              # fused f64→f32 write
         valid = ~np.isnan(vals)
+        _NUM_PATHS.inc(path="zero_copy")
     elif pa.types.is_floating(t) or pa.types.is_decimal(t):
         vals = arr.cast(pa.float64(), safe=False).to_numpy(
             zero_copy_only=False)
         x[lo:hi, spec.num_lane] = vals.astype(np.float32)
         valid = ~np.isnan(vals)
+        _NUM_PATHS.inc(path="slow")
     elif no_nulls and not pa.types.is_boolean(t):
         # ints: stay in int64 so ids > 2^53 hash exactly
         vals = arr.to_numpy().astype(np.int64, copy=False)
         x[lo:hi, spec.num_lane] = vals.astype(np.float32)
         valid = np.ones(n, dtype=bool)
+        _NUM_PATHS.inc(path="zero_copy")
     else:                           # bools, and ints carrying nulls
         valid = (arr.is_valid().to_numpy(zero_copy_only=False)
                  if arr.null_count else np.ones(n, dtype=bool))
@@ -271,6 +295,7 @@ def _fill_num_rows(arr: pa.Array, spec: "ColumnSpec", x: np.ndarray,
         if arr.null_count:
             xf = np.where(valid, xf, np.nan)
         x[lo:hi, spec.num_lane] = xf
+        _NUM_PATHS.inc(path="slow")
     if hashes:
         keys = _num_keys(vals)
         hll_packed[lo:hi, spec.hash_lane] = _packed_obs(
@@ -416,8 +441,11 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
     any worker count (tests/test_ingest.py pins 1 vs 2 vs 8); ordered
     folds (sampler, Misra-Gries, HLL registers) run on the COMPLETED
     batch in the consumer, never inside racing workers."""
+    import time as _time
+
     from tpuprof import native
     from tpuprof.kernels import hll as khll
+    _t0 = _time.perf_counter() if _obs_metrics.enabled() else None
     if dict_cache is None:
         dict_cache = {}             # per-call: correct, just unmemoized
     n = batch.num_rows
@@ -606,6 +634,12 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
             tasks.append(lambda i=i, spec=spec: decode_column(i, spec))
     prep.run_tasks(tasks, workers)
 
+    if _t0 is not None:
+        _ROWS_INGESTED.inc(n)
+        _BATCHES_INGESTED.inc()
+        _BYTES_INGESTED.inc(sum(col_nbytes.values())
+                            + sum(col_dict_nbytes.values()))
+        _PREP_SECONDS.observe(_time.perf_counter() - _t0)
     return HostBatch(nrows=n, x=x, row_valid=row_valid, hll=hll_packed,
                      cat_codes=cat_codes, date_ints=date_ints,
                      cat_hashes=cat_hashes if hashes else None,
@@ -688,6 +722,7 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
         while not cancelled.is_set():
             try:
                 q.put(item, timeout=0.5)
+                _QUEUE_DEPTH.set(q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -734,6 +769,7 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
     try:
         while True:
             item = q.get()
+            _QUEUE_DEPTH.set(q.qsize())
             if item is sentinel:
                 break
             yield item.result()     # in-order; re-raises prepare errors

@@ -25,7 +25,19 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence
 
+from tpuprof.obs import metrics
+
 _LOCK = threading.Lock()
+
+# per-worker leaf-task counts: worker="serial" is the in-caller fallback
+# path, pool threads report under their tpuprof-col-N names — a skewed
+# spread means the task split is leaving cores idle
+_PREP_TASKS = metrics.counter(
+    "tpuprof_prep_tasks_total",
+    "intra-batch prep leaf tasks executed, by worker thread")
+_BATCH_TASKS = metrics.counter(
+    "tpuprof_prep_batch_tasks_total",
+    "whole-batch prepares run through the ordered cross-batch pipeline")
 _COL_POOL: Optional[ThreadPoolExecutor] = None
 _COL_WORKERS = 0
 _BATCH_POOL: Optional[ThreadPoolExecutor] = None
@@ -63,8 +75,16 @@ def run_tasks(tasks: Sequence[Callable[[], None]], workers: int) -> None:
     if workers <= 1 or len(tasks) <= 1:
         for t in tasks:
             t()
+        _PREP_TASKS.inc(len(tasks), worker="serial")
         return
-    futs = [_shared("col", workers).submit(t) for t in tasks]
+
+    def _counted(t: Callable[[], None]) -> None:
+        t()
+        # after the task body: a raising task still re-raises below, and
+        # the count means "completed work", not "attempts"
+        _PREP_TASKS.inc(worker=threading.current_thread().name)
+
+    futs = [_shared("col", workers).submit(_counted, t) for t in tasks]
     first: Optional[BaseException] = None
     for f in futs:
         try:
@@ -90,13 +110,20 @@ def ordered_map(items: Iterable, fn: Callable, workers: int,
     if workers <= 1:
         for it in items:
             yield fn(it)
+            _BATCH_TASKS.inc(worker="serial")
         return
     pool = _shared("batch", workers)
     pending: List = []
     depth = max(depth, 1)
+
+    def _counted(it):
+        out = fn(it)
+        _BATCH_TASKS.inc(worker=threading.current_thread().name)
+        return out
+
     try:
         for it in items:
-            pending.append(pool.submit(fn, it))
+            pending.append(pool.submit(_counted, it))
             while len(pending) > depth:
                 yield pending.pop(0).result()
         while pending:

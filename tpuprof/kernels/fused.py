@@ -46,8 +46,51 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tpuprof.kernels import corr as kcorr
 from tpuprof.kernels import moments as kmoments
+from tpuprof.obs import metrics as _obs_metrics
 
 Array = jnp.ndarray
+
+# ---- device-fold telemetry (OBSERVABILITY.md) ---------------------------
+# Dispatch COUNTS are free (host-side increments at the enqueue sites in
+# runtime/mesh.py).  Block TIMINGS are not: jax dispatch is async, so a
+# wall time requires jax.block_until_ready, which serializes the pipeline
+# it measures.  observe_dispatch therefore samples — every Nth dispatch
+# (obs.block_sample(), config.metrics_block_sample / --metrics-interval
+# wiring) pays one sync and lands in the histogram; N=0 never syncs.
+_DISPATCHES = _obs_metrics.counter(
+    "tpuprof_device_dispatch_total",
+    "device program dispatches, by program (step_a/scan_a/...)")
+_BLOCK_SECONDS = _obs_metrics.histogram(
+    "tpuprof_device_block_seconds",
+    "sampled wall seconds from enqueue to block_until_ready, by program")
+_dispatch_seq = [0]     # process-wide sample phase (racy += is fine: the
+                        # worst case is a sample skipped or doubled)
+
+
+def observe_dispatch(program: str, result, batches: int = 1):
+    """Record one device dispatch (and sometimes time it).  Called by
+    MeshRunner at every enqueue site with the dispatch's result pytree;
+    returns the result unchanged so call sites stay expressions."""
+    if not _obs_metrics.enabled():
+        return result
+    _DISPATCHES.inc(program=program)
+    if batches > 1:
+        _DISPATCHES.inc(batches, program=f"{program}_batches")
+    rate = 0
+    try:
+        from tpuprof import obs
+        rate = obs.block_sample()
+    except Exception:
+        pass
+    if rate > 0:
+        _dispatch_seq[0] += 1
+        if _dispatch_seq[0] % rate == 0:
+            import time
+            t0 = time.perf_counter()
+            jax.block_until_ready(result)
+            _BLOCK_SECONDS.observe(time.perf_counter() - t0,
+                                   program=program)
+    return result
 
 C_ALIGN = 8            # sublane-axis (column) padding multiple — the f32
                        # min sublane tile; 128 alignment is only required

@@ -1,0 +1,88 @@
+"""Pipeline observability (OBSERVABILITY.md): metrics registry, span
+tracing, JSONL event export, live progress.
+
+The subsystem is OFF by default and costs one branch per instrumentation
+site when off.  Three switches turn it on, strongest first:
+
+* ``ProfilerConfig(metrics_enabled=True, metrics_path=...)`` — per-run
+* ``--metrics-json PATH`` / ``--progress`` on the CLI
+* ``TPUPROF_METRICS=1`` (and ``TPUPROF_METRICS_PATH``) in the env
+
+All three land on :func:`configure`, which flips the process-wide
+default registry and points the JSONL sink.  Everything here is
+host-side and import-light: no jax, no pandas — safe to import from
+the hot ingest modules.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpuprof.obs import events, metrics
+from tpuprof.obs.events import emit, emit_snapshot
+from tpuprof.obs.metrics import (MetricsRegistry, counter, enabled, gauge,
+                                 histogram, registry, set_enabled)
+from tpuprof.obs.progress import RateEMA, Ticker, registry_progress_line
+from tpuprof.obs.spans import current_path, get_phase_report, span
+
+__all__ = [
+    "MetricsRegistry", "RateEMA", "Ticker", "block_sample", "configure",
+    "configure_from_config", "counter", "current_path", "emit",
+    "emit_snapshot", "enabled", "finalize", "gauge", "get_phase_report",
+    "histogram", "registry", "registry_progress_line", "set_enabled",
+    "snapshot_if_enabled", "span",
+]
+
+# every Nth device dispatch is block_until_ready-timed when > 0
+# (kernels/fused.observe_dispatch); 0 = never synchronize for telemetry
+_block_sample = 0
+
+
+def block_sample() -> int:
+    return _block_sample
+
+
+def configure(enabled: Optional[bool] = None,
+              jsonl_path: Optional[str] = None,
+              block_sample: Optional[int] = None) -> None:
+    """Flip the process-wide observability state.  ``None`` leaves a
+    knob as it is, so CLI and backend can each set their half without
+    clobbering the other."""
+    global _block_sample
+    if jsonl_path is not None:
+        events.set_sink(jsonl_path)
+        if enabled is None:     # a sink with recording off would be empty
+            enabled = True
+    if enabled is not None:
+        metrics.set_enabled(enabled)
+    if block_sample is not None:
+        _block_sample = max(int(block_sample), 0)
+
+
+def configure_from_config(config) -> None:
+    """Apply a ProfilerConfig's metrics knobs (backends call this at the
+    top of collect / StreamingProfiler.__init__)."""
+    from tpuprof.config import resolve_metrics_enabled
+    on = resolve_metrics_enabled(config.metrics_enabled,
+                                 config.metrics_path)
+    path = config.metrics_path
+    if path is None:
+        import os
+        path = os.environ.get("TPUPROF_METRICS_PATH") or None
+    configure(enabled=on, jsonl_path=path,
+              block_sample=config.metrics_block_sample)
+
+
+def snapshot_if_enabled() -> Optional[dict]:
+    """Registry snapshot when recording is on, else None — what rides
+    the stats dict (``stats['_obs']``) into the report footer."""
+    if not metrics.enabled():
+        return None
+    return metrics.registry().snapshot()
+
+
+def finalize(reason: str = "final") -> None:
+    """Flush a final metrics snapshot into the JSONL sink (if any).  The
+    sink stays open — a process may profile again and append."""
+    if events.get_sink() is not None:
+        emit_snapshot(reason=reason)

@@ -1,0 +1,103 @@
+"""JSONL event sink (OBSERVABILITY.md "JSONL events").
+
+One line per event, append-only, written as it happens so a crashed run
+leaves a readable trace up to the crash.  Events are flat dicts with a
+``kind`` discriminator:
+
+* ``{"kind": "span", "name", "seconds", "path", "depth", ...meta}`` —
+  emitted by obs/spans.py at every span exit
+* ``{"kind": "metric", "name", "type", "labels", "value"|"count"/"sum"}``
+  — one event per live series, emitted by :func:`emit_snapshot`
+  (finalize and the ``--metrics-interval`` ticker)
+* ``{"kind": "heartbeat", ...}`` — StreamingProfiler.heartbeat() /
+  the CLI ``--progress`` ticker
+
+Every event carries ``ts`` (epoch seconds).  Field values are coerced
+via ``default=str`` — numpy scalars, paths and timestamps must never
+crash the pipeline they observe.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from tpuprof.obs import metrics
+
+
+class JsonlSink:
+    """Thread-safe append-only JSONL writer (line-buffered)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", buffering=1)
+
+    def write(self, event: Dict[str, Any]) -> None:
+        line = json.dumps(event, default=str)
+        with self._lock:
+            if self._fh.closed:
+                return
+            self._fh.write(line + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.close()
+
+
+_lock = threading.Lock()
+_sink: Optional[JsonlSink] = None
+
+
+def set_sink(path: Optional[str]) -> Optional[JsonlSink]:
+    """Point the process-wide sink at ``path`` (None closes it).  A
+    repeated call with the sink's current path keeps it (appending),
+    so configure() is idempotent across CLI + backend."""
+    global _sink
+    with _lock:
+        if _sink is not None and (path is None or _sink.path != path):
+            _sink.close()
+            _sink = None
+        if path is not None and _sink is None:
+            _sink = JsonlSink(path)
+        return _sink
+
+
+def get_sink() -> Optional[JsonlSink]:
+    return _sink
+
+
+def emit(kind: str, **fields) -> None:
+    """Write one event to the sink, if any.  Cheap no-op otherwise."""
+    sink = _sink
+    if sink is None:
+        return
+    sink.write({"ts": round(time.time(), 3), "kind": kind, **fields})
+
+
+def emit_snapshot(registry: Optional[metrics.MetricsRegistry] = None,
+                  reason: str = "snapshot") -> None:
+    """One ``metric`` event per live series — the JSONL twin of
+    ``render_text()`` (same names, same label strings)."""
+    sink = _sink
+    if sink is None:
+        return
+    reg = registry if registry is not None else metrics.registry()
+    snap = reg.snapshot()
+    ts = round(time.time(), 3)
+    for mtype, byname in (("counter", snap["counters"]),
+                          ("gauge", snap["gauges"])):
+        for name, series in byname.items():
+            for labels, value in series.items():
+                sink.write({"ts": ts, "kind": "metric", "reason": reason,
+                            "name": name, "type": mtype,
+                            "labels": labels, "value": value})
+    for name, series in snap["histograms"].items():
+        for labels, st in series.items():
+            sink.write({"ts": ts, "kind": "metric", "reason": reason,
+                        "name": name, "type": "histogram",
+                        "labels": labels, "count": st["count"],
+                        "sum": st["sum"], "mean": st["mean"]})

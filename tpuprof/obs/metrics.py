@@ -1,0 +1,311 @@
+"""Thread-safe metrics registry: counters, gauges, fixed-bucket
+histograms (OBSERVABILITY.md).
+
+Design constraints, in order:
+
+* **Near-zero overhead when disabled.**  Every instrument method's first
+  action is one attribute read of the registry's ``enabled`` flag; the
+  instrumentation sites in the hot paths (ingest, stream drain, device
+  dispatch) are per-batch or per-task, never per-row, so the disabled
+  cost is a handful of predictable branches per 64k rows.
+* **Process-wide default registry.**  Instruments are declared at module
+  import (``metrics.counter(...)`` at the top of ingest/arrow.py, etc.)
+  and exist whether or not recording is on — ``render_text()`` then
+  shows an honest zero rather than omitting a series that simply never
+  fired.
+* **Prometheus-style exposition** via :meth:`MetricsRegistry.render_text`
+  and a plain-dict :meth:`MetricsRegistry.snapshot` for JSON/JSONL
+  export (obs/events.py writes the event stream).
+
+Labels are keyword arguments at record time (``c.inc(program="scan_a")``)
+and must stay low-cardinality — worker names, program names, path kinds;
+never column names or row values (a 10k-column table must not mint 10k
+series).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# default histogram buckets: wall-clock seconds from 100us to 60s —
+# covers a prep task (~ms), a device dispatch (~15ms tunneled), a
+# checkpoint save (~100ms) and a full drain (~s) on one shared scale
+TIME_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> LabelKey:
+    # sorted so inc(a=1, b=2) and inc(b=2, a=1) hit one series
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _fmt_labels(key: LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class _Instrument:
+    """Shared series storage: one value (or bucket vector) per label set."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str,
+                 help: str = ""):
+        self._registry = registry
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: Dict[LabelKey, Any] = {}
+
+    # NOTE: instrument methods check ``self._registry.enabled`` inline
+    # (a plain attribute read) rather than via a property — a property
+    # is a Python-level call, and the disabled path is budgeted at one
+    # branch per site (PERF.md round 6)
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+    def total(self) -> float:
+        """Sum across every label set (the unlabeled view)."""
+        with self._lock:
+            return float(sum(self._series.values()))
+
+    def items(self) -> List[Tuple[LabelKey, float]]:
+        """(label_key, value) pairs — a stable copy."""
+        with self._lock:
+            return list(self._series.items())
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, **labels) -> None:
+        self.inc(-value, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket histogram (cumulative buckets at render time, like
+    Prometheus): per label set it keeps per-bucket counts plus sum and
+    count — no per-observation storage, O(buckets) memory forever."""
+
+    kind = "histogram"
+
+    def __init__(self, registry: "MetricsRegistry", name: str,
+                 help: str = "",
+                 buckets: Sequence[float] = TIME_BUCKETS):
+        super().__init__(registry, name, help)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError(f"histogram {name!r} needs >= 1 bucket bound")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None:
+                st = self._series[key] = {
+                    "buckets": [0] * len(self.buckets),
+                    "sum": 0.0, "count": 0}
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    st["buckets"][i] += 1
+                    break
+            st["sum"] += float(value)
+            st["count"] += 1
+
+    def summary(self, **labels) -> Dict[str, float]:
+        with self._lock:
+            st = self._series.get(_label_key(labels))
+            if st is None:
+                return {"count": 0, "sum": 0.0, "mean": 0.0}
+            n = st["count"]
+            return {"count": n, "sum": st["sum"],
+                    "mean": st["sum"] / n if n else 0.0}
+
+
+class MetricsRegistry:
+    """Instrument factory + exporter.  ``get_or_create`` semantics: a
+    second declaration of the same name returns the existing instrument
+    (modules re-imported under different names must not fork a series),
+    but a kind mismatch is a programming error and raises."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    # -- declaration -------------------------------------------------------
+
+    def _get_or_create(self, cls, name: str, help: str, **kw) -> Any:
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is not None:
+                if not isinstance(inst, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{inst.kind}, not {cls.kind}")
+                return inst
+            inst = cls(self, name, help, **kw)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = TIME_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    # -- export ------------------------------------------------------------
+
+    def _items(self) -> List[_Instrument]:
+        with self._lock:
+            return sorted(self._instruments.values(),
+                          key=lambda i: i.name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-dict view of every series (JSON-serializable).
+
+        ``{"counters": {name: {label_str: value}}, "gauges": {...},
+        "histograms": {name: {label_str: {count, sum, mean}}}}`` —
+        label_str "" is the unlabeled series."""
+        out: Dict[str, Any] = {"counters": {}, "gauges": {},
+                               "histograms": {}}
+        for inst in self._items():
+            with inst._lock:
+                series = dict(inst._series)
+            if isinstance(inst, Histogram):
+                out["histograms"][inst.name] = {
+                    _fmt_labels(k): {
+                        "count": st["count"], "sum": round(st["sum"], 6),
+                        "mean": round(st["sum"] / st["count"], 6)
+                        if st["count"] else 0.0}
+                    for k, st in series.items()}
+            else:
+                bucket = "counters" if isinstance(inst, Counter) \
+                    else "gauges"
+                out[bucket][inst.name] = {
+                    _fmt_labels(k): v for k, v in series.items()}
+        return out
+
+    def render_text(self) -> str:
+        """Prometheus text exposition (the ``/metrics`` format): HELP and
+        TYPE comments, one sample line per series, histograms expanded
+        into cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``."""
+        lines: List[str] = []
+        for inst in self._items():
+            with inst._lock:
+                series = dict(inst._series)
+            if inst.help:
+                lines.append(f"# HELP {inst.name} {inst.help}")
+            lines.append(f"# TYPE {inst.name} {inst.kind}")
+            if isinstance(inst, Histogram):
+                for key, st in sorted(series.items()):
+                    cum = 0
+                    for b, c in zip(inst.buckets, st["buckets"]):
+                        cum += c
+                        lk = _fmt_labels(key + (("le", _fmt_value(b)),))
+                        lines.append(f"{inst.name}_bucket{lk} {cum}")
+                    lk = _fmt_labels(key + (("le", "+Inf"),))
+                    lines.append(f"{inst.name}_bucket{lk} {st['count']}")
+                    lines.append(f"{inst.name}_sum{_fmt_labels(key)} "
+                                 f"{st['sum']:.6g}")
+                    lines.append(f"{inst.name}_count{_fmt_labels(key)} "
+                                 f"{st['count']}")
+            else:
+                if not series:
+                    # an instrument that never fired still exposes its
+                    # unlabeled zero — absence would read as "not wired"
+                    lines.append(f"{inst.name} 0")
+                for key, v in sorted(series.items()):
+                    lines.append(
+                        f"{inst.name}{_fmt_labels(key)} {_fmt_value(v)}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Zero every series (instrument declarations survive) — test
+        isolation and the per-profile snapshot boundary."""
+        for inst in self._items():
+            with inst._lock:
+                inst._series.clear()
+
+
+# ---------------------------------------------------------------------------
+# process-wide default registry
+# ---------------------------------------------------------------------------
+
+_default = MetricsRegistry(enabled=False)
+
+
+def registry() -> MetricsRegistry:
+    return _default
+
+
+def enabled() -> bool:
+    return _default.enabled
+
+
+def set_enabled(value: bool) -> None:
+    _default.enabled = bool(value)
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _default.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _default.gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Sequence[float] = TIME_BUCKETS) -> Histogram:
+    return _default.histogram(name, help, buckets=buckets)

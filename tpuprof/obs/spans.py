@@ -1,0 +1,88 @@
+"""Span-based tracing: nested wall-clock timing with metadata.
+
+``span("prep", cols=23)`` times its body, records the wall seconds into
+
+* the per-phase totals (``get_phase_report()`` — the report footer's
+  contract, kept from the original ``phase_timer``),
+* the ``tpuprof_span_seconds{name=...}`` histogram (when metrics are
+  enabled),
+* one ``{"kind": "span"}`` JSONL event (when a sink is configured),
+  carrying the full dotted path (``"profile.scan_a"``) and nesting depth
+  so a trace viewer can rebuild the tree,
+* a debug log line (the original ``phase_timer`` behavior).
+
+Nesting is per-thread (a ``threading.local`` stack): spans opened by
+prep-pool workers do not see — or corrupt — the main thread's stack.
+Phase totals accumulate under the span's LEAF name, exactly like
+``phase_timer`` did, so ``get_phase_report()`` keys are stable across
+the refactor.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, Iterator
+
+from tpuprof.obs import events, metrics
+
+logger = logging.getLogger("tpuprof")
+
+_lock = threading.Lock()
+_phase_totals: Dict[str, float] = {}
+_tls = threading.local()
+
+_SPAN_SECONDS = metrics.histogram(
+    "tpuprof_span_seconds",
+    "wall-clock seconds per pipeline span, by leaf name")
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_path() -> str:
+    """Dotted path of the innermost open span on THIS thread ('' at
+    top level)."""
+    return ".".join(_stack())
+
+
+@contextlib.contextmanager
+def span(name: str, **meta: Any) -> Iterator[None]:
+    """Time a pipeline stage.  Exceptions propagate; the timing is
+    recorded either way (a failed stage's cost is still cost)."""
+    stack = _stack()
+    stack.append(name)
+    depth = len(stack)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        stack.pop()
+        with _lock:
+            _phase_totals[name] = _phase_totals.get(name, 0.0) + dt
+        _SPAN_SECONDS.observe(dt, name=name)
+        if events.get_sink() is not None:
+            events.emit("span", name=name, seconds=round(dt, 6),
+                        path=".".join(stack + [name]), depth=depth,
+                        **meta)
+        logger.debug("%s", json.dumps(
+            {"event": "phase", "name": name, "seconds": round(dt, 4),
+             **meta}, default=str))
+
+
+def get_phase_report(reset: bool = False) -> Dict[str, float]:
+    """Per-leaf-name accumulated wall-clock seconds (the report footer
+    and bench stage breakdowns read this)."""
+    with _lock:
+        out = dict(_phase_totals)
+        if reset:
+            _phase_totals.clear()
+    return out

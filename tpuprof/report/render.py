@@ -81,6 +81,42 @@ def _perf_line(stats: Dict[str, Any]) -> str:
     return f"{n / scan:,.0f} rows/s · " + " · ".join(parts)
 
 
+def _pipeline_stats_line(stats: Dict[str, Any]) -> str:
+    """Second footer line: pipeline counters from the obs snapshot the
+    backend attached as ``stats['_obs']`` (metrics enabled only —
+    OBSERVABILITY.md).  Everything here degrades to omission: a missing
+    metric simply drops its fragment."""
+    snap = stats.get("_obs") or {}
+    counters = snap.get("counters") or {}
+    hists = snap.get("histograms") or {}
+
+    def _total(name: str) -> float:
+        return sum((counters.get(name) or {}).values())
+
+    parts = []
+    rows = _total("tpuprof_ingest_rows_total")
+    if rows:
+        parts.append(f"{int(rows):,} rows ingested")
+    batches = _total("tpuprof_ingest_batches_total")
+    if batches:
+        parts.append(f"{int(batches)} batches prepared")
+    disp = counters.get("tpuprof_device_dispatch_total") or {}
+    n_disp = sum(v for k, v in disp.items() if "_batches" not in k)
+    if n_disp:
+        parts.append(f"{int(n_disp)} device dispatches")
+    paths = counters.get("tpuprof_prep_numeric_path_total") or {}
+    zc = sum(v for k, v in paths.items() if "zero_copy" in k)
+    total_paths = sum(paths.values())
+    if total_paths:
+        parts.append(f"{zc / total_paths:.0%} zero-copy decodes")
+    ck = hists.get("tpuprof_checkpoint_save_seconds") or {}
+    saves = sum(s["count"] for s in ck.values())
+    if saves:
+        secs = sum(s["sum"] for s in ck.values())
+        parts.append(f"{int(saves)} checkpoints ({secs:.2f}s)")
+    return " · ".join(parts)
+
+
 def to_html(stats: Dict[str, Any], config: ProfilerConfig) -> str:
     """Render the report fragment (reference: ProfileReport.html)."""
     from tpuprof import __version__
@@ -95,6 +131,7 @@ def to_html(stats: Dict[str, Any], config: ProfilerConfig) -> str:
         config=config,
         version=__version__,
         perf=_perf_line(stats),
+        pipeline_stats=_pipeline_stats_line(stats),
     )
 
 

@@ -25,10 +25,27 @@ from __future__ import annotations
 
 import io
 import pickle
+import time
 from typing import Any, Dict, Tuple
 
 import jax
 import numpy as np
+
+from tpuprof.obs import metrics as _obs_metrics
+
+_SAVES = _obs_metrics.counter(
+    "tpuprof_checkpoint_saves_total", "checkpoint artifacts written")
+_RESTORES = _obs_metrics.counter(
+    "tpuprof_checkpoint_restores_total", "checkpoint payloads read back")
+_SAVE_SECONDS = _obs_metrics.histogram(
+    "tpuprof_checkpoint_save_seconds",
+    "wall seconds per atomic checkpoint write (device fetch + pickle + "
+    "rename)")
+_RESTORE_SECONDS = _obs_metrics.histogram(
+    "tpuprof_checkpoint_restore_seconds",
+    "wall seconds per checkpoint payload read (disk + unpickle)")
+_SAVE_BYTES = _obs_metrics.gauge(
+    "tpuprof_checkpoint_bytes", "size of the newest checkpoint artifact")
 
 # v3: the quantile sample moved off-device (ingest/sample.RowSampler in
 # the host blob); the pass-A device state lost its "qs" and "step"
@@ -68,6 +85,7 @@ def _unflatten(template: Any, flat: Dict[str, np.ndarray]) -> Any:
 def save(path: str, state: Any, host_blob: Any, cursor: int,
          meta: Dict[str, Any]) -> None:
     """Write one atomic checkpoint file."""
+    t0 = time.perf_counter()
     flat = _flatten(jax.device_get(state))
     buf = io.BytesIO()
     np.savez(buf, **flat)
@@ -84,6 +102,17 @@ def save(path: str, state: Any, host_blob: Any, cursor: int,
         pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
     import os
     os.replace(tmp, path)
+    if _obs_metrics.enabled():
+        dt = time.perf_counter() - t0
+        _SAVES.inc()
+        _SAVE_SECONDS.observe(dt)
+        try:
+            _SAVE_BYTES.set(os.path.getsize(path))
+        except OSError:
+            pass
+        from tpuprof.obs import events
+        events.emit("checkpoint_save", path=path, cursor=int(cursor),
+                    seconds=round(dt, 6))
 
 
 def load_payload(path: str) -> Dict[str, Any]:
@@ -95,6 +124,7 @@ def load_payload(path: str) -> Dict[str, Any]:
     changed incompatibly) is ever unpickled.  Pre-v4 files were one
     single pickle whose dict carried format_version inline — the first
     load then yields that whole dict and the check still rejects it."""
+    t0 = time.perf_counter()
     with open(path, "rb") as fh:
         header = pickle.load(fh)
         version = header.get("format_version") \
@@ -102,6 +132,14 @@ def load_payload(path: str) -> Dict[str, Any]:
         if version != FORMAT_VERSION:
             raise ValueError(f"unsupported checkpoint format {version}")
         payload = pickle.load(fh)
+    if _obs_metrics.enabled():
+        dt = time.perf_counter() - t0
+        _RESTORES.inc()
+        _RESTORE_SECONDS.observe(dt)
+        from tpuprof.obs import events
+        events.emit("checkpoint_restore", path=path,
+                    cursor=int(payload.get("cursor", -1)),
+                    seconds=round(dt, 6))
     return payload
 
 

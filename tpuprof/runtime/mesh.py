@@ -176,7 +176,9 @@ class MeshRunner:
 
     def scan_a(self, state: Pytree, sb: "StackedBatch") -> Pytree:
         """Fold ``sb.n_batches`` staged batches in one compiled dispatch."""
-        return self._scan_a(state, sb.xts, sb.row_valids, sb.hllts)
+        return fused.observe_dispatch(
+            "scan_a", self._scan_a(state, sb.xts, sb.row_valids, sb.hllts),
+            batches=sb.n_batches)
 
     def put_replicated(self, arr, dtype=None):
         """Place a small constant (e.g. histogram lo/hi/mean) once, so the
@@ -449,24 +451,30 @@ class MeshRunner:
         ``step_idx`` is accepted for caller convenience (cursor-style
         loops); the update itself is deterministic and order-free."""
         db = self._as_device(hb)
-        return self._step_a(state, db.xt, db.row_valid, db.hllt)
+        return fused.observe_dispatch(
+            "step_a", self._step_a(state, db.xt, db.row_valid, db.hllt))
 
     def step_b(self, state: Pytree, hb, lo, hi, mean) -> Pytree:
         db = self._as_device(hb)
-        return self._step_b(state, db.xt, db.row_valid,
-                            self.put_replicated(lo, dtype=jnp.float32),
-                            self.put_replicated(hi, dtype=jnp.float32),
-                            self.put_replicated(mean, dtype=jnp.float32))
+        return fused.observe_dispatch(
+            "step_b",
+            self._step_b(state, db.xt, db.row_valid,
+                         self.put_replicated(lo, dtype=jnp.float32),
+                         self.put_replicated(hi, dtype=jnp.float32),
+                         self.put_replicated(mean, dtype=jnp.float32)))
 
     def scan_b(self, state: Pytree, sb: "StackedBatch", lo, hi,
                mean) -> Pytree:
         """Fold ``sb.n_batches`` staged batches into the pass-B state in
         one compiled dispatch (stage with ``with_hll=False`` — pass B
         never reads the packed plane)."""
-        return self._scan_b(state, sb.xts, sb.row_valids,
-                            self.put_replicated(lo, dtype=jnp.float32),
-                            self.put_replicated(hi, dtype=jnp.float32),
-                            self.put_replicated(mean, dtype=jnp.float32))
+        return fused.observe_dispatch(
+            "scan_b",
+            self._scan_b(state, sb.xts, sb.row_valids,
+                         self.put_replicated(lo, dtype=jnp.float32),
+                         self.put_replicated(hi, dtype=jnp.float32),
+                         self.put_replicated(mean, dtype=jnp.float32)),
+            batches=sb.n_batches)
 
     def init_spearman(self) -> Pytree:
         def one_device(_):

@@ -21,15 +21,31 @@ import numpy as np
 import pandas as pd
 import pyarrow as pa
 
+from tpuprof import obs
 from tpuprof.config import ProfilerConfig
 from tpuprof.ingest.arrow import ColumnPlan, prepare_batch
 from tpuprof.ingest.sample import RowSampler
 from tpuprof.kernels import corr as kcorr
 from tpuprof.kernels import hll as khll
 from tpuprof.kernels import moments as kmoments
+from tpuprof.obs import metrics as _obs_metrics
+from tpuprof.obs.progress import RateEMA, fmt_rate
 from tpuprof.runtime import checkpoint as ckpt
 from tpuprof.runtime.mesh import MeshRunner
 from tpuprof.utils.trace import log_event
+
+_BATCHES_FOLDED = _obs_metrics.counter(
+    "tpuprof_stream_batches_folded_total",
+    "device batches folded into the streaming state")
+_STREAM_ROWS = _obs_metrics.counter(
+    "tpuprof_stream_rows_total", "rows folded through the stream")
+_DRAIN_SECONDS = _obs_metrics.histogram(
+    "tpuprof_stream_drain_seconds",
+    "wall seconds per buffer drain (prep + device folds)")
+_OVERLAP_RATIO = _obs_metrics.gauge(
+    "tpuprof_stream_prefetch_overlap_ratio",
+    "share of the last multi-slice drain NOT spent waiting on prep "
+    "(1.0 = prep fully hidden under device folds)")
 
 
 def _to_record_batches(batch: Any, schema: Optional[pa.Schema]):
@@ -147,6 +163,11 @@ class StreamingProfiler:
         # it via config.resolve_prep_workers, and the shared column pool
         # bounds the process's total prep threads either way
         self._prep_width = self.config.prep_workers
+        # heartbeat state (obs/progress.py): recent-rate EMA + wall start
+        obs.configure_from_config(self.config)
+        import time as _time
+        self._t_start = _time.monotonic()
+        self._rate_ema = RateEMA(halflife=10.0)
 
     @classmethod
     def for_example(cls, example: Any, **kwargs) -> "StreamingProfiler":
@@ -187,7 +208,8 @@ class StreamingProfiler:
             self._buf.append(rb)
             self._buf_rows += rb.num_rows
         if self._buf_rows >= self._flush_rows:
-            self._drain(force=False)
+            with obs.span("drain", rows=int(self._buf_rows)):
+                self._drain(force=False)
         log_event("stream_update", cursor=self.cursor,
                   rows=self.hostagg.n_rows + self._buf_rows,
                   buffered=self._buf_rows)
@@ -223,6 +245,9 @@ class StreamingProfiler:
             self.host_hll.update(hb.hll, hb.nrows)
         self.hostagg.update(hb)
         self.cursor += 1
+        self._rate_ema.update(hb.nrows)
+        _BATCHES_FOLDED.inc()
+        _STREAM_ROWS.inc(hb.nrows)
 
     def _drain(self, force: bool) -> None:
         """Fold buffered rows: full device batches always; the partial
@@ -236,6 +261,8 @@ class StreamingProfiler:
         serial stream's."""
         if not self._buf_rows:
             return
+        import time as _time
+        t0 = _time.perf_counter()
         rows = self.runner.rows
         tbl = pa.Table.from_batches(self._buf)
         n, pos = tbl.num_rows, 0
@@ -253,9 +280,57 @@ class StreamingProfiler:
         from tpuprof.ingest import prep
         w = resolve_prepare_workers(self.config.prepare_workers) \
             if len(slices) > 1 else 1
-        for hb in prep.ordered_map(slices, self._prepare_slice,
-                                   workers=w, depth=2):
+        # split the drain's wall time into "waiting on prep" (the
+        # generator's next()) vs "folding" — their ratio is the
+        # prefetch-overlap figure the obs layer reports
+        wait_s = 0.0
+        done = object()     # ordered_map may yield None for empty slices
+        it = iter(prep.ordered_map(slices, self._prepare_slice,
+                                   workers=w, depth=2))
+        while True:
+            tw = _time.perf_counter()
+            hb = next(it, done)
+            wait_s += _time.perf_counter() - tw
+            if hb is done:
+                break
             self._fold_prepared(hb)
+        if _obs_metrics.enabled():
+            dt = _time.perf_counter() - t0
+            _DRAIN_SECONDS.observe(dt)
+            if len(slices) > 1 and dt > 0:
+                _OVERLAP_RATIO.set(max(0.0, 1.0 - wait_s / dt))
+
+    # -- liveness ----------------------------------------------------------
+
+    def heartbeat(self) -> Dict[str, Any]:
+        """Cheap liveness snapshot — NO drain, NO device sync: how much
+        has been folded, what is still buffered, and the recent ingest
+        rate (a ~10s-halflife EMA, so a stalled stream decays to ~0
+        instead of reporting its lifetime average).  Safe to call from
+        another thread at any frequency.  When a JSONL sink is
+        configured the snapshot is also emitted as a ``heartbeat``
+        event."""
+        import time as _time
+        hb = {
+            "rows_folded": int(self.hostagg.n_rows),
+            "rows_buffered": int(self._buf_rows),
+            "batches_folded": int(self.cursor),
+            "rows_per_sec_ema": round(self._rate_ema.rate(), 1),
+            "uptime_s": round(_time.monotonic() - self._t_start, 3),
+            "columns": len(self.plan.specs),
+        }
+        obs.emit("heartbeat", **hb)
+        return hb
+
+    def progress(self) -> str:
+        """One human line from :meth:`heartbeat` (the CLI/driver
+        ``--progress`` format)."""
+        hb = self.heartbeat()
+        return (f"{hb['rows_folded']:,} rows folded "
+                f"(+{hb['rows_buffered']:,} buffered) · "
+                f"{hb['batches_folded']} batches · "
+                f"{fmt_rate(hb['rows_per_sec_ema'])} · "
+                f"up {hb['uptime_s']:.0f}s")
 
     # -- snapshots ---------------------------------------------------------
 
@@ -270,7 +345,8 @@ class StreamingProfiler:
             stats = _empty_stats(self.config)
             stats["variables"] = VariablesView(stats["variables"])
             return stats
-        self._drain(force=True)
+        with obs.span("drain", rows=int(self._buf_rows), forced=True):
+            self._drain(force=True)
         state = self.state if self.state is not None \
             else self.runner.init_pass_a()
         res = self.runner.finalize_a(state)
@@ -295,6 +371,8 @@ class StreamingProfiler:
             rho_spear=rho_spear, spear_approx=True)
         from tpuprof.schema import VariablesView
         stats["variables"] = VariablesView(stats["variables"])
+        if obs.enabled():
+            stats["_obs"] = obs.snapshot_if_enabled()
         return stats
 
     def report_html(self) -> str:
@@ -307,7 +385,8 @@ class StreamingProfiler:
         """Persist (device state, host aggregators, cursor) atomically.
         Buffered rows fold first — the artifact must cover every row the
         caller handed to ``update`` (the buffer itself is not saved)."""
-        self._drain(force=True)
+        with obs.span("drain", rows=int(self._buf_rows), forced=True):
+            self._drain(force=True)
         # the artifact references unique-spill runs by path: a crash
         # must leave them for restore (kernels/unique.py persistence)
         self.hostagg.unique.persistent = True

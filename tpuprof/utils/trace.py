@@ -4,10 +4,14 @@
   trace of everything inside the context (the ``--trace`` CLI flag);
   no-op when dir is falsy.
 * ``phase_timer(name)`` — wall-clock a pipeline phase (ingest / scan /
-  merge / render); accumulated per-phase totals feed the report footer
-  and ``get_phase_report()``.
+  merge / render).  Since the obs subsystem landed this is an alias of
+  :func:`tpuprof.obs.span`: same per-phase totals and
+  ``get_phase_report()`` contract, plus span events/histograms when
+  metrics are on.  Existing call sites keep working unchanged.
 * ``log_event(event, **fields)`` — structured single-line JSON records on
-  the ``tpuprof`` logger (rows ingested, batches, device util).
+  the ``tpuprof`` logger (rows ingested, batches, device util).  Field
+  values are coerced via ``default=str`` so numpy scalars / paths /
+  timestamps never crash the pipeline they describe.
 """
 
 from __future__ import annotations
@@ -15,14 +19,11 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
-import threading
-import time
-from typing import Dict, Iterator, Optional
+from typing import Iterator, Optional
+
+from tpuprof.obs.spans import get_phase_report, span as phase_timer  # noqa: F401 — re-exported API
 
 logger = logging.getLogger("tpuprof")
-
-_lock = threading.Lock()
-_phase_totals: Dict[str, float] = {}
 
 
 @contextlib.contextmanager
@@ -31,31 +32,14 @@ def trace_to(trace_dir: Optional[str]) -> Iterator[None]:
         yield
         return
     import jax
-    with jax.profiler.trace(trace_dir):
-        yield
-    logger.info("tpuprof trace written to %s (view with TensorBoard)",
-                trace_dir)
-
-
-@contextlib.contextmanager
-def phase_timer(name: str) -> Iterator[None]:
-    t0 = time.perf_counter()
     try:
-        yield
+        with jax.profiler.trace(trace_dir):
+            yield
     finally:
-        dt = time.perf_counter() - t0
-        with _lock:
-            _phase_totals[name] = _phase_totals.get(name, 0.0) + dt
-        log_event("phase", name=name, seconds=round(dt, 4))
-
-
-def get_phase_report(reset: bool = False) -> Dict[str, float]:
-    """Per-phase accumulated wall-clock seconds."""
-    with _lock:
-        out = dict(_phase_totals)
-        if reset:
-            _phase_totals.clear()
-    return out
+        # the trace file exists even when the body raised — say where it
+        # is precisely THEN, when someone will want to look at it
+        logger.info("tpuprof trace written to %s (view with TensorBoard)",
+                    trace_dir)
 
 
 def log_event(event: str, **fields) -> None:
