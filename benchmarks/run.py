@@ -385,11 +385,131 @@ def run_prepare(scale: float, workdir: str) -> dict:
     return out
 
 
+def run_passb(scale: float, workdir: str) -> dict:
+    """Pass-B dispatch microbenchmark (ISSUE 3): the histogram+MAD fold
+    alone, A/B'd across the two binning formulations on the current
+    mesh, with bounds derived on device from a folded pass-A state (the
+    production recipe).  On the CPU regression mesh the absolute rates
+    are smoke-scale; the tracked signals are the round-over-round DELTA
+    of ``pass_b_rows_per_sec`` and the cumulative:legacy ratio."""
+    import time as _time
+
+    import jax
+
+    from tpuprof.config import ProfilerConfig, resolve_pass_b_kernel
+    from tpuprof.runtime.mesh import MeshRunner
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    batch_rows = 1 << (12 if on_cpu else 16)
+    cols = 50
+    total_rows = max(int(2e8 * scale), 1 << 17)
+    rng = np.random.default_rng(0)
+
+    def measure(kernel):
+        runner = MeshRunner(ProfilerConfig(batch_rows=batch_rows,
+                                           pass_b_kernel=kernel),
+                            n_num=cols, n_hash=0)
+        from tpuprof.ingest.arrow import HostBatch
+        hb = HostBatch(
+            nrows=runner.rows,
+            x=np.asfortranarray(
+                rng.normal(50, 10, (runner.rows, cols)).astype(np.float32)),
+            row_valid=np.ones(runner.rows, dtype=bool),
+            hll=np.zeros((runner.rows, 0), dtype=np.uint16),
+            cat_codes={}, date_ints={})
+        state_a = runner.init_pass_a(np.full(cols, 50.0, np.float32))
+        state_a = runner.step_a(state_a, hb)
+        lo_d, hi_d, mean_d = runner.bounds_b_device(state_a)
+        db = runner.put_batch(hb, with_hll=False)
+        state = runner.step_b(runner.init_pass_b(), db, lo_d, hi_d,
+                              mean_d)                       # compile
+        jax.block_until_ready(state)
+        steps = min(max(total_rows // runner.rows, 4), 64)
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            state = runner.step_b(state, db, lo_d, hi_d, mean_d)
+            # fake CPU devices timeshare cores — sync per step, as the
+            # wide1b leg does, so no device outruns the others
+            jax.block_until_ready(state)
+        elapsed = _time.perf_counter() - t0
+        return steps * runner.rows / elapsed
+
+    cum = measure("cumulative")
+    leg = measure("legacy")
+    return {"scenario": "passb", "rows": total_rows, "cols": cols,
+            "pass_b_rows_per_sec": round(cum, 1),
+            "rows_per_sec": round(cum, 1),  # the generic delta column
+            "pass_b_legacy_rows_per_sec": round(leg, 1),
+            "pass_b_cumulative_vs_legacy": round(cum / leg, 3),
+            "default_kernel": resolve_pass_b_kernel(None)}
+
+
 REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
-                        "hostfed", "prepare")
+                        "hostfed", "prepare", "passb")
 
 
-def run_regression(scale: float, workdir: str) -> None:
+def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
+    """(label, results-by-scenario) of the previous round's regression
+    table: an explicit ``--baseline`` path wins; else the newest
+    committed ``benchmarks/REGRESSION_r*.json``; else the workdir's
+    previous ``REGRESSION.json`` (same-machine rerun).  Returns
+    (None, {}) when this is the first round with nothing to diff."""
+    import glob
+
+    candidates = []
+    if baseline:
+        candidates.append(baseline)
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates.extend(sorted(glob.glob(
+        os.path.join(here, "REGRESSION_r*.json")), reverse=True))
+    candidates.append(os.path.join(workdir, "REGRESSION.json"))
+    for path in candidates:
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        by_name = {r.get("scenario"): r for r in payload.get("results", [])
+                   if isinstance(r, dict)}
+        if by_name:
+            return os.path.basename(path), by_name
+    return None, {}
+
+
+def _print_deltas(results, label, baseline) -> None:
+    """One delta line per scenario vs the previous round, with pass_b
+    called out and flagged — a silent pass-B regression must be visible
+    without reading JSON by hand (ISSUE 3 satellite)."""
+    if not baseline:
+        print("\n(no previous REGRESSION.json found — nothing to diff)")
+        return
+    print(f"\ndeltas vs {label} (|Δ| ≥ 25% flagged; this box's CPU "
+          "weather band is ±10-20% — PERF.md round 5):")
+    keymap = {"passb": "pass_b_rows_per_sec",
+              "prepare": "prepare_rows_per_sec"}
+    for r in results:
+        name = r.get("scenario")
+        prev = baseline.get(name)
+        key = keymap.get(name, "rows_per_sec")
+        if "error" in r:
+            print(f"  {name}: FAILED this round ({r['error'][:50]})")
+            continue
+        if not prev or key not in prev or key not in r:
+            print(f"  {name}: no baseline figure")
+            continue
+        old, new = float(prev[key]), float(r[key])
+        pct = (new - old) / old * 100 if old else float("nan")
+        flag = ""
+        if pct <= -25:
+            flag = "  ⚠ REGRESSION?"
+        elif pct >= 25:
+            flag = "  (improvement)"
+        print(f"  {name}: {old:,.0f} → {new:,.0f} rows/s "
+              f"({pct:+.1f}%){flag}")
+
+
+def run_regression(scale: float, workdir: str,
+                   baseline: "str | None" = None) -> None:
     """ALL five BASELINE scenarios (+ hostfed), each in a CPU-pinned
     subprocess on an 8-fake-device mesh, one diffable table out
     (VERDICT r4 #6): small-scale rates whose round-over-round DELTAS —
@@ -406,6 +526,9 @@ def run_regression(scale: float, workdir: str) -> None:
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     here = os.path.abspath(__file__)
+    # snapshot the previous round's figures BEFORE this run overwrites
+    # the workdir copy
+    base_label, base_results = _load_baseline(baseline, workdir)
     results = []
 
     def _leg(display_name, argv):
@@ -451,8 +574,13 @@ def run_regression(scale: float, workdir: str) -> None:
         notes = ""
         if "stream_vs_singlepass" in r:
             notes = f"stream:single {r['stream_vs_singlepass']}"
+        if "pass_b_cumulative_vs_legacy" in r:
+            notes = f"cum:legacy {r['pass_b_cumulative_vs_legacy']}"
+        rate = r.get("rows_per_sec",
+                     r.get("prepare_rows_per_sec", float("nan")))
         print(f"| {r['scenario']} | {r.get('rows', '—'):,} | "
-              f"{r.get('rows_per_sec', float('nan')):,.0f} | {notes} |")
+              f"{rate:,.0f} | {notes} |")
+    _print_deltas(results, base_label, base_results)
     print(f"\nwritten: {out_path}")
 
 
@@ -461,10 +589,16 @@ def main() -> None:
     parser.add_argument("scenario", choices=["taxi", "tpch", "criteo",
                                              "wide1b", "streaming",
                                              "hostfed", "prepare",
-                                             "regression", "all"])
+                                             "passb", "regression",
+                                             "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
     parser.add_argument("--backend", default="tpu")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help="previous round's REGRESSION.json to diff "
+                             "against (default: newest committed "
+                             "benchmarks/REGRESSION_r*.json, else the "
+                             "workdir's previous run)")
     parser.add_argument("--exact-distinct", action="store_true",
                         help="profile with exact distinct counting "
                              "(spill dir under --workdir) — the "
@@ -473,7 +607,7 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.scenario == "regression":
-        run_regression(args.scale, args.workdir)
+        run_regression(args.scale, args.workdir, baseline=args.baseline)
         return
 
     # Persistent compilation cache: each ProfileReport builds a fresh
@@ -491,7 +625,7 @@ def main() -> None:
         pass                      # older jaxlibs: warm == cold, still valid
 
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
-              "prepare"]
+              "prepare", "passb"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -504,6 +638,8 @@ def main() -> None:
             result = run_hostfed(args.scale, args.workdir)
         elif name == "prepare":
             result = run_prepare(args.scale, args.workdir)
+        elif name == "passb":
+            result = run_passb(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

@@ -1,0 +1,397 @@
+"""Pass-B binning-formulation parity (ISSUE 3): the cumulative ≥-edge
+kernel must be bit-for-bin identical to the legacy per-element-index
+kernel — in BOTH tiers (pallas interpret-mode and the XLA fallback) —
+and to a numpy oracle that mirrors each tier's edge arithmetic exactly,
+over every value class the profile can meet (NaN/±inf, denormals,
+constant and single-value columns, adversarial boundary values) and bin
+counts 1–256.  HistState folds/merges across formulations must be
+byte-equal, and the differencing step must never emit a negative bin.
+
+The equality claims here are EXACT (``assert_array_equal``), not
+tolerances: for the same computed ``t`` and integer threshold ``b``,
+``floor(t) >= b ⇔ t >= b`` in IEEE arithmetic, so the two formulations
+are the same function — these tests pin that the implementations
+actually preserve it.
+
+Property style: the parity laws run over a seeded generator sweeping
+(shape × value class × bin count) so they execute on every CI box; when
+hypothesis is installed (pyproject ``[test]``) the same laws
+additionally fuzz over its search space (the import gate follows
+tests/test_properties.py).
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuprof.kernels import histogram, pallas_hist
+
+try:
+    from hypothesis import given, settings, strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:                     # optional dep — deterministic
+    HAVE_HYPOTHESIS = False             # sweeps below still run
+
+F32_TINY = np.float32(1e-38)            # denormal-adjacent magnitude
+KINDS = ("normal", "boundary", "denormal", "constant", "single",
+         "mixed", "hugespan")
+BIN_SWEEP = (1, 2, 3, 10, 17, 64, 128, 200, 256)
+
+
+def _oracle_counts(x, lo, hi, nbins, scale_form):
+    """Float-exact oracle of the legacy clip semantics, mirroring the
+    tier's edge arithmetic bit for bit: the XLA tier computes
+    ``(x-lo)/width*nbins`` in f32, the pallas tier ``(x-lo)*(nbins/width)``
+    — IEEE ops numpy reproduces exactly.  floor/clip then run in f64 on
+    the f32 result (both are value-exact)."""
+    x = x.astype(np.float32)
+    lo32 = lo.astype(np.float32)
+    with np.errstate(all="ignore"):     # hugespan: hi-lo overflows (as
+        # it does in-kernel — the oracle mirrors that too)
+        width = np.maximum(hi.astype(np.float32) - lo32,
+                           np.float32(1e-30)).astype(np.float32)
+        if scale_form == "div":         # XLA tier
+            t = ((x - lo32[None, :]) / width[None, :]
+                 * np.float32(nbins)).astype(np.float32)
+        else:                           # pallas tier: premultiplied scale
+            scale = (np.float32(nbins) / width).astype(np.float32)
+            t = ((x - lo32[None, :]) * scale[None, :]).astype(np.float32)
+        idx = np.clip(np.floor(t.astype(np.float64)), 0, nbins - 1)
+    out = np.zeros((x.shape[1], nbins), dtype=np.int64)
+    finite = np.isfinite(x)
+    for c in range(x.shape[1]):
+        v = idx[:, c][finite[:, c]]
+        # NaN t from finite x (f32-overflowed column spans): XLA's
+        # float→int convert saturates NaN to 0, i.e. bin 0
+        v = np.where(np.isnan(v), 0, v).astype(int)
+        np.add.at(out[c], v, 1)
+    return out
+
+
+def _make_case(kind, seed, nbins, rows=None, cols=None):
+    """(x, lo, hi, mean, nbins) for one adversarial value class, with
+    bounds derived the way the backend derives them (pass_b_bounds
+    clamp included)."""
+    rng = np.random.default_rng(seed)
+    rows = rows or int(rng.integers(4, 200))
+    cols = cols or int(rng.integers(1, 5))
+    if kind == "normal":
+        x = rng.normal(0, 10, (rows, cols))
+    elif kind == "boundary":
+        # values engineered onto/near bin edges of a unit range: the
+        # exact straddle class where a formulation mismatch would show
+        edges = rng.integers(0, nbins + 1, (rows, cols)) / nbins
+        x = edges + rng.choice([0.0, 1e-7, -1e-7], (rows, cols))
+    elif kind == "denormal":
+        x = rng.normal(0, 1, (rows, cols)) * F32_TINY
+    elif kind == "constant":
+        x = np.full((rows, cols), rng.uniform(-1e6, 1e6))
+    elif kind == "single":
+        x = np.full((rows, cols), np.nan)
+        x[rng.integers(0, rows)] = rng.uniform(-1e6, 1e6)
+    elif kind == "hugespan":
+        # f32-overflowing column span: hi-lo overflows to inf
+        x = rng.choice([-3.0e38, 0.0, 3.0e38], (rows, cols))
+    else:
+        x = rng.normal(0, 5, (rows, cols))
+        x[rng.random((rows, cols)) < 0.2] = np.nan
+        x[rng.random((rows, cols)) < 0.05] = np.inf
+        x[rng.random((rows, cols)) < 0.05] = -np.inf
+        x[rng.random((rows, cols)) < 0.05] = F32_TINY
+    x = x.astype(np.float32)
+    masked = np.where(np.isfinite(x), x.astype(np.float64), np.nan)
+    with np.errstate(all="ignore"):
+        lo = np.nanmin(masked, axis=0)
+        hi = np.nanmax(masked, axis=0)
+        mean = np.nanmean(masked, axis=0)
+    # all-NaN columns: the backend clamps bounds to 0 (pass_b_bounds)
+    lo = np.where(np.isfinite(lo), lo, 0.0).astype(np.float32)
+    hi = np.where(np.isfinite(hi), hi, 0.0).astype(np.float32)
+    mean = np.where(np.isfinite(mean), mean, 0.0).astype(np.float32)
+    return x, lo, hi, mean, nbins
+
+
+def _sweep_cases():
+    """Deterministic (kind × bins) sweep — every value class meets
+    small, large and non-power-of-two bin counts."""
+    for i, (kind, nbins) in enumerate(itertools.product(KINDS, BIN_SWEEP)):
+        yield kind, 1000 + i, nbins
+
+
+def _assert_xla_parity(case):
+    x, lo, hi, mean, nbins = case
+    rows, cols = x.shape
+    rv = np.ones(rows, dtype=bool)
+    args = (jnp.asarray(x), jnp.asarray(rv), jnp.asarray(lo),
+            jnp.asarray(hi), jnp.asarray(mean))
+    s_leg = jax.jit(histogram.update)(histogram.init(cols, nbins), *args)
+    s_cum = jax.jit(histogram.update_cumulative)(
+        histogram.init(cols, nbins), *args)
+    np.testing.assert_array_equal(np.asarray(s_leg["counts"]),
+                                  np.asarray(s_cum["counts"]))
+    np.testing.assert_array_equal(np.asarray(s_leg["abs_dev"]),
+                                  np.asarray(s_cum["abs_dev"]))
+    np.testing.assert_array_equal(
+        np.asarray(s_cum["counts"]),
+        _oracle_counts(x, lo, hi, nbins, "div"))
+
+
+def _assert_pallas_parity(case):
+    x, lo, hi, mean, nbins = case
+    nbins = min(nbins, pallas_hist.MAX_BINS)
+    rv = np.ones(x.shape[0], dtype=bool)
+    xt = jnp.asarray(np.ascontiguousarray(x.T))
+    args = (xt, jnp.asarray(rv), jnp.asarray(lo), jnp.asarray(hi),
+            jnp.asarray(mean), nbins)
+    c_leg, d_leg = pallas_hist.histogram_batch(*args, interpret=True,
+                                               kernel="legacy")
+    c_cum, d_cum = pallas_hist.histogram_batch(*args, interpret=True,
+                                               kernel="cumulative")
+    np.testing.assert_array_equal(np.asarray(c_leg), np.asarray(c_cum))
+    np.testing.assert_array_equal(np.asarray(d_leg), np.asarray(d_cum))
+    np.testing.assert_array_equal(
+        np.asarray(c_cum), _oracle_counts(x, lo, hi, nbins, "mul"))
+
+
+@pytest.mark.parametrize("kind,seed,nbins", list(_sweep_cases()))
+def test_xla_cumulative_equals_legacy_and_oracle(kind, seed, nbins):
+    """XLA tier: update_cumulative ≡ update ≡ the f32-exact numpy
+    oracle, byte for byte, bins 1–256, every value class."""
+    _assert_xla_parity(_make_case(kind, seed, nbins))
+
+
+@pytest.mark.parametrize("kind", KINDS)
+@pytest.mark.parametrize("nbins", (1, 10, 128))
+def test_pallas_cumulative_equals_legacy_and_oracle(kind, nbins):
+    """Pallas tier (interpret mode): cumulative ≡ legacy ≡ the oracle
+    mirroring the premultiplied-scale arithmetic, bins ≤ 128."""
+    _assert_pallas_parity(_make_case(kind, 77 + nbins, nbins))
+
+
+def _assert_merge_byte_equality(case, split_frac):
+    x, lo, hi, mean, nbins = case
+    rows, cols = x.shape
+    split = max(1, min(rows - 1, int(rows * split_frac)))
+    rv = np.ones(rows, dtype=bool)
+
+    def fold(fn_first, fn_second):
+        s = histogram.init(cols, nbins)
+        s = jax.jit(fn_first)(s, jnp.asarray(x[:split]),
+                              jnp.asarray(rv[:split]), jnp.asarray(lo),
+                              jnp.asarray(hi), jnp.asarray(mean))
+        s2 = histogram.init(cols, nbins)
+        s2 = jax.jit(fn_second)(s2, jnp.asarray(x[split:]),
+                                jnp.asarray(rv[split:]), jnp.asarray(lo),
+                                jnp.asarray(hi), jnp.asarray(mean))
+        return jax.jit(histogram.merge)(s, s2)
+
+    ref = fold(histogram.update, histogram.update)
+    mixed = fold(histogram.update_cumulative, histogram.update)
+    cum = fold(histogram.update_cumulative, histogram.update_cumulative)
+    for other in (mixed, cum):
+        for key in ("counts", "abs_dev"):
+            a, b = np.asarray(ref[key]), np.asarray(other[key])
+            assert a.dtype == b.dtype
+            assert a.tobytes() == b.tobytes(), key
+
+
+@pytest.mark.parametrize("kind", KINDS)
+@pytest.mark.parametrize("split_frac", (0.1, 0.5, 0.9))
+def test_histstate_merge_byte_equality_across_formulations(kind,
+                                                           split_frac):
+    """Fold a split stream through MIXED formulations and merge: the
+    HistState must be byte-identical to the single-formulation fold —
+    same dtypes, same bytes — so checkpoints, multi-host merges and
+    kernel-flag flips can never observe which kernel ran."""
+    _assert_merge_byte_equality(_make_case(kind, 31, 10), split_frac)
+
+
+if HAVE_HYPOTHESIS:
+    @st.composite
+    def binning_cases(draw):
+        kind = draw(st.sampled_from(KINDS))
+        seed = draw(st.integers(0, 2**31 - 1))
+        nbins = draw(st.sampled_from(BIN_SWEEP))
+        return _make_case(kind, seed, nbins)
+
+    @given(binning_cases())
+    @settings(max_examples=25, deadline=None)
+    def test_xla_parity_fuzzed(case):
+        _assert_xla_parity(case)
+
+    @given(binning_cases())
+    @settings(max_examples=10, deadline=None)
+    def test_pallas_parity_fuzzed(case):
+        _assert_pallas_parity(case)
+
+    @given(binning_cases(), st.floats(0.05, 0.95))
+    @settings(max_examples=10, deadline=None)
+    def test_merge_byte_equality_fuzzed(case, split_frac):
+        _assert_merge_byte_equality(case, split_frac)
+
+
+# ---------------------------------------------------------------------------
+# negative-count guard (differencing step)
+# ---------------------------------------------------------------------------
+
+def test_counts_from_cumulative_clamps_adversarial_input():
+    """A non-monotone cumulative row (what a float non-monotonicity in
+    hand-derived edges would produce) must clamp to empty bins, never
+    emit a negative count."""
+    cum = jnp.asarray(np.array([
+        [10, 4, 7, 2],          # 4 < 7: adversarial rise mid-row
+        [5, 5, 5, 5],           # flat: all mass in the last bin
+        [3, 2, 1, 0],           # well-formed
+        [0, 9, 0, 9],           # pathological zig-zag
+    ], dtype=np.int32))
+    out = np.asarray(histogram.counts_from_cumulative(cum))
+    assert (out >= 0).all(), out
+    # well-formed rows difference exactly
+    np.testing.assert_array_equal(out[2], [1, 1, 1, 0])
+    # last bin is always cum[-1] (clamped at 0)
+    np.testing.assert_array_equal(out[:, -1], np.maximum(cum[:, -1], 0))
+
+
+@pytest.mark.parametrize("seed,nbins,cols", [
+    (s, nb, c) for s in (0, 1, 2) for nb in (1, 7, 64) for c in (1, 5)])
+def test_counts_from_cumulative_properties(seed, nbins, cols):
+    """For ANY int32 input: no negative output; and for monotone
+    non-increasing input the differencing is exact (sums to cum[:, 0])."""
+    rng = np.random.default_rng(seed)
+    raw = rng.integers(-50, 1000, (cols, nbins)).astype(np.int32)
+    out = np.asarray(histogram.counts_from_cumulative(jnp.asarray(raw)))
+    assert (out >= 0).all()
+    mono = np.sort(np.abs(raw), axis=1)[:, ::-1].astype(np.int32)
+    out_m = np.asarray(histogram.counts_from_cumulative(
+        jnp.asarray(np.ascontiguousarray(mono))))
+    np.testing.assert_array_equal(out_m.sum(axis=1), mono[:, 0])
+
+
+# ---------------------------------------------------------------------------
+# config / dispatch wiring
+# ---------------------------------------------------------------------------
+
+def test_resolve_pass_b_kernel_precedence(monkeypatch):
+    from tpuprof.config import resolve_pass_b_kernel
+    monkeypatch.delenv("TPUPROF_PASS_B_KERNEL", raising=False)
+    assert resolve_pass_b_kernel(None) == "cumulative"
+    assert resolve_pass_b_kernel("legacy") == "legacy"
+    monkeypatch.setenv("TPUPROF_PASS_B_KERNEL", "legacy")
+    assert resolve_pass_b_kernel(None) == "legacy"
+    # explicit config beats the env (same contract as the worker knobs)
+    assert resolve_pass_b_kernel("cumulative") == "cumulative"
+    monkeypatch.setenv("TPUPROF_PASS_B_KERNEL", "sideways")
+    with pytest.raises(ValueError, match="TPUPROF_PASS_B_KERNEL"):
+        resolve_pass_b_kernel(None)
+
+
+def test_config_validates_pass_b_kernel():
+    from tpuprof import ProfilerConfig
+    with pytest.raises(ValueError, match="pass_b_kernel"):
+        ProfilerConfig(pass_b_kernel="sideways")
+    assert ProfilerConfig(pass_b_kernel="legacy").pass_b_kernel == "legacy"
+
+
+class _HB:
+    """Minimal HostBatch stand-in for direct MeshRunner folds."""
+
+    def __init__(self, x):
+        self.x = np.asfortranarray(x.astype(np.float32))
+        self.nrows = x.shape[0]
+        self.row_valid = np.ones(x.shape[0], dtype=bool)
+        self.hll = np.zeros((x.shape[0], 0), dtype=np.uint16)
+        self.hll_precision = 11
+
+
+def test_mesh_runner_routes_selected_kernel(monkeypatch):
+    """pass_b_kernel=legacy must select the OLD update path (the
+    rollback contract), cumulative the new one — asserted by spying the
+    actual kernel entry points, not just the attribute."""
+    from tpuprof import ProfilerConfig
+    from tpuprof.runtime.mesh import MeshRunner
+
+    calls = []
+    orig_update, orig_cum = histogram.update, histogram.update_cumulative
+    monkeypatch.setattr(histogram, "update",
+                        lambda *a, **k: calls.append("legacy")
+                        or orig_update(*a, **k))
+    monkeypatch.setattr(histogram, "update_cumulative",
+                        lambda *a, **k: calls.append("cumulative")
+                        or orig_cum(*a, **k))
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, (64, 3)).astype(np.float32)
+    lo, hi, mean = x.min(axis=0), x.max(axis=0), x.mean(axis=0)
+
+    results = {}
+    for kern in ("legacy", "cumulative"):
+        calls.clear()
+        runner = MeshRunner(ProfilerConfig(batch_rows=64,
+                                           pass_b_kernel=kern),
+                            n_num=3, n_hash=0)
+        assert runner.pass_b_kernel == kern
+        state = runner.step_b(runner.init_pass_b(), _HB(x), lo, hi, mean)
+        assert calls == [kern]          # traced through the right path
+        results[kern] = np.asarray(state["counts"][0])
+    np.testing.assert_array_equal(results["legacy"],
+                                  results["cumulative"])
+
+
+def test_profile_identical_across_kernels():
+    """End-to-end: a full backend profile is bit-identical (histograms,
+    MAD) whichever pass-B kernel the config selects."""
+    import pandas as pd
+
+    from tpuprof import ProfilerConfig
+    from tpuprof.backends.tpu import TPUStatsBackend
+
+    rng = np.random.default_rng(11)
+    n = 1500
+    df = pd.DataFrame({
+        "a": rng.normal(5, 2, n),
+        "b": rng.exponential(1.5, n),
+        "c": np.where(rng.random(n) < 0.1, np.nan,
+                      rng.integers(0, 9, n).astype(np.float64)),
+        "k": rng.choice(["x", "y"], n),
+    })
+    out = {}
+    for kern in ("legacy", "cumulative"):
+        out[kern] = TPUStatsBackend().collect(
+            df, ProfilerConfig(backend="tpu", batch_rows=256,
+                               scan_batches=2, pass_b_kernel=kern))
+    for name in ("a", "b", "c"):
+        v_l = out["legacy"]["variables"][name]
+        v_c = out["cumulative"]["variables"][name]
+        np.testing.assert_array_equal(v_l["histogram"][0],
+                                      v_c["histogram"][0], err_msg=name)
+        np.testing.assert_array_equal(v_l["histogram"][1],
+                                      v_c["histogram"][1], err_msg=name)
+        assert v_l["mad"] == v_c["mad"], name
+
+
+def test_pass_b_dispatch_metrics_labelled_by_kernel():
+    """The pass-B dispatch sites must feed the kernel-labelled obs
+    series (OBSERVABILITY.md) so a fleet mixing formulations can
+    attribute counts to the kernel actually running."""
+    from tpuprof import ProfilerConfig, obs
+    from tpuprof.runtime.mesh import MeshRunner
+
+    obs.configure(enabled=True)
+    try:
+        rng = np.random.default_rng(5)
+        x = rng.normal(0, 1, (64, 2)).astype(np.float32)
+        runner = MeshRunner(ProfilerConfig(batch_rows=64,
+                                           pass_b_kernel="cumulative"),
+                            n_num=2, n_hash=0)
+        before = obs.registry().snapshot()["counters"].get(
+            "tpuprof_pass_b_dispatch_total", {})
+        runner.step_b(runner.init_pass_b(), _HB(x),
+                      x.min(axis=0), x.max(axis=0), x.mean(axis=0))
+        after = obs.registry().snapshot()["counters"].get(
+            "tpuprof_pass_b_dispatch_total", {})
+        key = '{kernel="cumulative"}'
+        assert after.get(key, 0) == before.get(key, 0) + 1
+    finally:
+        obs.configure(enabled=False)

@@ -31,6 +31,11 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 sys.path.insert(0, sys.argv[5])
 import jax
 jax.config.update("jax_platforms", "cpu")
+# this jaxlib's CPU client ships without default multiprocess
+# collectives ("Multiprocess computations aren't implemented on the
+# CPU backend"); the gloo TCP implementation is compiled in and just
+# needs selecting before the backend initializes
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(coordinator_address="localhost:" + port,
                            num_processes=2, process_id=pid)
 from tpuprof import ProfilerConfig
@@ -122,6 +127,7 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 sys.path.insert(0, sys.argv[5])
 import jax
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 from tpuprof.cli import main
 sys.exit(main([
     "profile", ds, "-o", out, "--backend", "tpu",
@@ -182,6 +188,11 @@ sys.path.insert(0, sys.argv[5])
 spill = sys.argv[6]
 import jax
 jax.config.update("jax_platforms", "cpu")
+# this jaxlib's CPU client ships without default multiprocess
+# collectives ("Multiprocess computations aren't implemented on the
+# CPU backend"); the gloo TCP implementation is compiled in and just
+# needs selecting before the backend initializes
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(coordinator_address="localhost:" + port,
                            num_processes=2, process_id=pid)
 from tpuprof import ProfilerConfig
@@ -276,6 +287,11 @@ sys.path.insert(0, sys.argv[5])
 ckpt = sys.argv[6]; crash_at = int(sys.argv[7])
 import jax
 jax.config.update("jax_platforms", "cpu")
+# this jaxlib's CPU client ships without default multiprocess
+# collectives ("Multiprocess computations aren't implemented on the
+# CPU backend"); the gloo TCP implementation is compiled in and just
+# needs selecting before the backend initializes
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(coordinator_address="localhost:" + port,
                            num_processes=2, process_id=pid)
 import tpuprof.backends.tpu as tpu

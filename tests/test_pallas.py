@@ -22,12 +22,13 @@ def _reference(x, lo, hi, nbins):
     return out
 
 
+@pytest.mark.parametrize("kernel", ["legacy", "cumulative"])
 @pytest.mark.parametrize("rows,cols,nbins", [
     (1000, 7, 10),          # non-tile-aligned both dims
     (512, 128, 10),         # exactly one tile
     (1500, 200, 64),        # multiple tiles both dims
 ])
-def test_matches_reference(rows, cols, nbins):
+def test_matches_reference(rows, cols, nbins, kernel):
     rng = np.random.default_rng(rows + cols)
     x = rng.normal(0, 5, (rows, cols)).astype(np.float32)
     x[rng.random((rows, cols)) < 0.05] = np.nan
@@ -38,7 +39,7 @@ def test_matches_reference(rows, cols, nbins):
     got, dev = pallas_hist.histogram_tiles(
         jnp.asarray(np.ascontiguousarray(x.T)),
         jnp.ones(rows, dtype=bool), jnp.asarray(lo), jnp.asarray(hi),
-        jnp.asarray(mean), nbins, interpret=True)
+        jnp.asarray(mean), nbins, interpret=True, kernel=kernel)
     np.testing.assert_array_equal(np.asarray(got),
                                   _reference(x, lo, hi, nbins))
     masked = np.where(np.isfinite(x), x, np.nan)
@@ -46,7 +47,8 @@ def test_matches_reference(rows, cols, nbins):
     np.testing.assert_allclose(np.asarray(dev), expect_dev, rtol=1e-5)
 
 
-def test_matches_xla_scatter_path():
+@pytest.mark.parametrize("kernel", ["legacy", "cumulative"])
+def test_matches_xla_scatter_path(kernel):
     import jax
     from tpuprof.kernels import histogram
     rng = np.random.default_rng(0)
@@ -64,7 +66,7 @@ def test_matches_xla_scatter_path():
     pallas_counts, pallas_dev = pallas_hist.histogram_batch(
         jnp.asarray(np.ascontiguousarray(x.T)), jnp.asarray(row_valid),
         jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(mean), nbins,
-        interpret=True)
+        interpret=True, kernel=kernel)
     np.testing.assert_array_equal(np.asarray(pallas_counts),
                                   scatter_counts)
     np.testing.assert_allclose(np.asarray(pallas_dev),
@@ -76,3 +78,11 @@ def test_rejects_too_many_bins():
         pallas_hist.histogram_tiles(
             jnp.zeros((2, 8)), jnp.ones(8, dtype=bool), jnp.zeros(2),
             jnp.ones(2), jnp.zeros(2), 200, interpret=True)
+
+
+def test_rejects_unknown_kernel():
+    with pytest.raises(ValueError, match="pass-B kernel"):
+        pallas_hist.histogram_tiles(
+            jnp.zeros((2, 8)), jnp.ones(8, dtype=bool), jnp.zeros(2),
+            jnp.ones(2), jnp.zeros(2), 10, interpret=True,
+            kernel="sideways")

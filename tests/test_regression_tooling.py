@@ -1,0 +1,98 @@
+"""Regression-harness tooling (ISSUE 3 satellite): the cross-round
+delta diff must find the previous round's REGRESSION.json and print a
+flagged pass-B delta line, so a silent pass-B regression is visible
+without reading JSON by hand."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmarks.run import _load_baseline, _print_deltas  # noqa: E402
+
+
+def _payload(passb_rate, taxi_rate=100000.0):
+    return {"scale": 0.01, "results": [
+        {"scenario": "taxi", "rows": 70000, "rows_per_sec": taxi_rate},
+        {"scenario": "passb", "rows": 2000000,
+         "pass_b_rows_per_sec": passb_rate,
+         "rows_per_sec": passb_rate,
+         "pass_b_legacy_rows_per_sec": passb_rate / 2.5,
+         "pass_b_cumulative_vs_legacy": 2.5},
+    ]}
+
+
+def test_load_baseline_prefers_explicit_then_committed_then_workdir(
+        tmp_path, monkeypatch):
+    import benchmarks.run as brun
+
+    workdir = tmp_path / "wd"
+    workdir.mkdir()
+    (workdir / "REGRESSION.json").write_text(
+        json.dumps(_payload(1000.0)))
+    explicit = tmp_path / "r05.json"
+    explicit.write_text(json.dumps(_payload(2000.0)))
+    committed = tmp_path / "REGRESSION_r04.json"
+    committed.write_text(json.dumps(_payload(3000.0)))
+
+    # pin the "committed benchmarks/REGRESSION_r*.json" glob to a known
+    # set so the repo's real snapshots cannot leak into the test
+    import glob as _glob
+    real_glob = _glob.glob
+    monkeypatch.setattr(
+        _glob, "glob",
+        lambda pat, *a, **k: ([str(committed)]
+                              if "REGRESSION_r*" in pat
+                              else real_glob(pat, *a, **k)))
+
+    # explicit --baseline beats everything
+    label, by_name = _load_baseline(str(explicit), str(workdir))
+    assert label == "r05.json"
+    assert by_name["passb"]["pass_b_rows_per_sec"] == 2000.0
+
+    # else the newest committed round snapshot
+    label, by_name = _load_baseline(None, str(workdir))
+    assert label == "REGRESSION_r04.json"
+    assert by_name["passb"]["pass_b_rows_per_sec"] == 3000.0
+
+    # else the workdir's previous run
+    monkeypatch.setattr(_glob, "glob",
+                        lambda pat, *a, **k: []
+                        if "REGRESSION_r*" in pat
+                        else real_glob(pat, *a, **k))
+    label, by_name = _load_baseline(None, str(workdir))
+    assert label == "REGRESSION.json"
+    assert by_name["passb"]["pass_b_rows_per_sec"] == 1000.0
+
+    # nothing anywhere: a first round diffs against nothing, not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert _load_baseline(None, str(empty)) == (None, {})
+
+
+def test_print_deltas_flags_pass_b_regression(capsys):
+    baseline = {r["scenario"]: r for r in _payload(1000.0)["results"]}
+    # pass_b drops 40% -> flagged; taxi moves +10% -> printed, unflagged
+    results = _payload(600.0, taxi_rate=110000.0)["results"]
+    _print_deltas(results, "REGRESSION_r05.json", baseline)
+    out = capsys.readouterr().out
+    assert "passb: 1,000 → 600 rows/s (-40.0%)" in out
+    assert "REGRESSION?" in out
+    assert "taxi" in out and "+10.0%" in out
+    assert out.count("REGRESSION?") == 1       # taxi NOT flagged
+
+
+def test_print_deltas_handles_missing_and_failed(capsys):
+    baseline = {r["scenario"]: r for r in _payload(1000.0)["results"]}
+    results = [
+        {"scenario": "passb", "error": "boom"},
+        {"scenario": "newcomer", "rows_per_sec": 5.0},
+    ]
+    _print_deltas(results, "prev", baseline)
+    out = capsys.readouterr().out
+    assert "passb: FAILED this round" in out
+    assert "newcomer: no baseline figure" in out
+    _print_deltas(results, None, {})
+    assert "nothing to diff" in capsys.readouterr().out

@@ -75,7 +75,11 @@ def test_fused_wide_column_tiled_kernel_on_hardware(tpu_backend):
     _assert_fused_matches_xla(cols=cols, rows=1024)
 
 
-def test_pallas_histogram_on_hardware(tpu_backend):
+@pytest.mark.parametrize("kernel", ["legacy", "cumulative"])
+def test_pallas_histogram_on_hardware(tpu_backend, kernel):
+    """Both pass-B formulations compile with Mosaic and match the XLA
+    scatter twin bit-for-bin on the chip (the cumulative kernel is the
+    ISSUE-3 fast path; legacy is its rollback flag)."""
     import jax.numpy as jnp
     from tpuprof.kernels import histogram, pallas_hist
 
@@ -88,7 +92,7 @@ def test_pallas_histogram_on_hardware(tpu_backend):
 
     counts, abs_dev = pallas_hist.histogram_batch(
         jnp.asarray(xt), jnp.asarray(rv), jnp.asarray(lo),
-        jnp.asarray(hi), jnp.asarray(mean), bins)
+        jnp.asarray(hi), jnp.asarray(mean), bins, kernel=kernel)
     state = histogram.update(histogram.init(cols, bins), jnp.asarray(xt.T),
                              jnp.asarray(rv), jnp.asarray(lo),
                              jnp.asarray(hi), jnp.asarray(mean))

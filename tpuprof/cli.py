@@ -41,6 +41,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "TPUPROF_PREP_WORKERS env, else all cores; 1 = "
                         "the serial reference path, byte-identical "
                         "output at any width)")
+    p.add_argument("--pass-b-kernel", default=None,
+                   choices=("cumulative", "legacy"),
+                   help="pass-B binning formulation (default: "
+                        "TPUPROF_PASS_B_KERNEL env, else cumulative). "
+                        "Both are bit-for-bin identical; legacy is the "
+                        "rollback if the cumulative kernel regresses on "
+                        "a given chip")
     p.add_argument("--sketch-size", type=int, default=4096,
                    help="quantile sample-sketch size K")
     p.add_argument("--hll-precision", type=int, default=11)
@@ -216,6 +223,7 @@ def cmd_profile(args: argparse.Namespace) -> int:
             batch_rows=args.batch_rows, scan_batches=args.scan_batches,
             prepare_workers=args.prepare_workers,
             prep_workers=args.prep_workers,
+            pass_b_kernel=args.pass_b_kernel,
             quantile_sketch_size=args.sketch_size,
             hll_precision=args.hll_precision,
             exact_passes=not args.single_pass,

@@ -48,6 +48,28 @@ def resolve_prepare_workers(value: Optional[int] = None) -> int:
     return max(1, min(4, (os.cpu_count() or 1) // 2))
 
 
+PASS_B_KERNELS = ("cumulative", "legacy")
+
+
+def resolve_pass_b_kernel(value: Optional[str] = None) -> str:
+    """Pass-B binning formulation: an explicit config value wins; else
+    ``TPUPROF_PASS_B_KERNEL``; else ``cumulative`` (the fast path —
+    ≥-edge compares with out-of-kernel differencing, bit-for-bin
+    identical to legacy).  ``legacy`` keeps the per-element bin-index
+    formulation (scatter-add on XLA meshes, index compare kernel on
+    pallas meshes), so a hardware regression in the new kernel is one
+    flag away from the old one."""
+    for cand, origin in ((value, "pass_b_kernel"),
+                         (os.environ.get("TPUPROF_PASS_B_KERNEL"),
+                          "TPUPROF_PASS_B_KERNEL")):
+        if cand:
+            if cand not in PASS_B_KERNELS:
+                raise ValueError(
+                    f"{origin}={cand!r} — use one of {PASS_B_KERNELS}")
+            return cand
+    return "cumulative"
+
+
 def resolve_metrics_enabled(value: Optional[bool] = None,
                             metrics_path: Optional[str] = None) -> bool:
     """Observability switch (tpuprof/obs): an explicit config value
@@ -257,6 +279,19 @@ class ProfilerConfig:
     use_pallas: Optional[bool] = None   # None = auto (on for real TPU):
                                         # dense pallas histogram kernel vs
                                         # XLA scatter-add
+    pass_b_kernel: Optional[str] = None  # pass-B binning formulation:
+                                         # "cumulative" (default — ≥-edge
+                                         # compares, counts differenced
+                                         # outside the kernel; ~2x fewer
+                                         # per-element VPU ops) or
+                                         # "legacy" (per-element bin
+                                         # indices — the rollback flag if
+                                         # the new kernel regresses on
+                                         # real hardware).  None = auto:
+                                         # TPUPROF_PASS_B_KERNEL env,
+                                         # else "cumulative".  Both are
+                                         # bit-for-bin identical; this
+                                         # selects COST, not results.
     use_fused: Optional[bool] = None    # None = auto (on for real TPU):
                                         # single-read fused pallas pass A
                                         # (kernels/fused.py) vs the
@@ -307,6 +342,12 @@ class ProfilerConfig:
             raise ValueError("prepare_workers must be >= 1 (or None)")
         if self.prep_workers is not None and self.prep_workers < 1:
             raise ValueError("prep_workers must be >= 1 (or None)")
+        if self.pass_b_kernel is not None \
+                and self.pass_b_kernel not in PASS_B_KERNELS:
+            raise ValueError(
+                f"pass_b_kernel={self.pass_b_kernel!r} — use one of "
+                f"{PASS_B_KERNELS} (or None for the "
+                "TPUPROF_PASS_B_KERNEL/default resolution)")
         if self.metrics_interval < 0:
             raise ValueError("metrics_interval must be >= 0")
         if self.metrics_block_sample < 0:

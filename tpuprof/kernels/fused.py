@@ -65,17 +65,32 @@ _BLOCK_SECONDS = _obs_metrics.histogram(
     "sampled wall seconds from enqueue to block_until_ready, by program")
 _dispatch_seq = [0]     # process-wide sample phase (racy += is fine: the
                         # worst case is a sample skipped or doubled)
+# pass-B gets its own kernel-labelled series (OBSERVABILITY.md): the
+# legacy/cumulative formulations are runtime-selectable
+# (config.pass_b_kernel), so a fleet mixing them must be able to
+# attribute dispatch counts/timings to the kernel actually running
+_PASS_B_DISPATCHES = _obs_metrics.counter(
+    "tpuprof_pass_b_dispatch_total",
+    "pass-B device dispatches, by binning kernel (legacy/cumulative)")
+_PASS_B_SECONDS = _obs_metrics.histogram(
+    "tpuprof_pass_b_dispatch_seconds",
+    "sampled pass-B enqueue-to-ready wall seconds, by binning kernel")
 
 
-def observe_dispatch(program: str, result, batches: int = 1):
+def observe_dispatch(program: str, result, batches: int = 1,
+                     kernel: str = None):
     """Record one device dispatch (and sometimes time it).  Called by
     MeshRunner at every enqueue site with the dispatch's result pytree;
-    returns the result unchanged so call sites stay expressions."""
+    returns the result unchanged so call sites stay expressions.
+    ``kernel`` (pass-B sites only) additionally feeds the
+    kernel-labelled pass-B series."""
     if not _obs_metrics.enabled():
         return result
     _DISPATCHES.inc(program=program)
     if batches > 1:
         _DISPATCHES.inc(batches, program=f"{program}_batches")
+    if kernel is not None:
+        _PASS_B_DISPATCHES.inc(kernel=kernel)
     rate = 0
     try:
         from tpuprof import obs
@@ -88,8 +103,10 @@ def observe_dispatch(program: str, result, batches: int = 1):
             import time
             t0 = time.perf_counter()
             jax.block_until_ready(result)
-            _BLOCK_SECONDS.observe(time.perf_counter() - t0,
-                                   program=program)
+            elapsed = time.perf_counter() - t0
+            _BLOCK_SECONDS.observe(elapsed, program=program)
+            if kernel is not None:
+                _PASS_B_SECONDS.observe(elapsed, kernel=kernel)
     return result
 
 C_ALIGN = 8            # sublane-axis (column) padding multiple — the f32

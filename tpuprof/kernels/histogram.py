@@ -1,10 +1,26 @@
-"""Fixed-bin histograms for all numeric columns in one scatter-add.
+"""Fixed-bin histograms for all numeric columns — XLA tier of pass B.
 
 Replaces the reference's per-column RDD ``histogram()`` jobs (SURVEY.md
-§2.2) with a single flattened segment scatter-add over (cols × bins)
-counters.  Runs in pass B, once the exact finite min/max per column are
-known from pass A — reproducing np.histogram semantics exactly (right
-edge of the last bin inclusive via the clip).
+§2.2) with a single batched update over (cols × bins) counters.  Runs
+in pass B, once the exact finite min/max per column are known from
+pass A — reproducing np.histogram semantics exactly (right edge of the
+last bin inclusive via the clip).
+
+Two formulations (``ProfilerConfig.pass_b_kernel`` selects; the mesh
+runtime routes real-TPU meshes to the pallas twins in pallas_hist.py):
+
+* ``update`` (legacy) — flattened segment scatter-add over per-element
+  bin indices;
+* ``update_cumulative`` — cumulative ≥-edge compares on the SAME
+  scaled value legacy feeds ``floor`` (``floor(t) >= b ⇔ t >= b`` for
+  integer b, so the differenced counts are bit-for-bin identical), with
+  the per-bin difference taken by :func:`counts_from_cumulative`
+  OUTSIDE the counting pass.  No scatter, no index materialization —
+  the formulation the pallas cumulative kernel mirrors on TPU.
+
+Both fold into the same per-bin ``HistState`` — merges and finalize are
+formulation-blind, and states from either path are byte-identical
+(tests/test_hist_cumulative.py pins this).
 
 Also accumulates Σ|x − mean| per column (the oracle's MAD needs the pass-A
 mean), folding the second statistic into the same read of the batch.
@@ -46,6 +62,53 @@ def update(state: HistState, x: Array, row_valid: Array,
     abs_dev = jnp.where(finite, jnp.abs(x - mean[None, :]), 0.0).sum(axis=0)
     return {
         "counts": state["counts"] + flat[: n_cols * bins].reshape(n_cols, bins),
+        "abs_dev": state["abs_dev"] + abs_dev,
+    }
+
+
+def counts_from_cumulative(cum: Array) -> Array:
+    """(cols, bins) cumulative ≥-edge counts → per-bin counts.
+
+    ``cum[:, b]`` counts elements at-or-above edge b (column 0 = all
+    binned elements), so ``counts[b] = cum[b] - cum[b+1]`` with an
+    implicit ``cum[bins] = 0``.  The ``maximum(…, 0)`` is the
+    negative-count guard: a well-formed cumulative input is monotone
+    non-increasing by construction (integer thresholds against one
+    computed value — a float non-monotonicity in derived EDGES cannot
+    occur in-kernel), but a corrupted or hand-built input must clamp to
+    an empty bin rather than emit a negative count that would poison
+    every downstream sum (tests/test_hist_cumulative.py pins this on
+    adversarial inputs)."""
+    upper = jnp.concatenate(
+        [cum[:, 1:], jnp.zeros((cum.shape[0], 1), dtype=cum.dtype)],
+        axis=1)
+    return jnp.maximum(cum - upper, 0)
+
+
+def update_cumulative(state: HistState, x: Array, row_valid: Array,
+                      lo: Array, hi: Array, mean: Array) -> HistState:
+    """``update`` twin without the scatter: cumulative ≥-edge compares
+    on the same ``(x - lo) / width * bins`` value, differenced by
+    :func:`counts_from_cumulative`.  Bit-for-bin identical to ``update``
+    for every input (module docstring)."""
+    n_cols, bins = state["counts"].shape
+    finite = row_valid[:, None] & jnp.isfinite(x)
+    width = jnp.maximum(hi - lo, 1e-30)[None, :]
+    t = (x - lo[None, :]) / width * bins
+    t = jnp.where(finite, t, jnp.nan)      # NaN fails every >= compare
+    # (rows, cols) >= (bins-1,) edges -> (cols, bins-1) lane reduces;
+    # column 0 is the finite count (every finite element clips into
+    # some bin), so no 0-edge compare is needed
+    edges = jnp.arange(1, bins, dtype=t.dtype)
+    cum_tail = jnp.sum(
+        (t[:, :, None] >= edges[None, None, :]).astype(jnp.int32),
+        axis=0)                            # (cols, bins-1)
+    cum = jnp.concatenate(
+        [jnp.sum(finite.astype(jnp.int32), axis=0, keepdims=True).T,
+         cum_tail], axis=1)                # (cols, bins)
+    abs_dev = jnp.where(finite, jnp.abs(x - mean[None, :]), 0.0).sum(axis=0)
+    return {
+        "counts": state["counts"] + counts_from_cumulative(cum),
         "abs_dev": state["abs_dev"] + abs_dev,
     }
 

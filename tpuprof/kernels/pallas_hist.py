@@ -9,6 +9,28 @@ VMEM — no scatter at all.  The MAD numerator Σ|x−mean| rides the same
 read (a separate XLA reduction measured as expensive as the histogram
 itself on the target device — PERF.md).
 
+Two formulations share the entry points (selected by ``kernel=``, wired
+from ``ProfilerConfig.pass_b_kernel`` via the mesh runtime):
+
+* ``legacy`` — per-element bin-index materialization:
+  ``idx = clip(floor((x-lo)*scale), 0, nbins-1)`` then one ``idx == b``
+  compare+lane-reduce per bin.  The index prologue (floor/clip/astype/
+  select) is ~6 extra VPU passes over the full (C, R) tile before any
+  bin is counted.
+* ``cumulative`` — ≥-edge compares on the raw scaled value: compute
+  ``t = (x-lo)*scale`` ONCE (the same two arithmetic ops legacy feeds
+  floor), then accumulate CUMULATIVE counts ``cum[b] = #(t >= b)`` —
+  one f32 compare+lane-reduce per bin, no floor/clip/astype/int index
+  anywhere.  Per-bin counts are recovered OUTSIDE the kernel by
+  differencing adjacent cumulative columns
+  (``kernels.histogram.counts_from_cumulative``).  Bit-for-bin equality
+  with legacy is by construction, not by tolerance: for the SAME
+  computed t and an integer threshold b, ``floor(t) >= b  ⇔  t >= b``
+  in IEEE arithmetic, so every element lands in the identical bin —
+  including ±overflowed t (clip vs compare saturate the same way) and
+  NaN/masked elements (compares are False; legacy's -1 sentinel index
+  matches no bin).
+
 Layout (per /opt/skills/guides/pallas_guide.md tiling rules, matching
 kernels/fused.py): the batch arrives as the mesh ships it — ``xt`` is
 (cols, rows), columns on the sublane axis (8-aligned for f32, so
@@ -18,9 +40,10 @@ have constant index maps so Mosaic keeps them VMEM-resident across the
 grid and writes them back once.  ``row_valid`` masks padding in-kernel
 (no NaN-masking pre-pass over the batch).
 
-The kernel is exact (same clip semantics as the XLA path) and is tested
-in interpreter mode on CPU against both numpy and the scatter version
-(tests/test_pallas.py); the mesh runtime enables it on real TPU only.
+Both kernels are exact (same clip semantics as the XLA path) and are
+tested in interpreter mode on CPU against numpy, the scatter version
+and each other (tests/test_pallas.py, tests/test_hist_cumulative.py);
+the mesh runtime enables them on real TPU only.
 """
 
 from __future__ import annotations
@@ -76,19 +99,71 @@ def _hist_kernel(xt_ref, rv_ref, lo_ref, scale_ref, mean_ref, out_ref,
     dev_ref[...] += dev
 
 
-@functools.partial(jax.jit, static_argnames=("nbins", "interpret"))
+def _hist_kernel_cumulative(xt_ref, rv_ref, lo_ref, scale_ref, mean_ref,
+                            out_ref, dev_ref, *, nbins: int):
+    """Cumulative ≥-edge formulation.  ``out_ref`` accumulates
+    ``cum[:, b] = #(t >= b)`` (column 0 = the finite count, since every
+    finite element clips into SOME bin); per-bin counts are differenced
+    outside the kernel.  ``t`` is the SAME ``(x - lo) * scale`` legacy
+    feeds ``floor``, and ``floor(t) >= b ⇔ t >= b`` for integer b, so
+    the differenced counts are bit-for-bin identical to legacy's —
+    without materializing any per-element index (no floor/clip/astype/
+    int-select passes over the tile)."""
+    i = pl.program_id(0)
+    x = xt_ref[...]                           # (C, R)
+    rv = rv_ref[...] > 0                      # (1, R)
+    lo = lo_ref[...]                          # (C, 1)
+    scale = scale_ref[...]                    # (C, 1)
+    mean = mean_ref[...]                      # (C, 1)
+    finite = rv & jnp.isfinite(x)
+    # NaN fails every >= compare, so one select masks invalid elements
+    # out of all nbins-1 edge counts at once
+    t = jnp.where(finite, (x - lo) * scale, jnp.nan)
+
+    cum = jnp.concatenate(
+        [jnp.sum(finite.astype(jnp.int32), axis=1, keepdims=True)]
+        + [jnp.sum((t >= float(b)).astype(jnp.int32), axis=1,
+                   keepdims=True)
+           for b in range(1, nbins)], axis=1)  # (C, nbins)
+
+    dev = jnp.sum(jnp.where(finite, jnp.abs(x - mean), 0.0),
+                  axis=1, keepdims=True)      # (C, 1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+        dev_ref[...] = jnp.zeros_like(dev_ref)
+
+    out_ref[...] += cum
+    dev_ref[...] += dev
+
+
+_KERNELS = {"legacy": _hist_kernel, "cumulative": _hist_kernel_cumulative}
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nbins", "interpret", "kernel"))
 def histogram_tiles(xt: jnp.ndarray, row_valid: jnp.ndarray,
                     lo: jnp.ndarray, hi: jnp.ndarray, mean: jnp.ndarray,
-                    nbins: int, interpret: bool = False):
+                    nbins: int, interpret: bool = False,
+                    kernel: str = "legacy"):
     """(cols, rows) f32 (NaN = skip; padding rows via ``row_valid``) →
     ((cols, nbins) int32 counts, (cols,) f32 Σ|x−mean|).
 
     ``lo``/``hi`` are per-column finite ranges (pass-A min/max); values
     land in ``clip(floor((x-lo)/(hi-lo)*nbins), 0, nbins-1)`` — identical
     semantics to kernels/histogram.py and np.histogram's inclusive last
-    edge.  ``mean`` is the pass-A mean feeding the exact-MAD numerator."""
+    edge.  ``mean`` is the pass-A mean feeding the exact-MAD numerator.
+
+    ``kernel`` selects the formulation (module docstring): both return
+    PER-BIN counts — the cumulative kernel's output is differenced here
+    (a (cols, nbins) elementwise op, outside the pallas program), so
+    callers and the HistState fold are formulation-blind."""
     if nbins > MAX_BINS:
         raise ValueError(f"pallas histogram supports bins <= {MAX_BINS}")
+    if kernel not in _KERNELS:
+        raise ValueError(f"unknown pass-B kernel {kernel!r} — use "
+                         f"{sorted(_KERNELS)}")
     cols, rows = xt.shape
     cpad = -cols % C_ALIGN
     C = cols + cpad
@@ -103,7 +178,7 @@ def histogram_tiles(xt: jnp.ndarray, row_valid: jnp.ndarray,
 
     n_rt = (rows + rpad) // r_tile
     counts, dev = pl.pallas_call(
-        functools.partial(_hist_kernel, nbins=nbins),
+        functools.partial(_KERNELS[kernel], nbins=nbins),
         grid=(n_rt,),
         in_specs=[
             pl.BlockSpec((C, r_tile), lambda i: (0, i)),
@@ -122,12 +197,17 @@ def histogram_tiles(xt: jnp.ndarray, row_valid: jnp.ndarray,
         ],
         interpret=interpret,
     )(xt_p, rv_p, lo_p, scale_p, mean_p)
+    if kernel == "cumulative":
+        # differencing lives OUTSIDE the pallas program: (cols, nbins)
+        # elementwise work per dispatch, fused by XLA into the epilogue
+        from tpuprof.kernels.histogram import counts_from_cumulative
+        counts = counts_from_cumulative(counts)
     return counts[:cols], dev[:cols, 0]
 
 
 def histogram_batch(xt, row_valid, lo, hi, mean, nbins: int,
-                    interpret: bool = False):
+                    interpret: bool = False, kernel: str = "legacy"):
     """Batch entry point matching kernels/histogram.update semantics;
     ``xt`` is (cols, rows) as the mesh ships batches."""
     return histogram_tiles(xt, row_valid, lo, hi, mean, nbins,
-                           interpret=interpret)
+                           interpret=interpret, kernel=kernel)

@@ -111,6 +111,16 @@ class MeshRunner:
             self.use_pallas = devs[0].platform == "tpu" and hist_fits
         else:
             self.use_pallas = config.use_pallas and hist_fits
+        # binning formulation for BOTH pass-B tiers (pallas kernel and
+        # XLA fallback): "cumulative" ≥-edge compares (counts
+        # differenced outside the kernel) or "legacy" per-element
+        # indices — bit-for-bin identical, selected for cost only.
+        # getattr: configs unpickled from pre-round-7 artifacts lack
+        # the field and must resolve to the same default a fresh one
+        # would.
+        from tpuprof.config import resolve_pass_b_kernel
+        self.pass_b_kernel = resolve_pass_b_kernel(
+            getattr(config, "pass_b_kernel", None))
         # fused pallas pass A (kernels/fused.py; single-read kernel up to
         # MAX_FUSED_COLS, column-tiled beyond) on real TPU; the
         # per-kernel XLA formulation on CPU meshes and past the tiled
@@ -256,17 +266,25 @@ class MeshRunner:
             return _restack(out)
 
         use_pallas = self.use_pallas
+        pass_b_kernel = self.pass_b_kernel
 
         def step_b_core(s, xt, row_valid, lo, hi, mean):
             """One batch folded into an UNSTACKED per-device pass-B state —
             shared by the single-batch program and the multi-batch
-            lax.scan program (same latency-amortization as scan_a)."""
+            lax.scan program (same latency-amortization as scan_a).
+            Formulation per ``pass_b_kernel``; both fold per-bin counts
+            into the same HistState, so everything downstream (merge,
+            checkpoint, finalize) is formulation-blind."""
             if use_pallas:
                 from tpuprof.kernels import pallas_hist
                 counts, abs_dev = pallas_hist.histogram_batch(
-                    xt, row_valid, lo, hi, mean, s["counts"].shape[1])
+                    xt, row_valid, lo, hi, mean, s["counts"].shape[1],
+                    kernel=pass_b_kernel)
                 return {"counts": s["counts"] + counts,
                         "abs_dev": s["abs_dev"] + abs_dev}
+            if pass_b_kernel == "cumulative":
+                return histogram.update_cumulative(s, xt.T, row_valid,
+                                                   lo, hi, mean)
             return histogram.update(s, xt.T, row_valid, lo, hi, mean)
 
         def local_step_b(state, xt, row_valid, lo, hi, mean):
@@ -461,7 +479,8 @@ class MeshRunner:
             self._step_b(state, db.xt, db.row_valid,
                          self.put_replicated(lo, dtype=jnp.float32),
                          self.put_replicated(hi, dtype=jnp.float32),
-                         self.put_replicated(mean, dtype=jnp.float32)))
+                         self.put_replicated(mean, dtype=jnp.float32)),
+            kernel=self.pass_b_kernel)
 
     def scan_b(self, state: Pytree, sb: "StackedBatch", lo, hi,
                mean) -> Pytree:
@@ -474,7 +493,7 @@ class MeshRunner:
                          self.put_replicated(lo, dtype=jnp.float32),
                          self.put_replicated(hi, dtype=jnp.float32),
                          self.put_replicated(mean, dtype=jnp.float32)),
-            batches=sb.n_batches)
+            batches=sb.n_batches, kernel=self.pass_b_kernel)
 
     def init_spearman(self) -> Pytree:
         def one_device(_):
