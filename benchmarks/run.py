@@ -385,6 +385,120 @@ def run_prepare(scale: float, workdir: str) -> dict:
     return out
 
 
+def measure_guardrail(rows: int = 1 << 17, repeats: int = 3) -> dict:
+    """Clean-path cost of the fault-tolerance plumbing (ISSUE 4
+    acceptance: <1%): the same serial prepare loop timed (a) calling
+    ``prepare_batch`` directly and (b) through the production
+    ``BatchGuard.run`` wrapper (retry policy + fault hook — what every
+    batch now pays), plus the v5 checkpoint CRC's share of a save.
+    ``guardrail_overhead_pct`` is the prepare-loop delta; per-batch
+    plumbing is nanoseconds against ~10ms of decode, so anything
+    persistently >1% is a regression in the guard itself."""
+    import pickle
+    import time as _time
+    import zlib
+
+    import pyarrow as pa
+
+    from benchmarks import scenarios
+    from tpuprof.ingest.arrow import ArrowIngest, prepare_batch
+    from tpuprof.runtime import guard
+
+    rng = np.random.default_rng(0)
+    batch_rows = min(1 << 16, rows)
+    df = scenarios.mixed23_batch(rng, rows)
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    ing = ArrowIngest(table, batch_rows=batch_rows)
+    rbs = [rb for _, _, rb in ing.raw_batches_positioned()]
+    bg = guard.BatchGuard(retries=2, backoff_s=0.05, capture=False)
+
+    def body(guarded: bool) -> None:
+        for k, rb in enumerate(rbs):
+            if guarded:
+                bg.run(lambda rb=rb: prepare_batch(
+                    rb, ing.plan, batch_rows, 11,
+                    dict_cache=ing._dict_cache,
+                    col_stats=ing._col_stats, decode_threads=1),
+                    site="prep", key=k, rows=rb.num_rows)
+            else:
+                prepare_batch(rb, ing.plan, batch_rows, 11,
+                              dict_cache=ing._dict_cache,
+                              col_stats=ing._col_stats,
+                              decode_threads=1)
+
+    # warm both modes over the same converged caches, then interleave
+    # the timed passes so cache/CPU weather hits both sides equally.
+    # The A/B delta is a SANITY figure only — at smoke scale it sits
+    # inside this box's ±3% noise band, far above the true wrapper
+    # cost, so the acceptance number comes from the isolated
+    # microbench below instead.
+    body(False)
+    body(True)
+    best = {False: float("inf"), True: float("inf")}
+    for _ in range(repeats):
+        for mode in (False, True):
+            t0 = _time.perf_counter()
+            body(mode)
+            best[mode] = min(best[mode], _time.perf_counter() - t0)
+    direct = rows / best[False]
+    guarded = rows / best[True]
+    ab_delta_pct = (direct - guarded) / direct * 100.0
+
+    # the actual plumbing cost, measured where it is measurable: the
+    # per-call price of BatchGuard.run around a no-op (lambda + fault
+    # hook + try/except), against the per-batch prepare time it wraps
+    def _noop():
+        return None
+
+    reps = 20000
+    t0 = _time.perf_counter()
+    for k in range(reps):
+        bg.run(_noop, site="prep", key=k)
+    guarded_call_s = (_time.perf_counter() - t0) / reps
+    t0 = _time.perf_counter()
+    for _ in range(reps):
+        _noop()
+    direct_call_s = (_time.perf_counter() - t0) / reps
+    wrapper_s = max(guarded_call_s - direct_call_s, 0.0)
+    prep_batch_s = best[False] / max(len(rbs), 1)
+    overhead_pct = wrapper_s / prep_batch_s * 100.0
+
+    # CRC share of a checkpoint save: the only new per-save byte work
+    payload = pickle.dumps({"arrays": np.zeros(1 << 20, np.float32)},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    t0 = _time.perf_counter()
+    for _ in range(5):
+        zlib.crc32(payload)
+    crc_gbps = 5 * len(payload) / (_time.perf_counter() - t0) / 1e9
+
+    # watchdog: the unwatched path is a direct call (free); the watched
+    # path spawns one thread per DRAIN, not per batch — report its
+    # per-call cost so the tradeoff stays written down
+    t0 = _time.perf_counter()
+    for _ in range(50):
+        guard.watched(lambda: None, 5.0, site="bench")
+    watched_us = (_time.perf_counter() - t0) / 50 * 1e6
+
+    return {
+        "rows": rows, "cols": table.num_columns,
+        "rows_per_sec": round(guarded, 1),      # generic delta column
+        "guarded_rows_per_sec": round(guarded, 1),
+        "direct_rows_per_sec": round(direct, 1),
+        "ab_delta_pct": round(ab_delta_pct, 3),
+        "guard_wrapper_us_per_batch": round(wrapper_s * 1e6, 3),
+        "guardrail_overhead_pct": round(overhead_pct, 4),
+        "checkpoint_crc_gbps": round(crc_gbps, 2),
+        "watchdog_watched_call_us": round(watched_us, 1),
+    }
+
+
+def run_faults(scale: float, workdir: str) -> dict:
+    rows = max(int(20_000_000 * scale), 100_000)
+    out = measure_guardrail(rows)
+    out["scenario"] = "faults"
+    return out
+
+
 def run_passb(scale: float, workdir: str) -> dict:
     """Pass-B dispatch microbenchmark (ISSUE 3): the histogram+MAD fold
     alone, A/B'd across the two binning formulations on the current
@@ -445,7 +559,7 @@ def run_passb(scale: float, workdir: str) -> dict:
 
 
 REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
-                        "hostfed", "prepare", "passb")
+                        "hostfed", "prepare", "passb", "faults")
 
 
 def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
@@ -486,7 +600,8 @@ def _print_deltas(results, label, baseline) -> None:
     print(f"\ndeltas vs {label} (|Δ| ≥ 25% flagged; this box's CPU "
           "weather band is ±10-20% — PERF.md round 5):")
     keymap = {"passb": "pass_b_rows_per_sec",
-              "prepare": "prepare_rows_per_sec"}
+              "prepare": "prepare_rows_per_sec",
+              "faults": "guarded_rows_per_sec"}
     for r in results:
         name = r.get("scenario")
         prev = baseline.get(name)
@@ -589,8 +704,8 @@ def main() -> None:
     parser.add_argument("scenario", choices=["taxi", "tpch", "criteo",
                                              "wide1b", "streaming",
                                              "hostfed", "prepare",
-                                             "passb", "regression",
-                                             "all"])
+                                             "passb", "faults",
+                                             "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
     parser.add_argument("--backend", default="tpu")
@@ -625,7 +740,7 @@ def main() -> None:
         pass                      # older jaxlibs: warm == cold, still valid
 
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
-              "prepare", "passb"]
+              "prepare", "passb", "faults"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -640,6 +755,8 @@ def main() -> None:
             result = run_prepare(args.scale, args.workdir)
         elif name == "passb":
             result = run_passb(args.scale, args.workdir)
+        elif name == "faults":
+            result = run_faults(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

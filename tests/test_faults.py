@@ -1,0 +1,524 @@
+"""Deterministic fault-injection suite (ROBUSTNESS.md): every recovery
+rung — transient retry, poison-batch quarantine, checkpoint integrity +
+last-good fallback, watchdog deadlines — driven by the seeded harness in
+tpuprof/testing/faults.py.  Everything here is CPU-only and fast."""
+
+import os
+import pickle
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfilerConfig
+from tpuprof.errors import (CorruptCheckpointError, PoisonBatchError,
+                            TransientError, WatchdogTimeout)
+from tpuprof.obs import metrics as obs_metrics
+from tpuprof.runtime import checkpoint as ckpt
+from tpuprof.runtime import guard
+from tpuprof.testing import faults
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    """No plan leaks between tests; metrics counters start from zero."""
+    faults.reset()
+    obs_metrics.registry().reset()
+    was = obs_metrics.enabled()
+    yield
+    obs_metrics.set_enabled(was)
+    obs_metrics.registry().reset()
+    faults.reset()
+
+
+def _tiny_state():
+    return {"mom": np.arange(6, dtype=np.float32),
+            "hll": np.zeros((2, 8), dtype=np.uint8)}
+
+
+def _save(path, cursor=1, keep=1, blob=None):
+    ckpt.save(str(path), _tiny_state(),
+              blob if blob is not None else {"tag": cursor},
+              cursor, meta={"v": 1}, keep=keep)
+
+
+def _micro_frames(n_batches=100, rows=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return [pd.DataFrame({
+        "a": rng.normal(5.0, 2.0, rows),
+        "c": rng.choice(["x", "y", "z"], rows),
+    }) for _ in range(n_batches)]
+
+
+def _stream_cfg(**kw):
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("batch_rows", 256)
+    kw.setdefault("stream_flush_rows", 256)
+    return ProfilerConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# pillar 1: checkpoint integrity + last-good fallback
+# ---------------------------------------------------------------------------
+
+class TestCheckpointIntegrity:
+
+    def test_roundtrip_and_header_fields(self, tmp_path):
+        path = tmp_path / "c.ckpt"
+        _save(path, cursor=7)
+        with open(path, "rb") as fh:
+            header = pickle.load(fh)
+        assert header["format_version"] == ckpt.FORMAT_VERSION
+        assert {"payload_crc32", "payload_len"} <= set(header)
+        payload = ckpt.load_payload(str(path))
+        assert payload["cursor"] == 7
+        state = ckpt.materialize(payload, _tiny_state())
+        np.testing.assert_array_equal(state["mom"],
+                                      _tiny_state()["mom"])
+
+    def test_truncate_at_every_offset_is_typed(self, tmp_path):
+        """The acceptance sweep: a checkpoint truncated at ANY byte
+        offset must surface as CorruptCheckpointError — never a raw
+        pickle/zip/EOF error, never silently-wrong state."""
+        path = tmp_path / "c.ckpt"
+        _save(path, cursor=3)
+        blob = open(path, "rb").read()
+        trunc = tmp_path / "t.ckpt"
+        for cut in range(len(blob)):
+            with open(trunc, "wb") as fh:
+                fh.write(blob[:cut])
+            with pytest.raises(CorruptCheckpointError):
+                ckpt.load_payload(str(trunc))
+
+    def test_garbage_and_flipped_bytes_are_typed(self, tmp_path):
+        bad = tmp_path / "g.ckpt"
+        bad.write_bytes(b"\x93NUMPYjunk" * 64)
+        with pytest.raises(CorruptCheckpointError):
+            ckpt.load_payload(str(bad))
+        # single flipped payload byte: CRC catches what pickle may not
+        path = tmp_path / "c.ckpt"
+        _save(path)
+        blob = bytearray(open(path, "rb").read())
+        blob[-10] ^= 0xFF
+        bad.write_bytes(bytes(blob))
+        with pytest.raises(CorruptCheckpointError, match="CRC"):
+            ckpt.load_payload(str(bad))
+
+    def test_rotation_keeps_generations(self, tmp_path):
+        path = tmp_path / "c.ckpt"
+        _save(path, cursor=1, keep=2)
+        _save(path, cursor=2, keep=2)
+        _save(path, cursor=3, keep=2)
+        assert ckpt.load_payload(str(path))["cursor"] == 3
+        assert ckpt.load_payload(str(path) + ".1")["cursor"] == 2
+        assert not os.path.exists(str(path) + ".2")    # keep=2 bound
+        ckpt.clear(str(path))
+        assert not os.path.exists(path)
+        assert not os.path.exists(str(path) + ".1")
+
+    def test_corrupt_head_falls_back_to_last_good(self, tmp_path):
+        obs_metrics.set_enabled(True)
+        path = tmp_path / "c.ckpt"
+        _save(path, cursor=1, keep=2)
+        _save(path, cursor=2, keep=2)
+        # tear the head at an arbitrary offset; the walk must land on
+        # the rotated generation and say so in the fallback counter
+        blob = open(path, "rb").read()
+        open(path, "wb").write(blob[: len(blob) // 3])
+        payload, state, used = ckpt.restore_payload(
+            str(path), _tiny_state())
+        assert payload["cursor"] == 1
+        assert used == str(path) + ".1"
+        assert state is not None
+        fb = obs_metrics.registry().counter(
+            "tpuprof_checkpoint_fallbacks_total").total()
+        assert fb == 1
+
+    def test_missing_head_falls_back_to_rotation(self, tmp_path):
+        path = tmp_path / "c.ckpt"
+        _save(path, cursor=1, keep=2)
+        _save(path, cursor=2, keep=2)
+        os.remove(path)                 # head gone, rotation survives
+        payload, _, used = ckpt.restore_payload(str(path))
+        assert payload["cursor"] == 1 and used.endswith(".1")
+
+    def test_fully_corrupt_chain_raises_typed(self, tmp_path):
+        path = tmp_path / "c.ckpt"
+        _save(path, cursor=1, keep=2)
+        _save(path, cursor=2, keep=2)
+        for p in (str(path), str(path) + ".1"):
+            open(p, "wb").write(b"junk")
+        with pytest.raises(CorruptCheckpointError, match="2 generation"):
+            ckpt.restore_payload(str(path))
+
+    def test_raising_save_leaves_no_tmp(self, tmp_path):
+        """Satellite bugfix: a save that raises mid-write must unlink
+        path.tmp (and never publish a head)."""
+        faults.configure("checkpoint_write:fatal@1")
+        path = tmp_path / "c.ckpt"
+        with pytest.raises(RuntimeError, match="injected fatal"):
+            _save(path)
+        assert not os.path.exists(path)
+        assert not os.path.exists(str(path) + ".tmp")
+        # the next (clean) save works on the same path
+        faults.reset()
+        _save(path, cursor=9)
+        assert ckpt.load_payload(str(path))["cursor"] == 9
+
+    def test_torn_write_detected_then_falls_back(self, tmp_path):
+        """A truncate-injected write survives the rename but fails CRC;
+        restore walks back to the previous generation."""
+        path = tmp_path / "c.ckpt"
+        _save(path, cursor=1, keep=2)
+        faults.configure("checkpoint_write:truncate@1")
+        _save(path, cursor=2, keep=2)          # torn head, rotated good
+        assert faults.injected("checkpoint_write") == 1
+        with pytest.raises(CorruptCheckpointError):
+            ckpt.load_payload(str(path))
+        payload, _, used = ckpt.restore_payload(str(path))
+        assert payload["cursor"] == 1 and used.endswith(".1")
+
+
+# ---------------------------------------------------------------------------
+# pillar 2: retry + poison-batch quarantine (streaming runtime)
+# ---------------------------------------------------------------------------
+
+class TestQuarantine:
+
+    def _run_stream(self, cfg, frames):
+        from tpuprof.runtime.stream import StreamingProfiler
+        prof = StreamingProfiler.for_example(frames[0], config=cfg)
+        for f in frames:
+            prof.update(f)
+        return prof, prof.stats()
+
+    def test_seeded_prep_faults_quarantine_exactly(self):
+        """Acceptance: p=0.05 seeded transient prep faults, quarantine
+        on, retries off — the run completes, and manifest + metric +
+        degraded banner all equal the injected count exactly."""
+        obs_metrics.set_enabled(True)
+        faults.configure("prep:0.05", seed=123)
+        frames = _micro_frames(100)
+        cfg = _stream_cfg(max_quarantined=100, ingest_retries=0,
+                          metrics_enabled=True)
+        prof, stats = self._run_stream(cfg, frames)
+        injected = faults.injected("prep")
+        assert injected > 0                      # seed chosen to fire
+        manifest = stats["_quarantine"]
+        assert len(manifest) == injected
+        assert all(e["site"] == "prep" for e in manifest)
+        assert stats["table"]["n"] == (100 - injected) * 256
+        q = obs_metrics.registry().counter(
+            "tpuprof_batches_quarantined_total").total()
+        assert q == injected
+        html = prof.report_html()
+        assert "Degraded run" in html
+        assert "quarantine-manifest" in html
+        assert f"{len(manifest)} batch(es)" in html
+
+    def test_quarantine_is_deterministic_per_seed(self):
+        """Same faults seed → same skipped-batch set → same stats."""
+        def one_run():
+            faults.configure("prep:0.08", seed=7)
+            frames = _micro_frames(60)
+            cfg = _stream_cfg(max_quarantined=100, ingest_retries=0)
+            prof, stats = self._run_stream(cfg, frames)
+            skipped = tuple(e["cursor"] for e in stats["_quarantine"])
+            keys = {n: {k: v for k, v in stats["variables"][n].items()
+                        if k in ("count", "n_missing", "mean", "std")}
+                    for n in stats["variables"]}
+            faults.reset()
+            return skipped, keys, stats["table"]["n"]
+
+        s1, k1, n1 = one_run()
+        s2, k2, n2 = one_run()
+        assert s1 == s2 and n1 == n2
+        assert k1 == k2
+
+    def test_retry_recovers_every_transient_first_attempt(self):
+        """'prep:transient' fails every batch's FIRST attempt; one
+        retry absorbs all of it — zero quarantined, full row count."""
+        obs_metrics.set_enabled(True)
+        faults.configure("prep:transient")
+        frames = _micro_frames(20)
+        cfg = _stream_cfg(ingest_retries=1, retry_backoff_s=0.0,
+                          metrics_enabled=True)
+        prof, stats = self._run_stream(cfg, frames)
+        assert "_quarantine" not in stats
+        assert stats["table"]["n"] == 20 * 256
+        retries = obs_metrics.registry().counter(
+            "tpuprof_ingest_retries_total").total()
+        assert retries == faults.injected("prep") == 20
+
+    def test_default_config_fails_fast(self):
+        """max_quarantined defaults to 0: a permanently-failing batch
+        still kills the run (the historical contract)."""
+        faults.configure("prep:transient")
+        frames = _micro_frames(4)
+        cfg = _stream_cfg(ingest_retries=0)
+        with pytest.raises(TransientError, match="injected transient"):
+            self._run_stream(cfg, frames)
+
+    def test_budget_exhaustion_raises_poison_with_manifest(self):
+        faults.configure("prep:transient")
+        frames = _micro_frames(10)
+        cfg = _stream_cfg(max_quarantined=2, ingest_retries=0,
+                          retry_backoff_s=0.0)
+        with pytest.raises(PoisonBatchError,
+                           match="max_quarantined=2") as ei:
+            self._run_stream(cfg, frames)
+        assert len(ei.value.manifest) == 3     # the one over budget
+
+    def test_fold_fault_quarantined_without_retry(self):
+        """A raising fold is skipped (never retried — not idempotent)
+        and lands in the manifest under its own site."""
+        faults.configure("fold:1@3")
+        frames = _micro_frames(8)
+        cfg = _stream_cfg(max_quarantined=5)
+        prof, stats = self._run_stream(cfg, frames)
+        manifest = stats["_quarantine"]
+        assert len(manifest) == 1
+        assert manifest[0]["site"] == "fold"
+        assert stats["table"]["n"] == 7 * 256
+
+    def test_quarantine_manifest_survives_checkpoint_restore(self,
+                                                             tmp_path):
+        from tpuprof.runtime.stream import StreamingProfiler
+        faults.configure("prep:transient")
+        frames = _micro_frames(6)
+        cfg = _stream_cfg(max_quarantined=10, ingest_retries=0,
+                          retry_backoff_s=0.0)
+        prof, stats = self._run_stream(cfg, frames)
+        n_skip = len(stats["_quarantine"])
+        assert n_skip == 6                     # every slice poisoned
+        faults.reset()
+        path = str(tmp_path / "s.ckpt")
+        prof.checkpoint(path)
+        restored = StreamingProfiler.restore(path, config=cfg)
+        for f in _micro_frames(3, seed=9):
+            restored.update(f)
+        s2 = restored.stats()
+        assert len(s2["_quarantine"]) == n_skip     # degraded stays said
+        assert "Degraded run" in restored.report_html()
+
+
+class TestCollectQuarantine:
+    """The batch-profile (TPUStatsBackend.collect) side of pillar 2."""
+
+    @pytest.fixture()
+    def parquet_source(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        rng = np.random.default_rng(3)
+        df = pd.DataFrame({
+            "a": rng.normal(7.0, 2.0, 4000),
+            "c": rng.choice(["x", "y", "z"], 4000),
+        })
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                       path)
+        return path
+
+    def test_collect_skips_poison_batches_and_reports(
+            self, parquet_source):
+        from tpuprof.backends.tpu import TPUStatsBackend
+        obs_metrics.set_enabled(True)
+        faults.configure("prep:2@2")
+        # serial prepare pipeline → the N@M window is exact; single-pass
+        # so the quarantined batches are not re-read by pass B
+        cfg = ProfilerConfig(backend="tpu", batch_rows=256,
+                             prepare_workers=1, ingest_retries=0,
+                             max_quarantined=10, exact_passes=False,
+                             metrics_enabled=True)
+        stats = TPUStatsBackend().collect(parquet_source, cfg)
+        manifest = stats["_quarantine"]
+        assert len(manifest) == 2 == faults.injected("prep")
+        assert stats["table"]["n"] == 4000 - 2 * 256
+        from tpuprof.report.render import to_standalone_html
+        html = to_standalone_html(stats, cfg)
+        assert "Degraded run" in html
+
+    def test_collect_default_still_fails_fast(self, parquet_source):
+        from tpuprof.backends.tpu import TPUStatsBackend
+        faults.configure("prep:transient")
+        cfg = ProfilerConfig(backend="tpu", batch_rows=256,
+                             prepare_workers=1, ingest_retries=0,
+                             exact_passes=False)
+        with pytest.raises(TransientError):
+            TPUStatsBackend().collect(parquet_source, cfg)
+
+
+# ---------------------------------------------------------------------------
+# pillar 3: watchdogs
+# ---------------------------------------------------------------------------
+
+class TestWatchdogs:
+
+    def test_watched_passthrough_and_timeout(self):
+        assert guard.watched(lambda: 42, None, site="x") == 42
+        assert guard.watched(lambda: 42, 5.0, site="x") == 42
+        import time
+        with pytest.raises(WatchdogTimeout) as ei:
+            guard.watched(lambda: time.sleep(2.0), 0.1, site="slow",
+                          heartbeat=lambda: {"alive": 1})
+        assert ei.value.site == "slow"
+        assert ei.value.heartbeat == {"alive": 1}
+
+    def test_watched_propagates_body_errors(self):
+        def boom():
+            raise KeyError("inner")
+        with pytest.raises(KeyError, match="inner"):
+            guard.watched(boom, 5.0, site="x")
+
+    def test_stream_drain_watchdog_fires_with_heartbeat(self):
+        from tpuprof.runtime.stream import StreamingProfiler
+        faults.configure("device_wait:sleep=2")
+        frames = _micro_frames(2)
+        cfg = _stream_cfg(drain_timeout_s=0.15)
+        prof = StreamingProfiler.for_example(frames[0], config=cfg)
+        with pytest.raises(WatchdogTimeout) as ei:
+            for f in frames:
+                prof.update(f)
+        assert ei.value.site == "device_drain"
+        assert ei.value.heartbeat is not None
+        assert "rows_folded" in ei.value.heartbeat
+
+    def test_barrier_watchdog_fires(self):
+        from tpuprof.runtime.distributed import allgather_with_watchdog
+        faults.configure("barrier:sleep=2")
+        with pytest.raises(WatchdogTimeout) as ei:
+            allgather_with_watchdog("hello", 0.1, site="resume_barrier",
+                                    heartbeat=lambda: {"rank": 0})
+        assert ei.value.site == "resume_barrier"
+        assert ei.value.heartbeat == {"rank": 0}
+        mqd = obs_metrics.registry()     # metric declared either way
+        faults.reset()
+        # without faults (and single process) the barrier is instant
+        assert allgather_with_watchdog("hello", 1.0) == ["hello"]
+
+
+# ---------------------------------------------------------------------------
+# the harness itself + CLI error mapping
+# ---------------------------------------------------------------------------
+
+class TestHarness:
+
+    def test_spec_parse_rejects_malformed(self):
+        for bad in ("prep", "prep:maybe", "prep:1.5", "prep:0@1"):
+            with pytest.raises(ValueError):
+                faults.FaultPlan.from_spec(bad)
+
+    def test_env_activation(self, monkeypatch):
+        monkeypatch.setenv("TPUPROF_FAULTS", "prep:transient")
+        monkeypatch.setenv("TPUPROF_FAULTS_SEED", "11")
+        plan = faults.configure()
+        assert plan is not None and plan.seed == 11
+        with pytest.raises(TransientError):
+            plan.fire("prep", key=0)
+        assert plan.injected("prep") == 1
+
+    def test_keyed_probability_is_thread_order_free(self):
+        plan = faults.FaultPlan.from_spec("prep:0.3", seed=5)
+        fired = set()
+        for key in range(50):
+            try:
+                plan.fire("prep", key=key)
+            except TransientError:
+                fired.add(key)
+        plan2 = faults.FaultPlan.from_spec("prep:0.3", seed=5)
+        fired2 = set()
+        for key in reversed(range(50)):      # reversed arrival order
+            try:
+                plan2.fire("prep", key=key)
+            except TransientError:
+                fired2.add(key)
+        assert fired == fired2 and fired
+
+    def test_cli_maps_corrupt_checkpoint_to_exit_3(self, tmp_path,
+                                                   capsys):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from tpuprof.cli import main
+        rng = np.random.default_rng(0)
+        src = str(tmp_path / "d.parquet")
+        pq.write_table(pa.Table.from_pandas(
+            pd.DataFrame({"a": rng.normal(size=600)}),
+            preserve_index=False), src)
+        ck = tmp_path / "scan.ckpt"
+        ck.write_bytes(b"definitely not a checkpoint")
+        rc = main(["profile", src, "-o", str(tmp_path / "r.html"),
+                   "--backend", "tpu", "--batch-rows", "256",
+                   "--checkpoint", str(ck), "--no-compile-cache"])
+        assert rc == 3
+        err = capsys.readouterr().err
+        assert "tpuprof: error:" in err and "checkpoint" in err
+
+    def test_cli_maps_watchdog_timeout_to_exit_4(self, tmp_path,
+                                                 capsys):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from tpuprof.cli import main
+        rng = np.random.default_rng(0)
+        src = str(tmp_path / "d.parquet")
+        pq.write_table(pa.Table.from_pandas(
+            pd.DataFrame({"a": rng.normal(size=600)}),
+            preserve_index=False), src)
+        faults.configure("device_wait:sleep=2")
+        rc = main(["profile", src, "-o", str(tmp_path / "r.html"),
+                   "--backend", "tpu", "--batch-rows", "256",
+                   "--drain-timeout", "0.1", "--single-pass",
+                   "--no-compile-cache"])
+        assert rc == 4
+        assert "watchdog" in capsys.readouterr().err
+
+
+class TestTickerAndClose:
+    """Satellite bugfix: obs ticker stop flagging + idempotent close."""
+
+    def test_ticker_stop_flags_undead_thread_and_mutes_it(self):
+        import io
+        import threading
+        import time
+
+        from tpuprof.obs.progress import Ticker
+        release = threading.Event()
+        entered = threading.Event()
+        t = Ticker(0.05, progress=True, stream=io.StringIO())
+
+        def stuck_tick():
+            entered.set()
+            release.wait(10.0)          # a tick wedged in a slow write
+
+        t._tick = stuck_tick
+        t.start()
+        assert entered.wait(5.0)
+        t.stop()                        # join(2.0) expires
+        assert t.stop_timed_out is True
+        release.set()
+
+    def test_ticker_tick_after_stop_is_noop(self):
+        import io
+        from tpuprof.obs.progress import Ticker
+        out = io.StringIO()
+        t = Ticker(60.0, progress=True, stream=out)
+        t.start()
+        t.stop()
+        assert t.stop_timed_out is False
+        t._tick()                       # orphan tick: guard returns
+        assert out.getvalue() == ""
+
+    def test_streaming_close_idempotent_after_raising_drain(self):
+        from tpuprof.runtime.stream import StreamingProfiler
+        frames = _micro_frames(2)
+        prof = StreamingProfiler.for_example(frames[0],
+                                             config=_stream_cfg())
+        faults.configure("fold:1@1")    # default budget 0 → drain raises
+        with pytest.raises(TransientError):
+            for f in frames:
+                prof.update(f)
+        faults.reset()
+        prof.close()
+        prof.close()                    # second close: no-op, no raise
+        assert prof._closed is True

@@ -366,13 +366,17 @@ def test_two_process_crash_resume_matches_uninterrupted(tmp_path):
         assert p.returncode != 0, out.decode()[-2000:]
     assert os.path.exists(f"{ckpt}.h0of2"), "host-0 artifact missing"
 
-    # phase 2: a MIXED fleet — host 1's artifact is CORRUPT (torn write
-    # at power loss); its load failure must fall back to a fresh stripe
-    # scan instead of exiting while peers block in the resume barrier,
-    # and the collective sequence must stay aligned (a restored host
-    # still participates in the shift agreement)
-    with open(f"{ckpt}.h1of2", "wb") as fh:
-        fh.write(b"\x00garbage artifact\x00" * 8)
+    # phase 2: a MIXED fleet — host 1's artifact CHAIN is corrupt (torn
+    # writes at power loss; the rotated .1 generation too, else the
+    # restore walk-back would legitimately resume from it — ROBUSTNESS
+    # pillar 1); the whole-chain load failure must fall back to a fresh
+    # stripe scan instead of exiting while peers block in the resume
+    # barrier, and the collective sequence must stay aligned (a
+    # restored host still participates in the shift agreement)
+    import glob as _glob
+    for art in _glob.glob(f"{ckpt}.h1of2*"):
+        with open(art, "wb") as fh:
+            fh.write(b"\x00garbage artifact\x00" * 8)
     logs = []
     for p in launch(crash_at=0):
         out, _ = p.communicate(timeout=420)

@@ -34,6 +34,24 @@ EVENT_SCHEMA = {
                            "seconds": ((int, float), True)},
     "heartbeat": {"ts": ((int, float), True),
                   "rows_folded": ((int,), True)},
+    # fault-tolerance events (ROBUSTNESS.md) — never emitted on a clean
+    # run, but part of the documented sink contract
+    "ingest_retry": {"ts": ((int, float), True), "site": ((str,), True),
+                     "attempt": ((int,), True), "error": ((str,), True)},
+    "batch_quarantined": {"ts": ((int, float), True),
+                          "site": ((str,), True),
+                          "error": ((str,), True)},
+    "checkpoint_fallback": {"ts": ((int, float), True),
+                            "path": ((str,), True),
+                            "error": ((str,), True)},
+    "checkpoint_fallback_used": {"ts": ((int, float), True),
+                                 "path": ((str,), True),
+                                 "cursor": ((int,), True)},
+    "watchdog_timeout": {"ts": ((int, float), True),
+                         "site": ((str,), True),
+                         "timeout_s": ((int, float), True)},
+    "ticker_stop_timeout": {"ts": ((int, float), True),
+                            "interval": ((int, float), True)},
 }
 
 

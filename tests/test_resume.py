@@ -110,16 +110,22 @@ def test_pre_upgrade_checkpoint_without_new_meta_keys_resumes(
         TPUStatsBackend().collect(parquet_source, cfg)
     monkeypatch.setattr(HostAgg, "update", real_update)
 
+    from tpuprof.runtime import checkpoint as ckpt
+
     path = tmp_path / "scan.ckpt"
     with open(path, "rb") as fh:
-        header = pickle.load(fh)
-        payload = pickle.load(fh)
+        pickle.load(fh)                  # v5 integrity header
+        payload = pickle.load(fh)        # payload bytes ARE a pickle
     for key in ("process_id", "process_count", "exact_distinct"):
         assert key in payload["meta"]
         del payload["meta"][key]
+    # rewrite as a VALID artifact (the v5 header carries the payload
+    # CRC, so an edited payload needs a restamped header)
+    payload_bytes = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     with open(path, "wb") as fh:
-        pickle.dump(header, fh, protocol=pickle.HIGHEST_PROTOCOL)
-        pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
+        pickle.dump(ckpt.payload_header(payload_bytes), fh,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+        fh.write(payload_bytes)
 
     control = TPUStatsBackend().collect(
         parquet_source, ProfilerConfig(backend="tpu", batch_rows=256))
@@ -450,9 +456,9 @@ def test_parallel_prep_never_reorders_checkpoint_cursors(
     cursors = []
     real_save = ckpt.save
 
-    def tracking_save(path, state, host_blob, cursor, meta):
+    def tracking_save(path, state, host_blob, cursor, meta, **kw):
         cursors.append(cursor)
-        return real_save(path, state, host_blob, cursor, meta)
+        return real_save(path, state, host_blob, cursor, meta, **kw)
 
     monkeypatch.setattr(ckpt, "save", tracking_save)
     cfg = _cfg(tmp_path)        # 256-row batches, checkpoint every 3
@@ -464,6 +470,48 @@ def test_parallel_prep_never_reorders_checkpoint_cursors(
     # and the final save covers the whole 16-batch stream
     assert all(c % 3 == 0 for c in cursors[:-1])
     assert cursors[-1] == 16
+
+
+def test_kill_restore_report_byte_identical(tmp_path):
+    """Resume-after-kill (ROBUSTNESS.md acceptance): checkpoint a
+    stream, drop ALL process state (the SIGKILL simulation — nothing
+    survives but the artifact on disk), restore, replay the remaining
+    batches, and the final report HTML must be BYTE-identical to an
+    uninterrupted run's."""
+    import gc
+
+    from tpuprof.runtime.stream import StreamingProfiler
+
+    rng = np.random.default_rng(21)
+    frames = [pd.DataFrame({
+        "a": rng.normal(3.0, 1.5, 250),
+        "b": rng.exponential(2.0, 250),
+        "c": rng.choice(["p", "q", "r"], 250),
+    }) for _ in range(12)]
+    cfg = dict(backend="tpu", batch_rows=256, stream_flush_rows=256,
+               seed=5)
+
+    control = StreamingProfiler.for_example(
+        frames[0], config=ProfilerConfig(**cfg))
+    for f in frames:
+        control.update(f)
+    html_control = control.report_html()
+
+    path = str(tmp_path / "stream.ckpt")
+    prof = StreamingProfiler.for_example(
+        frames[0], config=ProfilerConfig(**cfg))
+    for f in frames[:7]:
+        prof.update(f)
+    prof.checkpoint(path)       # force-drains: artifact covers 7 frames
+    del prof                    # SIGKILL simulation: drop process state
+    gc.collect()
+
+    restored = StreamingProfiler.restore(path,
+                                         config=ProfilerConfig(**cfg))
+    for f in frames[7:]:
+        restored.update(f)
+    html_resumed = restored.report_html()
+    assert html_resumed == html_control    # byte-for-byte
 
 
 def test_crash_resume_with_parallel_prep_matches_uninterrupted(

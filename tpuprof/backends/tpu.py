@@ -267,6 +267,7 @@ class _CollectCheckpoint:
 
     def __init__(self, config: ProfilerConfig, plan, runner, pshard,
                  source_fp: str, table_source: bool = False):
+        from tpuprof.config import resolve_checkpoint_keep
         self.pshard = pshard
         self.table_source = bool(table_source)
         path = config.checkpoint_path
@@ -274,6 +275,7 @@ class _CollectCheckpoint:
             path = f"{path}.h{pshard[0]}of{pshard[1]}"
         self.path = path
         self.every = max(int(config.checkpoint_every_batches), 1)
+        self.keep = resolve_checkpoint_keep(config.checkpoint_keep)
         self.config = config
         self.plan = plan
         self.runner = runner
@@ -282,7 +284,9 @@ class _CollectCheckpoint:
 
     def exists(self) -> bool:
         import os
-        return os.path.exists(self.path)
+        from tpuprof.runtime import checkpoint as ckpt
+        return any(os.path.exists(p)
+                   for p in ckpt.candidate_paths(self.path))
 
     def due(self, cursor: int) -> bool:
         return cursor % self.every == 0
@@ -313,7 +317,7 @@ class _CollectCheckpoint:
                 "nested": self.config.nested}
 
     def save(self, state, sampler, hostagg, host_hll, cursor,
-             frag_pos=None) -> None:
+             frag_pos=None, quarantine=None) -> None:
         from tpuprof.runtime import checkpoint as ckpt
         # this artifact will reference the tracker's spill runs by path:
         # from now on a crash must leave them on disk for resume (GC
@@ -321,10 +325,14 @@ class _CollectCheckpoint:
         # the FIRST save, __del__ may still reap them: nothing
         # references the files yet
         hostagg.unique.persistent = True
-        ckpt.save(self.path, state,
-                  {"sampler": sampler, "hostagg": hostagg,
-                   "host_hll": host_hll, "frag_pos": frag_pos},
-                  cursor, meta=self._meta())
+        blob = {"sampler": sampler, "hostagg": hostagg,
+                "host_hll": host_hll, "frag_pos": frag_pos}
+        if quarantine is not None and quarantine.entries:
+            # only degraded runs carry the key: clean-run payloads stay
+            # byte-identical to the pre-quarantine layout
+            blob["quarantine"] = list(quarantine.entries)
+        ckpt.save(self.path, state, blob, cursor, meta=self._meta(),
+                  keep=self.keep)
         # the new artifact no longer references runs demoted since the
         # previous save — only now is their physical deletion safe
         hostagg.unique.reap_retired()
@@ -333,13 +341,19 @@ class _CollectCheckpoint:
                   frag_pos=frag_pos)
 
     def load(self):
-        """(state, sampler, hostagg, host_hll, cursor, frag_pos) from the
-        artifact, after refusing any config/source divergence from the
-        saved prefix.  ``frag_pos`` is the (fragment, batch) position of
-        the last folded batch — resume skips whole fragments' I/O when
-        it is present."""
+        """(state, sampler, hostagg, host_hll, cursor, frag_pos,
+        quarantine_entries) from the newest INTEGRAL artifact in the
+        retention chain (a corrupt head falls back to ``path.N`` —
+        checkpoint.restore_payload), after refusing any config/source
+        divergence from the saved prefix.  ``frag_pos`` is the
+        (fragment, batch) position of the last folded batch — resume
+        skips whole fragments' I/O when it is present."""
         from tpuprof.runtime import checkpoint as ckpt
-        payload = ckpt.load_payload(self.path)
+        # integrity walk first (CRC/version/length — template-free so a
+        # config mismatch below still speaks the meta-key language, not
+        # a shape error); the CRC already guarantees the device-state
+        # archive decodes
+        payload, _, used = ckpt.restore_payload(self.path)
         meta = payload["meta"]
         mine = self._meta()
         # keys added after an artifact was written are absent from its
@@ -361,18 +375,14 @@ class _CollectCheckpoint:
         state = ckpt.materialize(payload, self.runner.init_pass_a())
         blob = payload["host_blob"]
         self.last_saved = payload["cursor"]
-        log_event("collect_resume", cursor=payload["cursor"],
-                  path=self.path)
+        log_event("collect_resume", cursor=payload["cursor"], path=used)
         return (state, blob["sampler"], blob["hostagg"],
                 blob["host_hll"], payload["cursor"],
-                blob.get("frag_pos"))
+                blob.get("frag_pos"), blob.get("quarantine") or [])
 
     def clear(self) -> None:
-        import os
-        try:
-            os.remove(self.path)
-        except OSError:
-            pass
+        from tpuprof.runtime import checkpoint as ckpt
+        ckpt.clear(self.path)
 
 
 _UNSET = object()
@@ -508,6 +518,25 @@ class TPUStatsBackend:
             allgather_objects(native.available()))
         host_hll = khll.HostRegisters(plan.n_hash, config.hll_precision) \
             if use_host_hll else None
+        # ---- fault-tolerance rungs (ROBUSTNESS.md): transient prep
+        # retries always; poison-batch quarantine when budgeted; watchdog
+        # deadlines on the blocking legs when configured.  All default
+        # to the historical fail-fast behavior.
+        from tpuprof.config import (resolve_ingest_retries,
+                                    resolve_max_quarantined,
+                                    resolve_watchdog_timeout)
+        from tpuprof.runtime import guard as _guard
+        from tpuprof.testing import faults as _faults
+        quarantine = _guard.Quarantine(
+            resolve_max_quarantined(config.max_quarantined),
+            log_path=config.quarantine_log)
+        batch_guard = _guard.BatchGuard(
+            resolve_ingest_retries(config.ingest_retries),
+            config.retry_backoff_s, capture=quarantine.enabled)
+        drain_timeout = resolve_watchdog_timeout(
+            config.drain_timeout_s, "TPUPROF_DRAIN_TIMEOUT_S")
+        barrier_timeout = resolve_watchdog_timeout(
+            config.barrier_timeout_s, "TPUPROF_BARRIER_TIMEOUT_S")
         # ---- batch-granular resumability (SURVEY §5 checkpoint/resume):
         # the pass-A scan persists (device state, host sketches, batch
         # cursor) every N batches; a crashed profile resumes by skipping
@@ -523,7 +552,10 @@ class TPUStatsBackend:
         if restored:
             try:
                 (state, sampler, hostagg, host_hll, skip,
-                 resume_frag) = resume.load()
+                 resume_frag, prior_q) = resume.load()
+                # a degraded prefix stays degraded: the restored
+                # manifest keeps riding checkpoints and the final report
+                quarantine.seed(prior_q)
                 # the artifact references the tracker's spill runs;
                 # assert crash protection on the resumed object too
                 # (artifacts pickled before the flag existed restore
@@ -547,6 +579,7 @@ class TPUStatsBackend:
                     pshard[0], resume.path, exc)
                 restored = False
                 state, skip, resume_frag = None, 0, None
+                quarantine.seed([])
                 hostagg = HostAgg(plan, config)
                 sampler = RowSampler(config.quantile_sketch_size,
                                      plan.n_num, seed=config.seed,
@@ -562,7 +595,18 @@ class TPUStatsBackend:
             # rescans its own stripe) but worth saying out loud
             with span("resume_barrier", rank=pshard[0],
                       restored=restored):
-                peers = allgather_objects((pshard[0], restored, skip))
+                # a peer that died before its artifact loaded would
+                # otherwise hang this collective forever; the watchdog
+                # converts the hang into a typed, heartbeat-stamped
+                # failure (off unless barrier_timeout_s is set)
+                from tpuprof.runtime.distributed import (
+                    allgather_with_watchdog)
+                peers = allgather_with_watchdog(
+                    (pshard[0], restored, skip), barrier_timeout,
+                    site="resume_barrier",
+                    heartbeat=lambda: {"rank": pshard[0],
+                                       "restored": restored,
+                                       "cursor": int(skip)})
             log_event("multihost_resume_barrier", peers=peers)
             flags = {r for _, r, _ in peers}
             if flags == {True, False}:
@@ -644,8 +688,16 @@ class TPUStatsBackend:
                 positions=use_positions, resume_pos=resume_pos,
                 workers=config.prepare_workers,
                 prep_workers=config.prep_workers,
-                full_hashes=config.exact_distinct)
+                full_hashes=config.exact_distinct,
+                batch_guard=batch_guard)
+            # the shift estimate needs a REAL first batch; quarantined
+            # heads are re-chained below so cursor accounting stays
+            # in stream order
+            poisoned_head: List[Any] = []
             first_hb = next(batches, None)
+            while isinstance(first_hb, _guard.PoisonBatch):
+                poisoned_head.append(first_hb)
+                first_hb = next(batches, None)
             if state is None:
                 shift = merge_shift_estimates(
                     estimate_shift(first_hb)
@@ -665,14 +717,48 @@ class TPUStatsBackend:
                     if first_hb is not None else None)
             last_frag = resume_frag
             pending: List[HostBatch] = []
-            if first_hb is not None:
-                for hb in itertools.chain((first_hb,), batches):
-                    # host-side folds run as batches arrive (they overlap
-                    # the async device dispatches of earlier groups)
-                    sampler.update(hb.x, hb.nrows)
-                    if host_hll is not None:
-                        host_hll.update(hb.hll, hb.nrows)
-                    hostagg.update(hb)
+            if first_hb is not None or poisoned_head:
+                head = poisoned_head + \
+                    ([first_hb] if first_hb is not None else [])
+                for hb in itertools.chain(head, batches):
+                    if isinstance(hb, _guard.PoisonBatch):
+                        # batch failed past the retry budget: skip it,
+                        # keep the stream alive.  The cursor still
+                        # advances — the batch WAS consumed from the raw
+                        # stream, so a resume must not replay it.
+                        cursor += 1
+                        last_frag = hb.frag_pos or last_frag
+                        quarantine.admit(site=hb.site, error=hb.error,
+                                         cursor=cursor, rows=hb.rows,
+                                         frag_pos=hb.frag_pos)
+                        if resume is not None and resume.due(cursor):
+                            flush_a(pending)
+                            resume.save(state, sampler, hostagg,
+                                        host_hll, cursor,
+                                        frag_pos=last_frag,
+                                        quarantine=quarantine)
+                        continue
+                    try:
+                        _faults.hit("fold", key=cursor)
+                        # host-side folds run as batches arrive (they
+                        # overlap the async device dispatches of
+                        # earlier groups)
+                        sampler.update(hb.x, hb.nrows)
+                        if host_hll is not None:
+                            host_hll.update(hb.hll, hb.nrows)
+                        hostagg.update(hb)
+                    except Exception as exc:
+                        if not quarantine.enabled:
+                            raise
+                        # fold is NOT idempotent (sampler/HLL/MG state
+                        # may hold partial contributions) — no retry;
+                        # quarantine the batch and press on
+                        cursor += 1
+                        last_frag = hb.frag_pos or last_frag
+                        quarantine.admit(site="fold", error=exc,
+                                         cursor=cursor, rows=hb.nrows,
+                                         frag_pos=hb.frag_pos)
+                        continue
                     pending.append(hb)
                     cursor += 1
                     last_frag = hb.frag_pos or last_frag
@@ -684,14 +770,22 @@ class TPUStatsBackend:
                         flush_a(pending)
                         if ckpt_due:
                             resume.save(state, sampler, hostagg, host_hll,
-                                        cursor, frag_pos=last_frag)
+                                        cursor, frag_pos=last_frag,
+                                        quarantine=quarantine)
                 flush_a(pending)
+            if drain_timeout and state is not None:
+                # bound the device-side drain: a wedged dispatch fails
+                # with a heartbeat instead of hanging the run
+                runner.wait_ready(
+                    state, drain_timeout,
+                    heartbeat=lambda: {"cursor": int(cursor),
+                                       "rows": int(hostagg.n_rows)})
         if resume is not None and resume.last_saved != cursor:
             # pass A complete: keep the final state on disk so a crash
             # during merge/pass-B resumes with the whole stream skipped
             # instead of rescanning; cleared only after assembly
             resume.save(state, sampler, hostagg, host_hll, cursor,
-                        frag_pos=last_frag)
+                        frag_pos=last_frag, quarantine=quarantine)
         # single-host pass-B bounds come off the DEVICE (the twin of
         # khistogram.pass_b_bounds, parity-pinned): the bounds jit
         # enqueues BEFORE the merged-state fetch, so pass B never waits
@@ -830,7 +924,16 @@ class TPUStatsBackend:
                                             depth=max(2, min(scan_s, 8)),
                                             hashes=False,
                                             workers=config.prepare_workers,
-                                            prep_workers=config.prep_workers):
+                                            prep_workers=config.prep_workers,
+                                            batch_guard=batch_guard):
+                    if isinstance(hb, _guard.PoisonBatch):
+                        # pass-B skip shares the pass-A budget; the
+                        # entry's pass field keeps the manifest honest
+                        # about WHICH statistics lost the batch
+                        quarantine.admit(site=hb.site + "_pass_b",
+                                         error=hb.error, rows=hb.rows,
+                                         frag_pos=hb.frag_pos)
+                        continue
                     recounter.update(hb)
                     pending_b.append(hb)
                     if len(pending_b) >= scan_s:
@@ -869,7 +972,13 @@ class TPUStatsBackend:
                         ingest, plan, pad,
                         config.hll_precision, hashes=False,
                         workers=config.prepare_workers,
-                        prep_workers=config.prep_workers):
+                        prep_workers=config.prep_workers,
+                        batch_guard=batch_guard):
+                    if isinstance(hb, _guard.PoisonBatch):
+                        quarantine.admit(site=hb.site + "_pass_b",
+                                         error=hb.error, rows=hb.rows,
+                                         frag_pos=hb.frag_pos)
+                        continue
                     recounter.update(hb)
                 # each host recounts only its own fragment stripe
                 recounter.counts = merge_recount_arrays(recounter.counts)
@@ -879,6 +988,17 @@ class TPUStatsBackend:
                           sample_kept, hll_est, hists, mad, recounter,
                           probes, rho_spear=rho_spear,
                           spear_approx=spear_approx)
+        q_entries = quarantine.entries
+        if pshard[1] > 1:
+            # every host gathers every stripe's skips (symmetric
+            # collective — all hosts call it, even with empty lists);
+            # host 0's report then lists the fleet's degradation
+            q_entries = [e for part in allgather_objects(q_entries)
+                         for e in part]
+        if q_entries:
+            # only degraded runs carry the key — clean-run stats (and
+            # the rendered HTML) stay byte-identical to pre-quarantine
+            stats["_quarantine"] = q_entries
         # spill runs go FIRST: a crash between the two deletes leaves an
         # artifact whose missing runs degrade honestly on resume
         # (__setstate__ demotes to OVERFLOW), whereas the reverse order

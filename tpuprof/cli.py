@@ -123,6 +123,43 @@ def build_parser() -> argparse.ArgumentParser:
                         "writes its own PATH.h<i>of<N> artifact)")
     p.add_argument("--checkpoint-every", type=int, default=64,
                    metavar="N", help="batches between checkpoints")
+    ft = p.add_argument_group(
+        "fault tolerance", "the degradation ladder (ROBUSTNESS.md): "
+        "retry transient batch failures, optionally quarantine poison "
+        "batches instead of dying, keep fallback checkpoint "
+        "generations, and bound the blocking legs with watchdogs")
+    ft.add_argument("--checkpoint-keep", type=int, default=None,
+                    metavar="N",
+                    help="checkpoint generations retained (PATH + "
+                         "PATH.1 ...); restore walks back past a "
+                         "corrupt head to the newest good one "
+                         "(default: TPUPROF_CHECKPOINT_KEEP, else 2)")
+    ft.add_argument("--ingest-retries", type=int, default=None,
+                    metavar="N",
+                    help="transient per-batch prep failures retried "
+                         "with exponential backoff before escalating "
+                         "(default: TPUPROF_INGEST_RETRIES, else 2)")
+    ft.add_argument("--max-quarantined", type=int, default=None,
+                    metavar="N",
+                    help="poison-batch budget: skip (and report) up to "
+                         "N permanently-failing batches instead of "
+                         "dying; the report gains a degraded-run "
+                         "banner (default: TPUPROF_MAX_QUARANTINED, "
+                         "else 0 = fail fast)")
+    ft.add_argument("--quarantine-log", metavar="PATH",
+                    help="also append quarantined-batch records to "
+                         "PATH as JSONL")
+    ft.add_argument("--drain-timeout", type=float, default=None,
+                    metavar="SEC",
+                    help="watchdog deadline on the device drain; "
+                         "expiry exits with a heartbeat snapshot "
+                         "instead of hanging (default: "
+                         "TPUPROF_DRAIN_TIMEOUT_S, else off)")
+    ft.add_argument("--barrier-timeout", type=float, default=None,
+                    metavar="SEC",
+                    help="watchdog deadline on the multi-host resume "
+                         "barrier (default: TPUPROF_BARRIER_TIMEOUT_S, "
+                         "else off)")
     dist = p.add_argument_group(
         "multi-host", "launch the same command on every host (the "
         "framework owns its launch — no spark-submit analogue needed); "
@@ -149,7 +186,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def cmd_profile(args: argparse.Namespace) -> int:
     from tpuprof import ProfileReport, ProfilerConfig
-    from tpuprof.errors import InputError
+    from tpuprof.errors import (CorruptCheckpointError, InputError,
+                                WatchdogTimeout)
     from tpuprof.utils.trace import phase_timer, trace_to
 
     # flag-interaction constraints (--exact-distinct without a spill
@@ -233,6 +271,12 @@ def cmd_profile(args: argparse.Namespace) -> int:
                if args.unique_track_rows is not None else {}),
             checkpoint_path=args.checkpoint,
             checkpoint_every_batches=args.checkpoint_every,
+            checkpoint_keep=args.checkpoint_keep,
+            ingest_retries=args.ingest_retries,
+            max_quarantined=args.max_quarantined,
+            quarantine_log=args.quarantine_log,
+            drain_timeout_s=args.drain_timeout,
+            barrier_timeout_s=args.barrier_timeout,
             metrics_enabled=True if (args.metrics_json or args.progress)
             else None,
             metrics_path=args.metrics_json,
@@ -269,6 +313,17 @@ def cmd_profile(args: argparse.Namespace) -> int:
                 # bugs stay diagnosable
                 print(f"tpuprof: error: {exc}", file=sys.stderr)
                 return 2
+            except CorruptCheckpointError as exc:
+                # the whole retention chain failed integrity: one line
+                # + a distinct code so wrappers can decide "delete the
+                # artifact and rerun" without parsing a traceback
+                print(f"tpuprof: error: {exc}", file=sys.stderr)
+                return 3
+            except WatchdogTimeout as exc:
+                # a watched blocking leg (device drain, resume barrier)
+                # overran its deadline — the heartbeat is in the message
+                print(f"tpuprof: error: {exc}", file=sys.stderr)
+                return 4
         # every host computes the complete merged stats (the cross-host
         # merges are allgathers), but only host 0 renders + writes —
         # N processes racing one output path helps nobody

@@ -48,6 +48,57 @@ def resolve_prepare_workers(value: Optional[int] = None) -> int:
     return max(1, min(4, (os.cpu_count() or 1) // 2))
 
 
+def _env_int(var: str) -> Optional[int]:
+    env = os.environ.get(var)
+    return int(env) if env not in (None, "") else None
+
+
+def _env_float(var: str) -> Optional[float]:
+    env = os.environ.get(var)
+    return float(env) if env not in (None, "") else None
+
+
+def resolve_ingest_retries(value: Optional[int] = None) -> int:
+    """Retry budget for transient per-batch prep failures (ROBUSTNESS.md):
+    an explicit config value wins; else ``TPUPROF_INGEST_RETRIES``; else
+    2.  0 disables the retry rung entirely (first failure escalates)."""
+    if value is not None:
+        return max(int(value), 0)
+    env = _env_int("TPUPROF_INGEST_RETRIES")
+    return max(env, 0) if env is not None else 2
+
+
+def resolve_max_quarantined(value: Optional[int] = None) -> int:
+    """Poison-batch quarantine budget: an explicit config value wins;
+    else ``TPUPROF_MAX_QUARANTINED``; else 0 — the historical fail-fast
+    (a failing batch kills the run), so defaults are bit-identical."""
+    if value is not None:
+        return max(int(value), 0)
+    env = _env_int("TPUPROF_MAX_QUARANTINED")
+    return max(env, 0) if env is not None else 0
+
+
+def resolve_checkpoint_keep(value: Optional[int] = None) -> int:
+    """Checkpoint retention depth (head + rotated ``path.N``): explicit
+    config value, else ``TPUPROF_CHECKPOINT_KEEP``, else 2 — one
+    generation of last-good fallback behind the head."""
+    if value is not None:
+        return max(int(value), 1)
+    env = _env_int("TPUPROF_CHECKPOINT_KEEP")
+    return max(env, 1) if env is not None else 2
+
+
+def resolve_watchdog_timeout(value: Optional[float], var: str
+                             ) -> Optional[float]:
+    """Watchdog deadlines (``drain_timeout_s``/``barrier_timeout_s``):
+    explicit config value, else the named env var, else None = watchdog
+    off (the blocking call runs unwrapped — zero overhead)."""
+    if value is not None:
+        return float(value) if value > 0 else None
+    env = _env_float(var)
+    return env if env and env > 0 else None
+
+
 PASS_B_KERNELS = ("cumulative", "legacy")
 
 
@@ -227,6 +278,49 @@ class ProfilerConfig:
                                             # artifacts path.h<i>of<N>;
                                             # SURVEY §5)
     checkpoint_every_batches: int = 64
+    checkpoint_keep: Optional[int] = None   # retention generations (head
+                                            # + rotated path.N); restore
+                                            # walks back past corrupt
+                                            # heads to the newest good
+                                            # one.  None = auto:
+                                            # TPUPROF_CHECKPOINT_KEEP
+                                            # env, else 2
+    ingest_retries: Optional[int] = None    # transient per-batch prep
+                                            # failures retried with
+                                            # exponential backoff before
+                                            # escalating (quarantine or
+                                            # raise).  None = auto:
+                                            # TPUPROF_INGEST_RETRIES
+                                            # env, else 2; 0 disables
+    retry_backoff_s: float = 0.05           # first retry's sleep; each
+                                            # further attempt doubles it
+    max_quarantined: Optional[int] = None   # poison-batch budget: how
+                                            # many permanently-failing
+                                            # batches may be SKIPPED
+                                            # (logged + degraded-run
+                                            # banner) before the run
+                                            # gives up.  None = auto:
+                                            # TPUPROF_MAX_QUARANTINED
+                                            # env, else 0 = historical
+                                            # fail-fast (bit-identical
+                                            # defaults)
+    quarantine_log: Optional[str] = None    # also append quarantined-
+                                            # batch records here as
+                                            # JSONL (independent of the
+                                            # metrics sink)
+    drain_timeout_s: Optional[float] = None  # watchdog deadline on the
+                                             # device drain
+                                             # (block_until_ready); None
+                                             # = auto:
+                                             # TPUPROF_DRAIN_TIMEOUT_S
+                                             # env, else off.  Expiry
+                                             # raises WatchdogTimeout
+                                             # with a heartbeat snapshot
+    barrier_timeout_s: Optional[float] = None  # watchdog deadline on the
+                                               # multi-host resume
+                                               # barrier; None = auto:
+                                               # TPUPROF_BARRIER_TIMEOUT_S
+                                               # env, else off
     prepare_workers: Optional[int] = None   # cross-batch host-prep
                                             # pipeline width (decode/hash/
                                             # pack of DIFFERENT batches in
@@ -348,6 +442,18 @@ class ProfilerConfig:
                 f"pass_b_kernel={self.pass_b_kernel!r} — use one of "
                 f"{PASS_B_KERNELS} (or None for the "
                 "TPUPROF_PASS_B_KERNEL/default resolution)")
+        if self.checkpoint_keep is not None and self.checkpoint_keep < 1:
+            raise ValueError("checkpoint_keep must be >= 1 (or None)")
+        if self.ingest_retries is not None and self.ingest_retries < 0:
+            raise ValueError("ingest_retries must be >= 0 (or None)")
+        if self.retry_backoff_s < 0:
+            raise ValueError("retry_backoff_s must be >= 0")
+        if self.max_quarantined is not None and self.max_quarantined < 0:
+            raise ValueError("max_quarantined must be >= 0 (or None)")
+        for fname in ("drain_timeout_s", "barrier_timeout_s"):
+            v = getattr(self, fname)
+            if v is not None and v <= 0:
+                raise ValueError(f"{fname} must be > 0 (or None = off)")
         if self.metrics_interval < 0:
             raise ValueError("metrics_interval must be >= 0")
         if self.metrics_block_sample < 0:

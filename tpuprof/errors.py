@@ -1,4 +1,4 @@
-"""Exception types.
+"""Exception taxonomy (ROBUSTNESS.md "degradation ladder").
 
 ``InputError`` marks errors caused by what the USER asked for — an
 unknown ``columns=`` name, a checkpoint that does not match the current
@@ -7,8 +7,63 @@ InputError as a one-line ``tpuprof: error: ...`` with exit code 2;
 everything else keeps its traceback so real bugs stay diagnosable.
 Subclasses ValueError, so library callers that caught ValueError before
 keep working.
+
+The fault-tolerance layer (runtime/guard.py, runtime/checkpoint.py)
+adds four more, each keeping the base class its call sites historically
+raised so existing ``except`` clauses keep working:
+
+* ``TransientError`` (OSError) — the retryable class: flaky reads,
+  wire hiccups, injected test faults.  The retry layer also treats raw
+  ``OSError`` and Arrow IO/decode errors as transient.
+* ``CorruptCheckpointError`` (ValueError — checkpoint loads raised
+  ValueError before) — an artifact that fails the CRC/version/shape
+  integrity checks, or whose pickle/zip payload is torn.  Never a raw
+  ``EOFError``/``UnpicklingError``/``BadZipFile``; the CLI maps it to
+  exit code 3.
+* ``PoisonBatchError`` (RuntimeError) — a batch kept failing past the
+  retry budget AND the quarantine budget (``max_quarantined``) is
+  exhausted or disabled; carries the quarantine manifest so callers can
+  report which batches were skipped before giving up.
+* ``WatchdogTimeout`` (TimeoutError) — a watched blocking call (device
+  drain, multi-host resume barrier) exceeded its configured timeout;
+  carries the site and a heartbeat snapshot taken at expiry.  CLI exit
+  code 4.
 """
+
+from typing import Any, Dict, List, Optional
 
 
 class InputError(ValueError):
     pass
+
+
+class TransientError(OSError):
+    """An error worth retrying: the operation is idempotent and the
+    failure class (I/O hiccup, injected fault) is expected to clear."""
+
+
+class CorruptCheckpointError(ValueError):
+    """A checkpoint artifact failed integrity validation (CRC32,
+    truncation, version, undecodable payload)."""
+
+
+class PoisonBatchError(RuntimeError):
+    """A batch failed permanently and no quarantine budget remains."""
+
+    def __init__(self, message: str,
+                 manifest: Optional[List[Dict[str, Any]]] = None):
+        super().__init__(message)
+        self.manifest = list(manifest or [])
+
+
+class WatchdogTimeout(TimeoutError):
+    """A watched blocking call overran its deadline."""
+
+    def __init__(self, site: str, timeout_s: float,
+                 heartbeat: Optional[Dict[str, Any]] = None):
+        super().__init__(
+            f"watchdog: {site!r} exceeded {timeout_s:g}s"
+            + (f" (heartbeat: {heartbeat})" if heartbeat else ""))
+        self.site = site
+        self.timeout_s = timeout_s
+        self.heartbeat = heartbeat

@@ -659,7 +659,8 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                       resume_pos: Optional[Tuple[int, int]] = None,
                       workers: Optional[int] = None,
                       full_hashes: bool = False,
-                      prep_workers: Optional[int] = None):
+                      prep_workers: Optional[int] = None,
+                      batch_guard=None):
     """Yield prepared HostBatches with decode/hash/pack of DIFFERENT
     batches pipelined across a small thread pool (``workers``, default
     ``_prepare_workers()``), so one process can saturate its cores
@@ -731,13 +732,23 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
     pool = ThreadPoolExecutor(max_workers=w,
                               thread_name_prefix="tpuprof-prep")
 
-    def _prep(rb, frag_pos):
-        return prepare_batch(rb, plan, pad, hll_precision, hashes=hashes,
-                             frag_pos=frag_pos,
-                             dict_cache=ingest._dict_cache,
-                             col_stats=ingest._col_stats,
-                             decode_threads=col_threads,
-                             full_hashes=full_hashes)
+    def _prep(rb, frag_pos, key):
+        def _do():
+            return prepare_batch(rb, plan, pad, hll_precision,
+                                 hashes=hashes, frag_pos=frag_pos,
+                                 dict_cache=ingest._dict_cache,
+                                 col_stats=ingest._col_stats,
+                                 decode_threads=col_threads,
+                                 full_hashes=full_hashes)
+        if batch_guard is None:
+            return _do()
+        # runtime/guard.BatchGuard: retry transient failures; with
+        # quarantine on, a permanently-failing batch flows through the
+        # ordered queue as a PoisonBatch marker instead of killing the
+        # pipeline.  ``key`` (the batch's stream position) makes seeded
+        # fault injection order-free under any worker count.
+        return batch_guard.run(_do, site="prep", key=key,
+                               rows=rb.num_rows, frag_pos=frag_pos)
 
     def reader():
         # enumerates raw batches (cheap: zero-copy slices / parquet page
@@ -751,13 +762,14 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                         skip_fragments=start_frag):
                     if fi == start_frag and bi < done:
                         continue
-                    if not _put(pool.submit(_prep, rb, (fi, bi))):
+                    if not _put(pool.submit(_prep, rb, (fi, bi),
+                                            (fi, bi))):
                         return
             else:
                 for k, rb in enumerate(ingest.raw_batches()):
                     if k < skip_batches:
                         continue
-                    if not _put(pool.submit(_prep, rb, None)):
+                    if not _put(pool.submit(_prep, rb, None, k)):
                         return
         except BaseException as exc:          # re-raised consumer-side
             failure.append(exc)

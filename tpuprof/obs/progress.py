@@ -111,8 +111,17 @@ class Ticker:
         self._thread: Optional[threading.Thread] = None
         self._t0 = time.monotonic()
         self._last_rows = 0.0
+        self.stop_timed_out = False     # a stop() join that expired
+        # with the tick thread still alive (e.g. a tick blocked on a
+        # wedged stream) — flagged, and _tick's stop guard keeps the
+        # orphan from ever printing/emitting into a closed profiler
 
     def _tick(self) -> None:
+        if self._stop.is_set():
+            # stop() may expire its join while a tick is queued behind
+            # a slow write; the guard makes the orphan tick a no-op
+            # instead of emitting into a finished (or closed) run
+            return
         if self.snapshots:
             events.emit_snapshot(reason="interval")
         if self.progress:
@@ -141,8 +150,18 @@ class Ticker:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=2.0)
+            if thread.is_alive():
+                # the join can expire with the thread still inside a
+                # blocked tick; before this flag existed the orphan
+                # kept ticking into whatever came next.  The daemon
+                # thread dies with the process; the _tick stop guard
+                # silences it until then.
+                self.stop_timed_out = True
+                events.emit("ticker_stop_timeout",
+                            interval=self.interval)
             self._thread = None
 
     def __enter__(self) -> "Ticker":

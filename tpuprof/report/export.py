@@ -79,6 +79,14 @@ def stats_to_json(stats: Dict[str, Any]) -> Dict[str, Any]:
             {**m.to_dict(), "value": json_scalar(m.value)}
             for m in stats.get("messages", ())],
     }
+    if stats.get("_quarantine"):
+        # degraded runs only (ROBUSTNESS.md): the skipped-batch manifest
+        # rides the JSON export so automation can react without
+        # scraping the HTML banner
+        out["quarantine"] = [
+            {k: json_scalar(v) if not isinstance(v, (list, type(None)))
+             else v for k, v in e.items()}
+            for e in stats["_quarantine"]]
     sample = stats.get("sample")
     if sample is None:
         out["sample"] = {"columns": [], "rows": []}

@@ -117,6 +117,24 @@ def _pipeline_stats_line(stats: Dict[str, Any]) -> str:
     return " · ".join(parts)
 
 
+def _quarantine_rows(stats: Dict[str, Any]):
+    """Degraded-run manifest for the banner (ROBUSTNESS.md): one row
+    per skipped batch, pre-formatted so the template stays dumb.  The
+    ``_quarantine`` key exists ONLY on degraded runs — clean-run HTML
+    is byte-identical to a build without the banner."""
+    rows = []
+    for e in stats.get("_quarantine") or []:
+        pos = e.get("frag_pos")
+        rows.append({
+            "site": e.get("site", "?"),
+            "cursor": "—" if e.get("cursor") is None else e["cursor"],
+            "rows": "?" if e.get("rows") is None else f"{e['rows']:,}",
+            "pos": f"frag {pos[0]} batch {pos[1]}" if pos else "—",
+            "error": str(e.get("error", ""))[:300],
+        })
+    return rows
+
+
 def to_html(stats: Dict[str, Any], config: ProfilerConfig) -> str:
     """Render the report fragment (reference: ProfileReport.html)."""
     from tpuprof import __version__
@@ -132,6 +150,7 @@ def to_html(stats: Dict[str, Any], config: ProfilerConfig) -> str:
         version=__version__,
         perf=_perf_line(stats),
         pipeline_stats=_pipeline_stats_line(stats),
+        quarantine=_quarantine_rows(stats),
     )
 
 

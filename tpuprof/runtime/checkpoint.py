@@ -1,4 +1,4 @@
-"""Checkpoint / resume for profiling runs (SURVEY.md §5).
+"""Checkpoint / resume for profiling runs (SURVEY.md §5, ROBUSTNESS.md).
 
 The reference has nothing here — a profile is one-shot and Spark task
 retry is its only recovery story.  tpuprof's sketch states are small
@@ -12,6 +12,24 @@ dicts hold arbitrary python values — strings, timestamps).  Not a
 wire-portable format; it is a crash-recovery artifact, same machine
 class in and out.
 
+Durability ladder (v5):
+
+* **atomic** — payload written to ``path.tmp``, flushed AND fsynced,
+  then renamed over ``path``; a raising save unlinks the tmp file in a
+  ``finally`` so no write path can litter.
+* **integrity** — the leading header pickle carries the payload's
+  CRC32 + byte length; ``load_payload`` verifies both before the host
+  blob (whose classes may have changed incompatibly) is ever unpickled.
+  Any torn/garbage artifact — truncated at ANY byte offset, rewritten
+  with junk — surfaces as :class:`CorruptCheckpointError`, never a raw
+  ``EOFError``/``UnpicklingError``/``BadZipFile``.
+* **retention** — ``save(..., keep=N)`` rotates the previous artifact
+  to ``path.1`` (then ``path.2``, ...), keeping N generations; and
+  ``restore_payload`` walks the chain newest-first, falling back past
+  corrupt heads (``checkpoint_fallback`` event +
+  ``tpuprof_checkpoint_fallbacks_total``) to the newest artifact that
+  passes the CRC/version/shape checks instead of dying.
+
 Cursor contract under parallel ingest: prepare workers race batches
 ahead of the device fold, but the cursor saved here counts DELIVERED
 (in-order) batches only — the prefetch pipeline yields in raw-stream
@@ -24,26 +42,33 @@ artifact-equals-fold invariant at 4 workers).
 from __future__ import annotations
 
 import io
+import os
 import pickle
 import time
-from typing import Any, Dict, Tuple
+import zlib
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
 import numpy as np
 
+from tpuprof.errors import CorruptCheckpointError
 from tpuprof.obs import metrics as _obs_metrics
+from tpuprof.testing import faults
 
 _SAVES = _obs_metrics.counter(
     "tpuprof_checkpoint_saves_total", "checkpoint artifacts written")
 _RESTORES = _obs_metrics.counter(
     "tpuprof_checkpoint_restores_total", "checkpoint payloads read back")
+_FALLBACKS = _obs_metrics.counter(
+    "tpuprof_checkpoint_fallbacks_total",
+    "corrupt/unreadable artifacts skipped by the restore walk-back")
 _SAVE_SECONDS = _obs_metrics.histogram(
     "tpuprof_checkpoint_save_seconds",
     "wall seconds per atomic checkpoint write (device fetch + pickle + "
-    "rename)")
+    "fsync + rename)")
 _RESTORE_SECONDS = _obs_metrics.histogram(
     "tpuprof_checkpoint_restore_seconds",
-    "wall seconds per checkpoint payload read (disk + unpickle)")
+    "wall seconds per checkpoint payload read (disk + CRC + unpickle)")
 _SAVE_BYTES = _obs_metrics.gauge(
     "tpuprof_checkpoint_bytes", "size of the newest checkpoint artifact")
 
@@ -55,7 +80,11 @@ _SAVE_BYTES = _obs_metrics.gauge(
 # HostAgg uniqueness tracker) and the file layout became header-first —
 # a small version header pickled BEFORE the payload, so a mismatched
 # version is rejected without unpickling a possibly-incompatible blob.
-FORMAT_VERSION = 4
+# v5: the header grew payload integrity fields (payload_crc32,
+# payload_len) and the payload is written as the RAW pickle bytes the
+# CRC covers (byte-identical stream to v4's second pickle.dump, so a
+# v5 reader still sees two back-to-back pickles).
+FORMAT_VERSION = 5
 
 
 def _flatten(tree: Any) -> Dict[str, np.ndarray]:
@@ -82,9 +111,50 @@ def _unflatten(template: Any, flat: Dict[str, np.ndarray]) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def payload_header(payload_bytes: bytes) -> Dict[str, Any]:
+    """The v5 integrity header for a serialized payload (exposed so
+    tests that hand-edit artifacts can restamp a VALID header)."""
+    return {"format_version": FORMAT_VERSION,
+            "payload_crc32": zlib.crc32(payload_bytes) & 0xFFFFFFFF,
+            "payload_len": len(payload_bytes)}
+
+
+def _rotate(path: str, keep: int) -> None:
+    """Shift ``path`` -> ``path.1`` -> ... keeping ``keep`` generations
+    total (the head plus keep-1 rotated).  keep<=1 keeps the historical
+    overwrite-in-place behavior."""
+    if keep <= 1 or not os.path.exists(path):
+        return
+    for i in range(keep - 1, 1, -1):
+        src = f"{path}.{i - 1}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i}")
+    os.replace(path, path + ".1")
+
+
+def candidate_paths(path: str) -> Iterator[str]:
+    """The retention chain, newest first: ``path``, ``path.1``, ... —
+    stops at the first missing rotation slot."""
+    yield path
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        yield f"{path}.{i}"
+        i += 1
+
+
+def clear(path: str) -> None:
+    """Remove an artifact chain (head, rotations, stray tmp)."""
+    for cand in list(candidate_paths(path)) + [path + ".tmp"]:
+        try:
+            os.remove(cand)
+        except OSError:
+            pass
+
+
 def save(path: str, state: Any, host_blob: Any, cursor: int,
-         meta: Dict[str, Any]) -> None:
-    """Write one atomic checkpoint file."""
+         meta: Dict[str, Any], keep: int = 1) -> None:
+    """Write one atomic, fsynced, CRC-stamped checkpoint file, rotating
+    the previous ``keep - 1`` generations to ``path.N``."""
     t0 = time.perf_counter()
     flat = _flatten(jax.device_get(state))
     buf = io.BytesIO()
@@ -95,12 +165,28 @@ def save(path: str, state: Any, host_blob: Any, cursor: int,
         "cursor": int(cursor),
         "meta": meta,
     }
+    payload_bytes = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
-        pickle.dump({"format_version": FORMAT_VERSION}, fh,
-                    protocol=pickle.HIGHEST_PROTOCOL)
-        pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
-    import os
+    try:
+        with open(tmp, "wb") as fh:
+            faults.hit("checkpoint_write", key=int(cursor))
+            pickle.dump(payload_header(payload_bytes), fh,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+            fh.write(faults.mangle("checkpoint_write", payload_bytes))
+            # fsync BEFORE the rename: os.replace is atomic in the
+            # namespace but says nothing about data pages — a crash
+            # after rename-before-flush would leave a torn "good" head
+            fh.flush()
+            os.fsync(fh.fileno())
+    except BaseException:
+        # a raising save must not litter: the tmp file is unreferenced
+        # and a later save would silently overwrite it anyway
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    _rotate(path, keep)
     os.replace(tmp, path)
     if _obs_metrics.enabled():
         dt = time.perf_counter() - t0
@@ -116,22 +202,59 @@ def save(path: str, state: Any, host_blob: Any, cursor: int,
 
 
 def load_payload(path: str) -> Dict[str, Any]:
-    """Read and version-check the raw checkpoint payload (one disk read;
-    materialize the device state separately with :func:`materialize`).
+    """Read, integrity-check and version-check the raw checkpoint
+    payload (one disk read; materialize the device state separately
+    with :func:`materialize`).
 
     The version header is a separate leading pickle so a mismatched
     format is rejected BEFORE the host blob (whose classes may have
-    changed incompatibly) is ever unpickled.  Pre-v4 files were one
-    single pickle whose dict carried format_version inline — the first
-    load then yields that whole dict and the check still rejects it."""
+    changed incompatibly) is ever unpickled; since v5 it also carries
+    the payload CRC32 + length, checked before unpickling too.  ANY
+    read/decode failure — torn header, short payload, bit rot, a
+    pre-v5 artifact — normalizes to :class:`CorruptCheckpointError`
+    (version mismatches name the version; callers walking a retention
+    chain treat them all as "try the next generation")."""
     t0 = time.perf_counter()
-    with open(path, "rb") as fh:
-        header = pickle.load(fh)
-        version = header.get("format_version") \
-            if isinstance(header, dict) else None
-        if version != FORMAT_VERSION:
-            raise ValueError(f"unsupported checkpoint format {version}")
-        payload = pickle.load(fh)
+    try:
+        with open(path, "rb") as fh:
+            header = pickle.load(fh)
+            version = header.get("format_version") \
+                if isinstance(header, dict) else None
+            if version != FORMAT_VERSION:
+                raise CorruptCheckpointError(
+                    f"unsupported checkpoint format {version} in "
+                    f"{path!r} (this build reads v{FORMAT_VERSION})")
+            crc = header.get("payload_crc32")
+            length = header.get("payload_len")
+            if crc is None or length is None:
+                raise CorruptCheckpointError(
+                    f"checkpoint {path!r} header lacks integrity fields "
+                    "(payload_crc32/payload_len) — torn or hand-edited")
+            payload_bytes = fh.read()
+            if len(payload_bytes) != length:
+                raise CorruptCheckpointError(
+                    f"checkpoint {path!r} payload is {len(payload_bytes)} "
+                    f"bytes, header says {length} — truncated write")
+            if zlib.crc32(payload_bytes) & 0xFFFFFFFF != crc:
+                raise CorruptCheckpointError(
+                    f"checkpoint {path!r} payload CRC mismatch — "
+                    "corrupt artifact")
+            payload = pickle.loads(payload_bytes)
+            if not isinstance(payload, dict):
+                raise CorruptCheckpointError(
+                    f"checkpoint {path!r} payload decodes to "
+                    f"{type(payload).__name__}, not a payload dict")
+    except CorruptCheckpointError:
+        raise
+    except FileNotFoundError:
+        raise
+    except Exception as exc:
+        # EOFError, UnpicklingError, AttributeError from a missing
+        # class, OSError mid-read ... all mean the same thing to a
+        # caller: this artifact cannot be trusted
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r} is unreadable "
+            f"({type(exc).__name__}: {exc})") from exc
     if _obs_metrics.enabled():
         dt = time.perf_counter() - t0
         _RESTORES.inc()
@@ -145,10 +268,59 @@ def load_payload(path: str) -> Dict[str, Any]:
 
 def materialize(payload: Dict[str, Any], state_template: Any) -> Any:
     """Decode the device pytree from a payload, validated against (and
-    shaped like) ``state_template``."""
-    with np.load(io.BytesIO(payload["arrays_npz"])) as npz:
-        flat = {k: npz[k] for k in npz.files}
+    shaped like) ``state_template``.  A torn/garbage archive inside an
+    otherwise-wellformed payload (possible only for artifacts written
+    outside :func:`save`'s CRC envelope) still surfaces typed."""
+    try:
+        with np.load(io.BytesIO(payload["arrays_npz"])) as npz:
+            flat = {k: npz[k] for k in npz.files}
+    except ValueError:
+        raise               # shape/meaning mismatches keep their message
+    except Exception as exc:   # BadZipFile, KeyError, OSError ...
+        raise CorruptCheckpointError(
+            f"checkpoint device-state archive is unreadable "
+            f"({type(exc).__name__}: {exc})") from exc
     return _unflatten(state_template, flat)
+
+
+def restore_payload(path: str, state_template: Any = None
+                    ) -> Tuple[Dict[str, Any], Optional[Any], str]:
+    """Walk the retention chain newest-first and return
+    ``(payload, state_or_None, used_path)`` from the newest artifact
+    that passes the CRC + version (+ shape, when ``state_template`` is
+    given) checks.  Each corrupt generation skipped emits a
+    ``checkpoint_fallback`` event and increments
+    ``tpuprof_checkpoint_fallbacks_total`` — the run degrades to older
+    work instead of dying on the corrupt head.  Raises
+    :class:`CorruptCheckpointError` only when NO generation survives."""
+    last_exc: Optional[Exception] = None
+    n_seen = 0
+    for cand in candidate_paths(path):
+        n_seen += 1
+        try:
+            payload = load_payload(cand)
+            state = None
+            if state_template is not None \
+                    and payload.get("meta", {}).get("has_state", True):
+                state = materialize(payload, state_template)
+            if cand != path:
+                from tpuprof.obs import events
+                events.emit("checkpoint_fallback_used", path=cand,
+                            head=path,
+                            cursor=int(payload.get("cursor", -1)))
+            return payload, state, cand
+        except (CorruptCheckpointError, ValueError, OSError) as exc:
+            # OSError covers a deleted/unreadable head whose rotations
+            # survive — still a walkable failure, not a crash
+            last_exc = exc
+            _FALLBACKS.inc()
+            from tpuprof.obs import events
+            events.emit("checkpoint_fallback", path=cand,
+                        error=f"{type(exc).__name__}: {exc}")
+            continue
+    raise CorruptCheckpointError(
+        f"no readable checkpoint at {path!r} ({n_seen} generation(s) "
+        f"tried; newest failure: {last_exc})") from last_exc
 
 
 def load(path: str, state_template: Any) -> Tuple[Any, Any, int,

@@ -83,6 +83,25 @@ def allgather_objects(obj):
             for row, ln in zip(gathered, all_lengths)]
 
 
+def allgather_with_watchdog(obj, timeout_s=None, site: str = "barrier",
+                            heartbeat=None):
+    """:func:`allgather_objects` under a watchdog deadline — the
+    multi-host barriers (resume barrier, cleanup barrier) otherwise
+    hang EVERY healthy host forever when one peer dies before its
+    collective.  Expiry raises :class:`WatchdogTimeout` with the
+    heartbeat snapshot attached (runtime/guard.watched); ``timeout_s``
+    None degrades to the plain allgather."""
+    from tpuprof.runtime import guard
+    from tpuprof.testing import faults
+
+    def _gather():
+        faults.hit("barrier")
+        return allgather_objects(obj)
+
+    return guard.watched(_gather, timeout_s, site=site,
+                         heartbeat=heartbeat)
+
+
 def merge_host_aggs(hostagg):
     """Merge every host's HostAgg into a complete one (on all hosts).
     Misra-Gries merge keeps its mergeability bounds (kernels/topk.py)."""

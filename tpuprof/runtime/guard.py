@@ -1,0 +1,231 @@
+"""Fault-tolerance primitives: retry, poison-batch quarantine, watchdogs
+(ROBUSTNESS.md "degradation ladder").
+
+The streaming runtime replaced Spark's executor — and with it Spark task
+retry, which was the reference's ONLY recovery story.  This module is
+the replacement ladder, rung by rung:
+
+1. **retry** (:class:`BatchGuard`) — transient errors (``OSError``,
+   Arrow IO/decode errors, :class:`TransientError`) on the idempotent
+   per-batch PREP path are retried ``ingest_retries`` times with
+   exponential backoff before anything escalates.
+2. **quarantine** (:class:`Quarantine`) — a batch that still fails (or
+   whose non-idempotent FOLD raises — never retried: a partial fold
+   cannot be replayed safely) is skipped, not fatal: its cursor,
+   row count and error land in the quarantine manifest + event log,
+   ``tpuprof_batches_quarantined_total`` increments, and the HTML
+   report grows a degraded-run banner.  Budgeted by ``max_quarantined``
+   (default 0 = the historical fail-fast behavior, so defaults are
+   bit-identical).
+3. **watchdog** (:func:`watched`) — blocking calls that can hang a
+   fleet (device drain, resume barrier) run under a deadline and raise
+   :class:`WatchdogTimeout` with a heartbeat snapshot instead of
+   wedging forever.
+
+Everything here is host-side and import-light (no jax, no pandas).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+from tpuprof.errors import (PoisonBatchError, TransientError,
+                            WatchdogTimeout)
+from tpuprof.obs import metrics as _obs_metrics
+from tpuprof.testing import faults
+
+_RETRIES = _obs_metrics.counter(
+    "tpuprof_ingest_retries_total",
+    "transient per-batch failures retried by the ingest guard, by site")
+_QUARANTINED = _obs_metrics.counter(
+    "tpuprof_batches_quarantined_total",
+    "batches skipped by the poison-batch quarantine, by site")
+_WATCHDOG_TIMEOUTS = _obs_metrics.counter(
+    "tpuprof_watchdog_timeouts_total",
+    "watched blocking calls that overran their deadline, by site")
+_WATCHDOG_WAIT_SECONDS = _obs_metrics.histogram(
+    "tpuprof_watchdog_wait_seconds",
+    "wall seconds a watched call actually took (completed calls only)")
+
+
+def is_transient(exc: BaseException) -> bool:
+    """The retryable class: OSError (and TransientError under it) plus
+    pyarrow's IO/decode errors.  KeyboardInterrupt/SystemExit are
+    BaseException and never reach here (guards catch Exception)."""
+    if isinstance(exc, (TransientError, OSError)):
+        return True
+    try:
+        import pyarrow as pa
+        return isinstance(exc, (pa.ArrowIOError, pa.ArrowInvalid))
+    except Exception:       # pyarrow absent/mid-teardown: no extra class
+        return False
+
+
+class PoisonBatch(NamedTuple):
+    """Marker delivered through a prep pipeline in place of a HostBatch
+    when a batch failed past its retry budget and the consumer is
+    quarantine-enabled — the pipeline stays alive and ordered, the
+    consumer decides (via :meth:`Quarantine.admit`) whether the budget
+    covers the skip."""
+
+    site: str
+    error: str
+    rows: Optional[int] = None
+    frag_pos: Optional[tuple] = None
+
+
+class BatchGuard:
+    """Per-batch retry policy (+ optional poison capture) for the
+    idempotent prep path.
+
+    ``capture=True`` converts a permanently-failing batch into a
+    :class:`PoisonBatch` marker instead of raising, so an ordered
+    prefetch pipeline survives the failure; ``capture=False`` (the
+    quarantine-disabled default) re-raises the original error after the
+    retries — exactly the historical behavior, one retry loop earlier.
+    """
+
+    def __init__(self, retries: int = 0, backoff_s: float = 0.05,
+                 capture: bool = False,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.retries = max(int(retries), 0)
+        self.backoff_s = float(backoff_s)
+        self.capture = bool(capture)
+        self._sleep = sleep
+
+    def run(self, fn: Callable[[], Any], *, site: str,
+            key: Any = None, rows: Optional[int] = None,
+            frag_pos: Optional[tuple] = None) -> Any:
+        attempt = 0
+        while True:
+            try:
+                faults.hit(site, key=key)
+                return fn()
+            except Exception as exc:
+                if is_transient(exc) and attempt < self.retries:
+                    attempt += 1
+                    _RETRIES.inc(site=site)
+                    from tpuprof.obs import events
+                    events.emit("ingest_retry", site=site, key=key,
+                                attempt=attempt,
+                                error=f"{type(exc).__name__}: {exc}")
+                    if self.backoff_s > 0:
+                        self._sleep(self.backoff_s * (2 ** (attempt - 1)))
+                    continue
+                if self.capture:
+                    return PoisonBatch(
+                        site=site,
+                        error=f"{type(exc).__name__}: {exc}",
+                        rows=rows, frag_pos=frag_pos)
+                raise
+
+
+class Quarantine:
+    """Bounded skip-list for poison batches.
+
+    ``admit`` either records the skip (budget permitting) or raises:
+    the ORIGINAL error when quarantine is disabled (``max_quarantined``
+    <= 0 — the historical fail-fast), :class:`PoisonBatchError`
+    carrying the manifest when the budget is exhausted."""
+
+    def __init__(self, max_quarantined: int = 0,
+                 log_path: Optional[str] = None):
+        self.max = int(max_quarantined)
+        self.log_path = log_path
+        self.entries: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.max > 0
+
+    def admit(self, *, site: str, error: Any, cursor: Optional[int] = None,
+              rows: Optional[int] = None,
+              frag_pos: Optional[tuple] = None) -> Dict[str, Any]:
+        if not self.enabled:
+            if isinstance(error, BaseException):
+                raise error
+            raise PoisonBatchError(
+                f"poison batch at {site!r} (cursor={cursor}): {error} "
+                "— quarantine is disabled (max_quarantined=0)")
+        entry = {
+            "site": site, "cursor": cursor, "rows": rows,
+            "frag_pos": list(frag_pos) if frag_pos else None,
+            "error": error if isinstance(error, str)
+            else f"{type(error).__name__}: {error}",
+        }
+        with self._lock:
+            self.entries.append(entry)
+            n = len(self.entries)
+        _QUARANTINED.inc(site=site)
+        from tpuprof.obs import events
+        events.emit("batch_quarantined", **entry)
+        if self.log_path:
+            import json
+            try:
+                with open(self.log_path, "a") as fh:
+                    fh.write(json.dumps(entry, default=str) + "\n")
+            except OSError:
+                pass        # the log is best-effort; the manifest rules
+        if n > self.max:
+            exc = PoisonBatchError(
+                f"giving up: {n} batches quarantined, budget "
+                f"max_quarantined={self.max} exhausted "
+                f"(last: {entry['site']} cursor={cursor}: "
+                f"{entry['error']})", manifest=self.entries)
+            if isinstance(error, BaseException):
+                raise exc from error
+            raise exc
+        return entry
+
+    def seed(self, entries) -> None:
+        """Adopt a restored checkpoint's manifest (resume continuity)."""
+        with self._lock:
+            self.entries = list(entries or [])
+
+
+def watched(fn: Callable[[], Any], timeout_s: Optional[float],
+            site: str,
+            heartbeat: Optional[Callable[[], Dict[str, Any]]] = None
+            ) -> Any:
+    """Run ``fn`` under a deadline.  ``timeout_s`` None/0 calls it
+    directly (zero overhead — the default path).  On expiry the worker
+    thread is abandoned (daemonized; the process is expected to exit on
+    :class:`WatchdogTimeout`) and the caller gets the timeout with a
+    heartbeat snapshot attached instead of hanging forever."""
+    if not timeout_s:
+        return fn()
+    result: List[Any] = []
+    err: List[BaseException] = []
+    done = threading.Event()
+
+    def _body() -> None:
+        try:
+            result.append(fn())
+        except BaseException as exc:        # noqa: BLE001 — re-raised
+            err.append(exc)
+        finally:
+            done.set()
+
+    t0 = time.perf_counter()
+    thread = threading.Thread(target=_body, daemon=True,
+                              name=f"tpuprof-watchdog-{site}")
+    thread.start()
+    if not done.wait(timeout_s):
+        _WATCHDOG_TIMEOUTS.inc(site=site)
+        hb = None
+        if heartbeat is not None:
+            try:
+                hb = heartbeat()
+            except Exception:
+                hb = None
+        from tpuprof.obs import events
+        events.emit("watchdog_timeout", site=site,
+                    timeout_s=float(timeout_s), heartbeat=hb)
+        raise WatchdogTimeout(site, float(timeout_s), heartbeat=hb)
+    _WATCHDOG_WAIT_SECONDS.observe(time.perf_counter() - t0, site=site)
+    if err:
+        raise err[0]
+    return result[0]

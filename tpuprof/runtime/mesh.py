@@ -548,6 +548,24 @@ class MeshRunner:
         per-batch program can consume staged data without re-transfer)."""
         return DeviceBatch(sb.xts[i], sb.row_valids[i], sb.hllts[i])
 
+    def wait_ready(self, tree: Pytree, timeout_s=None,
+                   heartbeat=None) -> Pytree:
+        """``jax.block_until_ready`` under a watchdog deadline
+        (runtime/guard.watched): a wedged device drain — dead tunnel,
+        hung collective — raises :class:`WatchdogTimeout` with the
+        caller's heartbeat snapshot attached instead of blocking the
+        process forever.  ``timeout_s`` None runs unwatched (and is the
+        zero-overhead default path)."""
+        from tpuprof.runtime import guard
+        from tpuprof.testing import faults
+
+        def _wait():
+            faults.hit("device_wait")
+            return jax.block_until_ready(tree)
+
+        return guard.watched(_wait, timeout_s, site="device_drain",
+                             heartbeat=heartbeat)
+
     def finalize_spearman(self, state: Pytree):
         return jax.device_get(
             jax.tree.map(lambda a: a[0], self._merge_spear(state)))

@@ -31,7 +31,9 @@ from tpuprof.kernels import moments as kmoments
 from tpuprof.obs import metrics as _obs_metrics
 from tpuprof.obs.progress import RateEMA, fmt_rate
 from tpuprof.runtime import checkpoint as ckpt
+from tpuprof.runtime import guard as _guard
 from tpuprof.runtime.mesh import MeshRunner
+from tpuprof.testing import faults as _faults
 from tpuprof.utils.trace import log_event
 
 _BATCHES_FOLDED = _obs_metrics.counter(
@@ -168,6 +170,27 @@ class StreamingProfiler:
         import time as _time
         self._t_start = _time.monotonic()
         self._rate_ema = RateEMA(halflife=10.0)
+        # fault-tolerance rungs (ROBUSTNESS.md): transient prep retries
+        # always on; poison-batch quarantine only when budgeted; drain
+        # watchdog only when a deadline is configured — defaults keep
+        # the historical fail-fast, bit-identical behavior
+        from tpuprof.config import (resolve_checkpoint_keep,
+                                    resolve_ingest_retries,
+                                    resolve_max_quarantined,
+                                    resolve_watchdog_timeout)
+        self._quarantine = _guard.Quarantine(
+            resolve_max_quarantined(self.config.max_quarantined),
+            log_path=self.config.quarantine_log)
+        self._batch_guard = _guard.BatchGuard(
+            resolve_ingest_retries(self.config.ingest_retries),
+            self.config.retry_backoff_s,
+            capture=self._quarantine.enabled)
+        self._drain_timeout = resolve_watchdog_timeout(
+            self.config.drain_timeout_s, "TPUPROF_DRAIN_TIMEOUT_S")
+        self._ckpt_keep = resolve_checkpoint_keep(
+            self.config.checkpoint_keep)
+        self._slice_seq = 0     # deterministic per-slice key (faults,
+        self._closed = False    # quarantine manifest ordering)
 
     @classmethod
     def for_example(cls, example: Any, **kwargs) -> "StreamingProfiler":
@@ -280,20 +303,53 @@ class StreamingProfiler:
         from tpuprof.ingest import prep
         w = resolve_prepare_workers(self.config.prepare_workers) \
             if len(slices) > 1 else 1
+        # each slice carries a process-monotonic sequence number: the
+        # retry guard's fault keys and the quarantine manifest stay
+        # deterministic at any worker count
+        seq0 = self._slice_seq
+        self._slice_seq += len(slices)
+
+        def _prepare(pair):
+            idx, tbl = pair
+            return self._batch_guard.run(
+                lambda: self._prepare_slice(tbl), site="prep", key=idx,
+                rows=tbl.num_rows)
+
         # split the drain's wall time into "waiting on prep" (the
         # generator's next()) vs "folding" — their ratio is the
         # prefetch-overlap figure the obs layer reports
         wait_s = 0.0
         done = object()     # ordered_map may yield None for empty slices
-        it = iter(prep.ordered_map(slices, self._prepare_slice,
-                                   workers=w, depth=2))
+        it = iter(prep.ordered_map(
+            list(enumerate(slices, start=seq0)), _prepare,
+            workers=w, depth=2))
         while True:
             tw = _time.perf_counter()
             hb = next(it, done)
             wait_s += _time.perf_counter() - tw
             if hb is done:
                 break
-            self._fold_prepared(hb)
+            if isinstance(hb, _guard.PoisonBatch):
+                # slice failed past the retry budget: skip it, keep the
+                # stream alive (budget enforced by admit)
+                self._quarantine.admit(site=hb.site, error=hb.error,
+                                       cursor=self.cursor, rows=hb.rows)
+                continue
+            try:
+                _faults.hit("fold", key=self.cursor)
+                self._fold_prepared(hb)
+            except Exception as exc:
+                if not self._quarantine.enabled:
+                    raise
+                # fold is not idempotent — no retry; skip the slice
+                self._quarantine.admit(
+                    site="fold", error=exc, cursor=self.cursor,
+                    rows=hb.nrows if hb is not None else None)
+        if self._drain_timeout and self.state is not None:
+            # bound the device side of the drain: a wedged dispatch
+            # surfaces as WatchdogTimeout + heartbeat, never a hang
+            self.runner.wait_ready(self.state, self._drain_timeout,
+                                   heartbeat=self.heartbeat)
         if _obs_metrics.enabled():
             dt = _time.perf_counter() - t0
             _DRAIN_SECONDS.observe(dt)
@@ -371,6 +427,9 @@ class StreamingProfiler:
             rho_spear=rho_spear, spear_approx=True)
         from tpuprof.schema import VariablesView
         stats["variables"] = VariablesView(stats["variables"])
+        if self._quarantine.entries:
+            # degraded runs only — clean snapshots stay byte-identical
+            stats["_quarantine"] = list(self._quarantine.entries)
         if obs.enabled():
             stats["_obs"] = obs.snapshot_if_enabled()
         return stats
@@ -397,13 +456,18 @@ class StreamingProfiler:
             "sample": self._sample,
             "schema": self.arrow_schema.serialize().to_pybytes(),
         }
+        if self._quarantine.entries:
+            # degraded streams stay degraded across restore; clean-run
+            # payloads keep the pre-quarantine byte layout
+            host_blob["quarantine"] = list(self._quarantine.entries)
         from tpuprof import native
         ckpt.save(path, self.state, host_blob, self.cursor,
                   meta={"n_num": self.plan.n_num, "n_hash": self.plan.n_hash,
                         "batch_rows": self.config.batch_rows,
                         "has_state": self.state is not None,
                         # HLL registers only merge with same-impl hashes
-                        "native_hash": native.available()})
+                        "native_hash": native.available()},
+                  keep=self._ckpt_keep)
         # runs demoted since the previous save are no longer referenced
         # by any artifact — reclaim their disk now
         self.hostagg.unique.reap_retired()
@@ -417,7 +481,13 @@ class StreamingProfiler:
         as a context manager) once the stream is done, or the runs —
         8 bytes/row/column — persist until manually deleted.  Snapshots
         are invalid after close (the exact-UNIQUE state is gone);
-        take a final ``stats()``/``report_html()`` first."""
+        take a final ``stats()``/``report_html()`` first.
+
+        Idempotent: a second close (``__exit__`` after an explicit
+        close, cleanup retries after a raising drain) is a no-op."""
+        if self._closed:
+            return
+        self._closed = True
         self.hostagg.unique.cleanup()
 
     def __enter__(self) -> "StreamingProfiler":
@@ -435,8 +505,14 @@ class StreamingProfiler:
     @classmethod
     def restore(cls, path: str, config: Optional[ProfilerConfig] = None,
                 devices: Optional[Sequence] = None) -> "StreamingProfiler":
-        """Rebuild a profiler from a checkpoint and continue streaming."""
-        payload = ckpt.load_payload(path)
+        """Rebuild a profiler from a checkpoint and continue streaming.
+
+        The artifact's retention chain (``path``, ``path.1``, ...) is
+        walked newest-first: a corrupt head falls back to the previous
+        integral generation (``checkpoint_fallback`` event) instead of
+        dying; only a fully-corrupt chain raises
+        :class:`CorruptCheckpointError`."""
+        payload, _, _used = ckpt.restore_payload(path)
         host_blob = payload["host_blob"]
         from tpuprof import native
         saved_native = payload["meta"].get("native_hash")
@@ -480,4 +556,7 @@ class StreamingProfiler:
         prof.host_hll = saved_hll
         prof._sample = host_blob["sample"]
         prof.cursor = payload["cursor"]
+        # a degraded stream stays flagged after restore (absent key =
+        # clean run, the historical layout)
+        prof._quarantine.seed(host_blob.get("quarantine"))
         return prof

@@ -1,0 +1,3 @@
+"""Test-support utilities that ship with the package (deterministic
+fault injection lives here so the CLI/env path can activate it in any
+process, not just under pytest)."""

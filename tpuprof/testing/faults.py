@@ -1,0 +1,269 @@
+"""Deterministic fault injection (ROBUSTNESS.md "fault sites").
+
+The runtime's recovery paths — retry, quarantine, checkpoint fallback,
+watchdogs — are driven in tests (and reproducible field debugging) by
+injecting failures at NAMED SITES.  A site is a string the runtime
+passes to :func:`hit` at the instant the failure would occur; the
+active :class:`FaultPlan` decides whether that call raises, sleeps, or
+passes.  With no plan installed every hook is one ``is None`` check —
+the clean path stays within the <1% guardrail budget
+(``benchmarks/run.py faults``).
+
+Sites wired today (grep ``faults.hit`` / ``faults.mangle``):
+
+========================  ==================================================
+``prep``                  per-batch host prepare (retried; quarantinable)
+``fold``                  per-batch fold into device/host state (quarantinable)
+``checkpoint_write``      inside ``checkpoint.save``'s tmp-file write
+``device_wait``           the watched device drain (``block_until_ready``)
+``barrier``               the watched multi-host resume barrier
+========================  ==================================================
+
+Spec grammar (config/env-driven; ``TPUPROF_FAULTS`` +
+``TPUPROF_FAULTS_SEED``)::
+
+    TPUPROF_FAULTS="prep:0.05,checkpoint_write:1@3,fold:transient"
+
+``site:mode`` pairs, comma-separated; modes:
+
+* ``0.05`` — raise :class:`TransientError` with probability p per
+  attempt.  Keyed calls (the runtime passes the batch cursor/position)
+  draw from ``hash(seed, site, key, attempt)`` so the injected set is
+  a pure function of the seed — identical under any thread count or
+  retry schedule.
+* ``N@M`` — raise :class:`TransientError` on N consecutive first
+  attempts starting at the M-th (1-based).  Exact for single-threaded
+  sites (fold, checkpoint_write); under parallel prep the arrival
+  order decides which batches land in the window.
+* ``fatal@M`` — like ``1@M`` but raises ``RuntimeError`` (never
+  retried, never classified transient).
+* ``transient`` — every batch's FIRST attempt raises
+  :class:`TransientError`; retries succeed.  The retry layer's
+  happy-path exerciser.
+* ``truncate@M`` — for byte-producing sites (``checkpoint_write``):
+  :func:`mangle` drops the second half of the payload on the M-th
+  call, simulating a torn write that still survived the rename.
+* ``sleep=S`` — delay S seconds on every call (watchdog tests).
+
+``injected()`` reports how many raises each site actually produced, so
+tests can assert quarantine counts match the injection count exactly.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from tpuprof.errors import TransientError
+
+_ENV_SPEC = "TPUPROF_FAULTS"
+_ENV_SEED = "TPUPROF_FAULTS_SEED"
+
+
+class _Rule:
+    """One site's injection rule (parsed from a ``site:mode`` pair)."""
+
+    def __init__(self, site: str, mode: str):
+        self.site = site
+        self.kind: str
+        self.p = 0.0
+        self.count = 0          # window width (N@M)
+        self.start = 0          # window start, 1-based (N@M)
+        self.sleep_s = 0.0
+        mode = mode.strip()
+        if mode == "transient":
+            self.kind = "transient"
+        elif mode.startswith("sleep="):
+            self.kind = "sleep"
+            self.sleep_s = float(mode[len("sleep="):])
+        elif "@" in mode:
+            left, at = mode.split("@", 1)
+            self.start = int(at)
+            if left == "fatal":
+                self.kind, self.count = "fatal", 1
+            elif left == "truncate":
+                self.kind, self.count = "truncate", 1
+            else:
+                self.kind, self.count = "window", int(left)
+            if self.start < 1 or self.count < 1:
+                raise ValueError(f"fault window must be >=1: {mode!r}")
+        else:
+            self.kind = "p"
+            self.p = float(mode)
+            if not 0.0 < self.p <= 1.0:
+                raise ValueError(f"fault probability out of (0,1]: {mode!r}")
+        # mutable state (guarded by the plan lock)
+        self.calls = 0              # every hit() at this site
+        self.firsts = 0             # first attempts only (window counting)
+        self.attempts: Dict[Any, int] = {}   # per-key attempt numbers
+        self.rng = None             # lazily seeded sequential RNG (no key)
+
+
+class FaultPlan:
+    """Parsed, seeded injection plan.  Thread-safe."""
+
+    def __init__(self, rules: Dict[str, _Rule], seed: int = 0):
+        self.rules = rules
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._injected: Dict[str, int] = {}
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        rules: Dict[str, _Rule] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" not in part:
+                raise ValueError(
+                    f"fault spec needs site:mode, got {part!r}")
+            site, mode = part.split(":", 1)
+            rules[site.strip()] = _Rule(site.strip(), mode)
+        return cls(rules, seed=seed)
+
+    def injected(self, site: Optional[str] = None):
+        with self._lock:
+            if site is not None:
+                return self._injected.get(site, 0)
+            return dict(self._injected)
+
+    def _record(self, site: str) -> None:
+        self._injected[site] = self._injected.get(site, 0) + 1
+
+    def fire(self, site: str, key: Any = None) -> None:
+        """Decide this call's fate: return (pass), sleep, or raise."""
+        rule = self.rules.get(site)
+        if rule is None:
+            return
+        if rule.kind == "truncate":
+            return      # counted by mangle_bytes, where the bytes are
+        with self._lock:
+            rule.calls += 1
+            call_no = rule.calls
+            if key is not None:
+                att = rule.attempts.get(key, 0)
+                rule.attempts[key] = att + 1
+            else:
+                att = 0
+            first = att == 0
+            if first:
+                rule.firsts += 1
+            first_no = rule.firsts
+            if rule.kind == "sleep":
+                pass                         # sleep outside the lock
+            elif rule.kind == "p":
+                if key is not None:
+                    # order-free determinism: one draw per (key, attempt)
+                    draw = random.Random(
+                        repr((self.seed, site, key, att))).random()
+                else:
+                    if rule.rng is None:
+                        rule.rng = random.Random(
+                            repr((self.seed, site)))
+                    draw = rule.rng.random()
+                if draw < rule.p:
+                    self._record(site)
+                    raise TransientError(
+                        f"injected transient fault at {site!r} "
+                        f"(key={key!r}, attempt={att})")
+            elif rule.kind == "transient":
+                odd = call_no % 2 == 1
+                if (first and key is not None) or (key is None and odd):
+                    self._record(site)
+                    raise TransientError(
+                        f"injected transient fault at {site!r} "
+                        f"(key={key!r}, first attempt)")
+            elif rule.kind in ("window", "fatal"):
+                n = first_no if key is not None else call_no
+                if first and rule.start <= n < rule.start + rule.count \
+                        or key is None \
+                        and rule.start <= n < rule.start + rule.count:
+                    self._record(site)
+                    if rule.kind == "fatal":
+                        raise RuntimeError(
+                            f"injected fatal fault at {site!r} "
+                            f"(call {n})")
+                    raise TransientError(
+                        f"injected transient fault at {site!r} "
+                        f"(call {n})")
+            # "truncate" never raises in fire(); mangle() applies it
+        if rule.kind == "sleep":
+            time.sleep(rule.sleep_s)
+
+    def mangle_bytes(self, site: str, data: bytes) -> bytes:
+        rule = self.rules.get(site)
+        if rule is None or rule.kind != "truncate":
+            return data
+        with self._lock:
+            rule.calls += 1
+            if rule.start <= rule.calls < rule.start + rule.count:
+                self._record(site)
+                return data[: len(data) // 2]
+        return data
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def configure(spec: Optional[str] = None,
+              seed: Optional[int] = None) -> Optional[FaultPlan]:
+    """Install a plan from ``spec`` (None/"" clears; env defaults)."""
+    global _plan
+    if spec is None:
+        spec = os.environ.get(_ENV_SPEC) or ""
+    if seed is None:
+        seed = int(os.environ.get(_ENV_SEED, "0") or 0)
+    _plan = FaultPlan.from_spec(spec, seed=seed) if spec else None
+    return _plan
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    global _plan
+    _plan = plan
+
+
+def reset() -> None:
+    global _plan
+    _plan = None
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def injected(site: Optional[str] = None):
+    """Raise counts by site (0/{} with no plan) — test assertions."""
+    p = _plan
+    if p is None:
+        return 0 if site is not None else {}
+    return p.injected(site)
+
+
+def hit(site: str, key: Any = None) -> None:
+    """The runtime hook: no-op unless a plan targets ``site``."""
+    p = _plan
+    if p is None:
+        return
+    p.fire(site, key=key)
+
+
+def mangle(site: str, data: bytes) -> bytes:
+    """Byte-corruption hook for writer sites (checkpoint_write)."""
+    p = _plan
+    if p is None:
+        return data
+    return p.mangle_bytes(site, data)
+
+
+# env-driven activation: a process launched with TPUPROF_FAULTS set
+# (CLI runs, subprocess tests) injects without any code cooperation
+if os.environ.get(_ENV_SPEC):
+    configure()
