@@ -294,6 +294,12 @@ def main() -> None:
     predicted = 1.0 / (1.0 / rate_a + 1.0 / rate_b)
 
     phases = obs.get_phase_report()
+    # device-memory headroom after the e2e runs (ISSUE 5): in_use summed
+    # across the chips the bench touched — 0 on backends without
+    # memory_stats() (the CPU fallback lane)
+    mem = obs.memory.sample(devices)
+    device_mem_in_use = sum(e.get("in_use", 0)
+                            for e in mem["devices"].values())
     snap = obs.registry().snapshot()
     disp = snap["counters"].get("tpuprof_device_dispatch_total", {})
 
@@ -343,6 +349,10 @@ def main() -> None:
         # prepare loop + the v5 checkpoint CRC throughput
         "guardrail_overhead_pct": guardrail["guardrail_overhead_pct"],
         "checkpoint_crc_gbps": guardrail["checkpoint_crc_gbps"],
+        # flight-recorder cost on the prepare leg (ISSUE 5 acceptance:
+        # < 0.5%) + HBM in use after the e2e runs (0 = no memory_stats)
+        "blackbox_overhead_pct": guardrail["blackbox_overhead_pct"],
+        "device_mem_in_use_bytes": int(device_mem_in_use),
         # per-stage breakdown (obs spans; NEW keys only — existing keys
         # above keep their names so BENCH_r* comparisons stay valid)
         "stage_prep_s": round(phases.get("prep", 0.0), 3),

@@ -479,6 +479,20 @@ def measure_guardrail(rows: int = 1 << 17, repeats: int = 3) -> dict:
         guard.watched(lambda: None, 5.0, site="bench")
     watched_us = (_time.perf_counter() - t0) / 50 * 1e6
 
+    # flight recorder (ISSUE 5): per-event ring-append cost against the
+    # per-batch prepare it instruments.  The production rate on the
+    # prepare leg is ~2 records/batch (dispatch milestone + span close,
+    # both batch-granular, never per-row), so the overhead bound is
+    # 2 * record cost / prepare cost — acceptance: < 0.5%.
+    from tpuprof.obs.blackbox import BlackBox
+    box = BlackBox(512)
+    reps_bb = 20000
+    t0 = _time.perf_counter()
+    for k in range(reps_bb):
+        box.record("dispatch", program="scan_a", key=k)
+    record_s = (_time.perf_counter() - t0) / reps_bb
+    blackbox_pct = 2 * record_s / prep_batch_s * 100.0
+
     return {
         "rows": rows, "cols": table.num_columns,
         "rows_per_sec": round(guarded, 1),      # generic delta column
@@ -489,6 +503,8 @@ def measure_guardrail(rows: int = 1 << 17, repeats: int = 3) -> dict:
         "guardrail_overhead_pct": round(overhead_pct, 4),
         "checkpoint_crc_gbps": round(crc_gbps, 2),
         "watchdog_watched_call_us": round(watched_us, 1),
+        "blackbox_record_us": round(record_s * 1e6, 3),
+        "blackbox_overhead_pct": round(blackbox_pct, 4),
     }
 
 

@@ -65,6 +65,19 @@ for _k, _v in _prev.items():
         os.environ[_k] = _v
 
 
+# The crash flight recorder (obs/blackbox.py) dumps postmortem bundles
+# into TPUPROF_POSTMORTEM_DIR (default: cwd).  In-process CLI tests that
+# exercise typed-error exits (corrupt checkpoint -> 3, watchdog -> 4)
+# would otherwise litter tpuprof-postmortem-*.json into the repo root;
+# point the default at a session-scoped scratch dir.  Tests that assert
+# on the bundles override this per-test (monkeypatch / subprocess env).
+import tempfile as _tempfile
+
+os.environ.setdefault(
+    "TPUPROF_POSTMORTEM_DIR",
+    _tempfile.mkdtemp(prefix="tpuprof-postmortem-tests-"))
+
+
 def pytest_collection_modifyitems(config, items):
     if _TPU_LANE:
         return

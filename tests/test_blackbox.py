@@ -1,0 +1,249 @@
+"""Crash flight recorder (ISSUE 5, obs/blackbox.py): ring semantics,
+always-on recording with metrics off, postmortem bundles, signal
+handlers, and the CLI crash path."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof.obs import blackbox, events, metrics
+from tpuprof.obs.blackbox import BlackBox
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+def test_ring_wraparound_keeps_newest():
+    box = BlackBox(capacity=4)
+    for i in range(10):
+        box.record("tick", i=i)
+    entries = box.entries()
+    assert len(entries) == 4
+    assert [e["i"] for e in entries] == [6, 7, 8, 9]
+    # sequence numbers are global, so the dump can say how many dropped
+    assert [e["seq"] for e in entries] == [7, 8, 9, 10]
+    snap = box.snapshot()
+    assert snap["recorded"] == 10 and snap["dropped"] == 6
+
+
+def test_zero_capacity_disables_recording():
+    box = BlackBox(capacity=0)
+    assert not box.enabled
+    box.record("tick")
+    box.set_context(a=1)
+    assert box.entries() == []
+    assert box.dump() is None
+
+
+def test_env_capacity_parsing(monkeypatch):
+    from tpuprof.obs.blackbox import DEFAULT_CAPACITY, _env_capacity
+    monkeypatch.delenv("TPUPROF_BLACKBOX", raising=False)
+    assert _env_capacity() == DEFAULT_CAPACITY
+    monkeypatch.setenv("TPUPROF_BLACKBOX", "0")
+    assert _env_capacity() == 0
+    monkeypatch.setenv("TPUPROF_BLACKBOX", "64")
+    assert _env_capacity() == 64
+    monkeypatch.setenv("TPUPROF_BLACKBOX", "nonsense")
+    assert _env_capacity() == DEFAULT_CAPACITY
+
+
+def test_events_emit_records_with_metrics_off():
+    """The recorder's whole point: obs events land in the ring even when
+    metrics are disabled and no JSONL sink exists."""
+    prev = metrics.enabled()
+    metrics.set_enabled(False)
+    events.set_sink(None)
+    try:
+        box = blackbox.box()
+        before = box.snapshot()["recorded"]
+        events.emit("batch_quarantined", site="prep", error="boom")
+        entries = box.entries()
+        assert box.snapshot()["recorded"] == before + 1
+        assert entries[-1]["kind"] == "batch_quarantined"
+        assert entries[-1]["site"] == "prep"
+    finally:
+        metrics.set_enabled(prev)
+
+
+def test_span_close_lands_in_ring():
+    from tpuprof.obs.spans import span
+    box = blackbox.box()
+    before = box.snapshot()["recorded"]
+    with span("bbx_test_stage", rows=5):
+        pass
+    entries = box.entries()
+    assert box.snapshot()["recorded"] == before + 1
+    assert entries[-1]["kind"] == "span"
+    assert entries[-1]["name"] == "bbx_test_stage"
+
+
+def test_batch_guard_escalation_names_site_in_ring():
+    from tpuprof.runtime import guard
+    box = blackbox.box()
+    bg = guard.BatchGuard(retries=0, capture=True)
+    poison = bg.run(lambda: (_ for _ in ()).throw(RuntimeError("bad")),
+                    site="prep", key=7)
+    assert isinstance(poison, guard.PoisonBatch)
+    last = [e for e in box.entries() if e["kind"] == "batch_failed"][-1]
+    assert last["site"] == "prep" and last["key"] == 7
+    assert "bad" in last["error"]
+
+
+# ---------------------------------------------------------------------------
+# postmortem bundle
+# ---------------------------------------------------------------------------
+
+def test_dump_bundle_schema(tmp_path):
+    box = BlackBox(capacity=8)
+    box.set_context(process_index=0, config_fingerprint="abc123")
+    box.record("dispatch", program="scan_a", payload=np.int64(3))
+    path = str(tmp_path / "pm.json")
+    err = ValueError("torn artifact")
+    assert box.dump(path=path, error=err) == path
+    bundle = json.load(open(path))
+    assert bundle["schema"] == "tpuprof-postmortem-v1"
+    assert bundle["pid"] == os.getpid()
+    assert bundle["error"] == {"type": "ValueError",
+                               "message": "torn artifact"}
+    assert bundle["context"]["config_fingerprint"] == "abc123"
+    assert bundle["entries"][-1]["kind"] == "dispatch"
+    # numpy payloads were coerced, not fatal
+    assert bundle["entries"][-1]["payload"] in (3, "3")
+
+
+def test_dump_default_path_honors_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUPROF_POSTMORTEM_DIR", str(tmp_path))
+    box = BlackBox(capacity=4)
+    box.record("tick")
+    out = box.dump(reason="test")
+    assert out == str(tmp_path / f"tpuprof-postmortem-{os.getpid()}.json")
+    assert json.load(open(out))["reason"] == "test"
+
+
+# ---------------------------------------------------------------------------
+# signals
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not hasattr(signal, "SIGUSR1"),
+                    reason="platform without SIGUSR1")
+def test_sigusr1_dumps_and_continues(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUPROF_POSTMORTEM_DIR", str(tmp_path))
+    prev_usr1 = signal.getsignal(signal.SIGUSR1)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    try:
+        assert blackbox.install_signal_handlers()
+        blackbox.record("before_signal", i=1)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        out = tmp_path / f"tpuprof-postmortem-{os.getpid()}.json"
+        assert out.exists()             # dumped ...
+        bundle = json.load(open(out))
+        assert bundle["signal"] == "SIGUSR1"
+        assert any(e["kind"] == "before_signal"
+                   for e in bundle["entries"])
+    finally:                            # ... and the process lives on
+        signal.signal(signal.SIGUSR1, prev_usr1)
+        signal.signal(signal.SIGTERM, prev_term)
+
+
+_TERM_WORKER = r"""
+import os, signal, sys, time
+sys.path.insert(0, sys.argv[1])
+os.environ["TPUPROF_POSTMORTEM_DIR"] = sys.argv[2]
+from tpuprof.obs import blackbox
+blackbox.record("worker_started")
+assert blackbox.install_signal_handlers()
+print("ready", flush=True)
+time.sleep(60)
+"""
+
+
+def test_sigterm_dumps_and_dies_by_signal(tmp_path):
+    worker = tmp_path / "term_worker.py"
+    worker.write_text(_TERM_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, str(worker), repo, str(tmp_path)],
+        stdout=subprocess.PIPE, text=True)
+    assert proc.stdout.readline().strip() == "ready"
+    proc.terminate()                    # SIGTERM
+    proc.wait(timeout=30)
+    # default disposition restored + re-raised: died BY the signal
+    assert proc.returncode == -signal.SIGTERM
+    pm = list(tmp_path.glob("tpuprof-postmortem-*.json"))
+    assert len(pm) == 1
+    bundle = json.load(open(pm[0]))
+    assert bundle["signal"] == "SIGTERM"
+    assert any(e["kind"] == "worker_started" for e in bundle["entries"])
+
+
+# ---------------------------------------------------------------------------
+# CLI crash path (acceptance: a fault-injected crashed run leaves a
+# parseable postmortem whose last ring entries name the failing site)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+@pytest.mark.faults
+def test_cli_crash_leaves_postmortem(tmp_path):
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({"a": rng.normal(size=4000),
+                       "c": rng.choice(["x", "y"], 4000)})
+    src = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), src)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TPUPROF_POSTMORTEM_DIR=str(tmp_path),
+               # two permanently-failing batches against a budget of 1:
+               # the second admit exhausts the quarantine and raises
+               # PoisonBatchError (exit 5)
+               TPUPROF_FAULTS="prep:2@1")
+    env.pop("TPUPROF_METRICS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuprof", "profile", src,
+         "-o", str(tmp_path / "r.html"), "--backend", "tpu",
+         "--batch-rows", "512", "--no-compile-cache",
+         "--ingest-retries", "0", "--max-quarantined", "1"],
+        env=env, capture_output=True, text=True, timeout=420,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 5, proc.stderr[-3000:]
+    assert "tpuprof: error:" in proc.stderr
+
+    pm = list(tmp_path.glob("tpuprof-postmortem-*.json"))
+    assert len(pm) == 1, proc.stderr[-2000:]
+    bundle = json.load(open(pm[0]))
+    assert bundle["error"]["type"] == "PoisonBatchError"
+    # the ring's recent entries name the failing site
+    sites = [e.get("site") for e in bundle["entries"]
+             if e["kind"] in ("batch_failed", "batch_quarantined")]
+    assert "prep" in sites
+    assert bundle["context"].get("config_fingerprint")
+
+
+@pytest.mark.smoke
+def test_cli_blackbox_disabled_leaves_nothing(tmp_path):
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({"a": rng.normal(size=2000)})
+    src = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), src)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TPUPROF_POSTMORTEM_DIR=str(tmp_path),
+               TPUPROF_BLACKBOX="0",
+               TPUPROF_FAULTS="prep:2@1")
+    env.pop("TPUPROF_METRICS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuprof", "profile", src,
+         "-o", str(tmp_path / "r.html"), "--backend", "tpu",
+         "--batch-rows", "512", "--no-compile-cache",
+         "--ingest-retries", "0", "--max-quarantined", "1"],
+        env=env, capture_output=True, text=True, timeout=420,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 5, proc.stderr[-3000:]
+    assert not list(tmp_path.glob("tpuprof-postmortem-*.json"))

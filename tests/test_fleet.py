@@ -1,0 +1,153 @@
+"""Fleet-wide metric aggregation (ISSUE 5): the registry wire form's
+merge laws, the fleet writer, and the single-process publish path
+(the two-process proof lives in tests/test_multiprocess.py)."""
+
+import json
+import os
+
+import pytest
+
+from tpuprof.obs import events, fleet, metrics
+from tpuprof.obs.metrics import MetricsRegistry
+
+
+def _host_registry(rows: float, depth: float, drains) -> MetricsRegistry:
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("rows_total", "rows").inc(rows)
+    reg.counter("quarantined_total", "skips").inc(1, site="prep")
+    reg.gauge("queue_depth", "depth").set(depth)
+    h = reg.histogram("drain_seconds", "drains", buckets=(0.1, 1.0))
+    for v in drains:
+        h.observe(v)
+    return reg
+
+
+def test_merge_wire_counters_sum():
+    merged = fleet.merge_wires([
+        _host_registry(100, 3, [0.05]).to_wire(),
+        _host_registry(250, 7, [0.5]).to_wire(),
+    ])
+    assert merged.counter("rows_total").total() == 350
+    assert merged.counter("quarantined_total").value(site="prep") == 2
+
+
+def test_merge_wire_gauges_keep_per_host_values():
+    merged = fleet.merge_wires([
+        _host_registry(1, 3, []).to_wire(),
+        _host_registry(1, 7, []).to_wire(),
+    ])
+    g = merged.gauge("queue_depth")
+    assert g.value(host="0") == 3
+    assert g.value(host="1") == 7
+    # no un-labelled sum was fabricated
+    assert g.value() == 0
+
+
+def test_merge_wire_histograms_sum_bucket_ladders():
+    merged = fleet.merge_wires([
+        _host_registry(1, 0, [0.05, 0.5]).to_wire(),
+        _host_registry(1, 0, [0.5, 5.0]).to_wire(),
+    ])
+    h = merged.histogram("drain_seconds", buckets=(0.1, 1.0))
+    s = h.summary()
+    assert s["count"] == 4
+    assert s["sum"] == pytest.approx(6.05)
+    # per-bucket counts summed, not concatenated: <=0.1 holds exactly 1
+    st = h._series[()]
+    assert st["buckets"] == [1, 2]          # (<=0.1)=1, (0.1..1]=2
+
+
+def test_merge_wire_mismatched_ladder_degrades_to_per_host():
+    a = MetricsRegistry(enabled=True)
+    a.histogram("h", buckets=(0.1, 1.0)).observe(0.5)
+    b = MetricsRegistry(enabled=True)
+    b.histogram("h", buckets=(0.2, 2.0)).observe(0.5)
+    merged = MetricsRegistry(enabled=True)
+    merged.merge_wire(a.to_wire(), host="0")
+    merged.merge_wire(b.to_wire(), host="1")
+    h = merged._instruments["h"]
+    # host 0's ladder won the declaration; host 1's skewed series is
+    # kept intact under host="1" instead of mis-summed into the buckets
+    assert h.summary()["count"] == 1
+    assert h.summary(host="1")["count"] == 1
+
+
+def test_merged_registry_renders_and_snapshots():
+    merged = fleet.merge_wires([
+        _host_registry(100, 3, [0.05]).to_wire(),
+        _host_registry(200, 4, [0.5]).to_wire(),
+    ])
+    text = merged.render_text()
+    assert "rows_total 300" in text
+    assert 'queue_depth{host="0"} 3' in text
+    assert 'queue_depth{host="1"} 4' in text
+    json.dumps(merged.snapshot())       # JSON-clean
+
+
+def test_to_wire_is_picklable_and_json_clean():
+    import pickle
+    wire = _host_registry(10, 1, [0.2]).to_wire()
+    assert pickle.loads(pickle.dumps(wire)) == wire
+    json.dumps(wire)
+
+
+def test_write_fleet_writes_prom_and_event(tmp_path):
+    mpath = str(tmp_path / "m.jsonl")
+    events.set_sink(mpath)
+    try:
+        wires = [_host_registry(5, 1, []).to_wire(),
+                 _host_registry(7, 2, []).to_wire()]
+        out = fleet.write_fleet(mpath, wires, reason="test",
+                                quarantined_by_host=[0, 3])
+        assert out == mpath + ".fleet.prom"
+        text = open(out).read()
+        assert "rows_total 12" in text
+        evs = [json.loads(l) for l in open(mpath)]
+        fs = [e for e in evs if e["kind"] == "fleet_snapshot"]
+        assert len(fs) == 1
+        assert fs[0]["hosts"] == 2
+        assert fs[0]["quarantined_by_host"] == [0, 3]
+        assert fs[0]["snapshot"]["counters"]["rows_total"][""] == 12
+    finally:
+        events.set_sink(None)
+
+
+def test_write_fleet_without_path_still_emits_event(tmp_path):
+    mpath = str(tmp_path / "m.jsonl")
+    events.set_sink(mpath)
+    try:
+        out = fleet.write_fleet(None, [_host_registry(5, 1, []).to_wire()],
+                                reason="test")
+        assert out is None
+        evs = [json.loads(l) for l in open(mpath)]
+        assert any(e["kind"] == "fleet_snapshot" for e in evs)
+    finally:
+        events.set_sink(None)
+
+
+def test_publish_fleet_single_process(tmp_path):
+    """publish_fleet degrades to a local gather at process_count()==1
+    and still writes the fleet exposition next to the metrics path."""
+    from tpuprof.runtime.distributed import publish_fleet
+    prev = metrics.enabled()
+    metrics.registry().reset()
+    metrics.set_enabled(True)
+    try:
+        metrics.counter("tpuprof_test_fleet_total").inc(42)
+        mpath = str(tmp_path / "m.jsonl")
+        out = publish_fleet("test", metrics_path=mpath, quarantined=0)
+        assert out == mpath + ".fleet.prom"
+        assert "tpuprof_test_fleet_total 42" in open(out).read()
+    finally:
+        metrics.set_enabled(prev)
+        metrics.registry().reset()
+
+
+def test_escaped_labels_survive_fleet_render():
+    """Satellite bugfix: label values holding quotes/backslashes/newlines
+    render spec-escaped, including through the fleet merge."""
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("c_total").inc(1, path='a"b\\c\nd')
+    merged = fleet.merge_wires([reg.to_wire()])
+    text = merged.render_text()
+    assert 'c_total{path="a\\"b\\\\c\\nd"} 1' in text

@@ -277,6 +277,109 @@ def test_two_process_exact_unique_with_shared_spill(tmp_path):
     assert not list(spill.glob("*.u64"))
 
 
+_FLEET_WORKER = r"""
+import os, sys, json
+pid = int(sys.argv[1]); port = sys.argv[2]
+ds = sys.argv[3]; out = sys.argv[4]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+# one poison batch per process: the 2nd per-host prep attempt fails
+# fatally (never retried), lands in quarantine, and must show up SUMMED
+# in the fleet exposition
+os.environ["TPUPROF_FAULTS"] = "prep:fatal@2"
+sys.path.insert(0, sys.argv[5])
+import jax
+jax.config.update("jax_platforms", "cpu")
+# this jaxlib's CPU client ships without default multiprocess
+# collectives; the gloo TCP implementation is compiled in and just
+# needs selecting before the backend initializes
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=2, process_id=pid)
+from tpuprof import ProfilerConfig
+from tpuprof.backends.tpu import TPUStatsBackend
+from tpuprof.obs import metrics
+stats = TPUStatsBackend().collect(
+    ds, ProfilerConfig(backend="tpu", batch_rows=512,
+                       ingest_retries=0, max_quarantined=4,
+                       metrics_enabled=True,
+                       metrics_path=out + ".events.jsonl"))
+reg = metrics.registry()
+disp = sum(v for k, v in
+           reg.counter("tpuprof_device_dispatch_total").items()
+           if not any(lv.endswith("_batches") for _, lv in k))
+json.dump({
+    "n": stats["table"]["n"],
+    "rows_total": reg.counter("tpuprof_ingest_rows_total").total(),
+    "dispatch_total": disp,
+    "quarantined_total": reg.counter(
+        "tpuprof_batches_quarantined_total").total(),
+    "fleet_quarantine_entries": len(stats.get("_quarantine") or []),
+}, open(out, "w"))
+"""
+
+
+def test_two_process_fleet_prom_sums_hosts(tmp_path):
+    """ISSUE 5 acceptance: host 0's ``<metrics>.fleet.prom`` counter
+    values equal the SUM of the per-host registries — rows, device
+    dispatches, and (fault-injected) quarantines — and gauges carry the
+    ``host=`` label."""
+    rng = np.random.default_rng(3)
+    ds_dir = tmp_path / "ds"
+    ds_dir.mkdir()
+    for f in range(4):
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "a": rng.normal(5, 2, 2000),
+            "c": rng.choice(["x", "y", "z"], 2000),
+        }), preserve_index=False), str(ds_dir / f"p{f}.parquet"))
+
+    worker = tmp_path / "fleet_worker.py"
+    worker.write_text(_FLEET_WORKER)
+    port = str(_free_port())
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env.pop("TPUPROF_METRICS", None)
+    outs = [str(tmp_path / f"f{i}.json") for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i), port, str(ds_dir),
+         outs[i], repo],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        assert p.returncode == 0, out.decode()[-2000:]
+    results = [json.load(open(o)) for o in outs]
+
+    # each host quarantined exactly its one injected poison batch, and
+    # the report-level manifest (gathered across hosts) sees both
+    assert [r["quarantined_total"] for r in results] == [1, 1]
+    assert all(r["fleet_quarantine_entries"] == 2 for r in results)
+
+    from test_obs_smoke import parse_prom
+    fleet_path = outs[0] + ".events.jsonl.fleet.prom"
+    assert os.path.exists(fleet_path), "host 0 did not write the fleet dump"
+    fleet = parse_prom(open(fleet_path).read())
+
+    def fleet_total(name, drop_batches=False):
+        return sum(v for n, l, v in fleet[name]["samples"]
+                   if not (drop_batches and n.endswith("_batches"))
+                   and not any(lv.endswith("_batches")
+                               for lv in l.values()))
+
+    # counters sum across hosts — the single-file fleet view
+    assert fleet_total("tpuprof_ingest_rows_total") == \
+        sum(r["rows_total"] for r in results)
+    assert fleet_total("tpuprof_device_dispatch_total") == \
+        sum(r["dispatch_total"] for r in results)
+    assert fleet_total("tpuprof_batches_quarantined_total") == 2
+    # gauges keep per-host identity
+    hosts = {l.get("host") for _, l, _ in
+             fleet["tpuprof_host_rss_bytes"]["samples"]}
+    assert hosts == {"0", "1"}
+    # host 1 computed its shard but must NOT have written a fleet file
+    assert not os.path.exists(outs[1] + ".events.jsonl.fleet.prom")
+
+
 _CKPT_WORKER = r"""
 import os, sys, json
 pid = int(sys.argv[1]); port = sys.argv[2]

@@ -402,3 +402,124 @@ def test_resolve_metrics_enabled_env(monkeypatch):
     assert resolve_metrics_enabled(None, None) is False
     # explicit config beats the env either way
     assert resolve_metrics_enabled(True, None) is True
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5 satellites: label escaping, sink rotation, concurrency under
+# fault injection
+# ---------------------------------------------------------------------------
+
+def test_label_value_prometheus_escaping():
+    """Regression: backslash, double-quote and newline in label values
+    must render spec-escaped (they used to tear the sample line)."""
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("c_total").inc(1, path='we"ird\\lab\nel')
+    text = reg.render_text()
+    assert 'c_total{path="we\\"ird\\\\lab\\nel"} 1' in text
+    # one logical line per sample — the newline did not split it
+    sample_lines = [l for l in text.splitlines()
+                    if l.startswith("c_total{")]
+    assert len(sample_lines) == 1
+    # escaping is render-only: the stored key keeps the raw value
+    assert reg.counter("c_total").value(path='we"ird\\lab\nel') == 1
+
+
+def test_jsonl_sink_rotates_at_max_bytes(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    sink = events.JsonlSink(path, max_bytes=400)
+    try:
+        for i in range(50):
+            sink.write({"kind": "tick", "i": i})
+    finally:
+        sink.close()
+    rotated = tmp_path / "m.jsonl.1"
+    assert rotated.exists()
+    # both generations hold valid JSONL, caps respected (~2x bound)
+    import os as _os
+    assert _os.path.getsize(path) <= 400
+    assert _os.path.getsize(str(rotated)) <= 400
+    lines = [json.loads(l) for p in (rotated, tmp_path / "m.jsonl")
+             for l in open(p)]
+    # rotation replaced the oldest generation exactly once per cap hit:
+    # the SURVIVING tail is contiguous and ends at the last event
+    assert lines[-1]["i"] == 49
+    idxs = [l["i"] for l in lines]
+    assert idxs == list(range(idxs[0], 50))
+
+
+def test_jsonl_sink_unlimited_by_default(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    sink = events.JsonlSink(path)
+    try:
+        for i in range(200):
+            sink.write({"kind": "tick", "i": i})
+    finally:
+        sink.close()
+    assert not (tmp_path / "m.jsonl.1").exists()
+    assert len(open(path).readlines()) == 200
+
+
+def test_resolve_metrics_max_bytes(monkeypatch):
+    from tpuprof.config import resolve_metrics_max_bytes
+    monkeypatch.delenv("TPUPROF_METRICS_MAX_BYTES", raising=False)
+    assert resolve_metrics_max_bytes(None) is None
+    assert resolve_metrics_max_bytes(1 << 20) == 1 << 20
+    monkeypatch.setenv("TPUPROF_METRICS_MAX_BYTES", "4096")
+    assert resolve_metrics_max_bytes(None) == 4096
+    assert resolve_metrics_max_bytes(123) == 123    # config beats env
+
+
+def test_snapshot_render_concurrent_with_fault_injection(obs_enabled):
+    """Registry reads must never raise or tear while the fault-injection
+    plan is firing retries and quarantines from worker threads (ISSUE 5
+    satellite): snapshot()/render_text()/to_wire() under live mutation."""
+    from tpuprof.runtime import guard
+    from tpuprof.testing import faults
+
+    faults.configure("prep:0.5", seed=7)
+    stop = threading.Event()
+    errors = []
+    try:
+        quarantine = guard.Quarantine(max_quarantined=1 << 30)
+        bg = guard.BatchGuard(retries=2, backoff_s=0.0, capture=True)
+
+        def mutate(tid):
+            k = 0
+            while not stop.is_set():
+                out = bg.run(lambda: None, site="prep",
+                             key=(tid, k))
+                if isinstance(out, guard.PoisonBatch):
+                    quarantine.admit(site=out.site, error=out.error)
+                k += 1
+
+        def read():
+            reg = metrics.registry()
+            while not stop.is_set():
+                try:
+                    snap = reg.snapshot()
+                    json.dumps(snap)            # JSON-clean mid-flight
+                    text = reg.render_text()
+                    assert text.endswith("\n")
+                    reg.to_wire()
+                except Exception as exc:        # pragma: no cover
+                    errors.append(exc)
+                    return
+        threads = [threading.Thread(target=mutate, args=(t,))
+                   for t in range(4)]
+        threads += [threading.Thread(target=read) for _ in range(3)]
+        for t in threads:
+            t.start()
+        import time as _time
+        _time.sleep(0.8)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+        # the counters moved while we read (the test exercised something)
+        assert metrics.registry().counter(
+            "tpuprof_ingest_retries_total").total() > 0
+        assert metrics.registry().counter(
+            "tpuprof_batches_quarantined_total").total() > 0
+    finally:
+        stop.set()
+        faults.reset()

@@ -52,7 +52,133 @@ EVENT_SCHEMA = {
                          "timeout_s": ((int, float), True)},
     "ticker_stop_timeout": {"ts": ((int, float), True),
                             "interval": ((int, float), True)},
+    # fleet aggregation (obs/fleet.py): one per publish — collect
+    # finish and each multi-host resume barrier
+    "fleet_snapshot": {"ts": ((int, float), True),
+                       "reason": ((str,), True),
+                       "hosts": ((int,), True),
+                       "quarantined_by_host": ((list,), True),
+                       "snapshot": ((dict,), True)},
 }
+
+
+# ---------------------------------------------------------------------------
+# minimal Prometheus text-exposition parser (ISSUE 5 satellite): enough
+# grammar to validate the full .prom / .fleet.prom dumps — TYPE/HELP
+# pairing, sample<->TYPE consistency, histogram bucket monotonicity —
+# without a prometheus_client dependency
+# ---------------------------------------------------------------------------
+
+import re
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})?'
+    r'\s+(?P<value>[+-]?(?:[0-9.eE+-]+|Inf|NaN))$')
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _base_name(sample_name: str, kind: str) -> str:
+    if kind == "histogram":
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample_name.endswith(suffix):
+                return sample_name[: -len(suffix)]
+    return sample_name
+
+
+def parse_prom(text: str) -> dict:
+    """Parse (and structurally validate) exposition text.  Returns
+    ``{name: {"type", "help", "samples": [(labels_dict, value)]}}`` and
+    asserts on any grammar violation."""
+    metrics_seen: dict = {}
+    pending_help = None
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name = rest.split(" ", 1)[0]
+            assert pending_help is None, \
+                f"line {lineno}: HELP {name} follows an unpaired HELP"
+            pending_help = name
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) >= 4, f"line {lineno}: malformed TYPE"
+            name, kind = parts[2], parts[3]
+            assert kind in ("counter", "gauge", "histogram", "untyped"), \
+                f"line {lineno}: unknown TYPE {kind!r}"
+            assert name not in metrics_seen, \
+                f"line {lineno}: duplicate TYPE for {name}"
+            # HELP, when present, must immediately precede its TYPE
+            assert pending_help in (None, name), \
+                f"line {lineno}: HELP {pending_help} not paired with " \
+                f"TYPE {name}"
+            metrics_seen[name] = {"type": kind,
+                                  "help": pending_help is not None,
+                                  "samples": []}
+            pending_help = None
+            continue
+        assert not line.startswith("#"), \
+            f"line {lineno}: unknown comment {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"line {lineno}: unparseable sample {line!r}"
+        name = m.group("name")
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = ",".join(f'{k}="{v}"'
+                                for k, v in _LABEL_RE.findall(raw))
+            assert consumed == raw, \
+                f"line {lineno}: malformed labels {raw!r}"
+            labels = dict(_LABEL_RE.findall(raw))
+        owner = None
+        for cand, ent in metrics_seen.items():
+            if _base_name(name, ent["type"]) == cand:
+                owner = ent
+                break
+        assert owner is not None, \
+            f"line {lineno}: sample {name!r} precedes (or lacks) its TYPE"
+        value = float(m.group("value").replace("Inf", "inf"))
+        owner["samples"].append((name, labels, value))
+    assert pending_help is None, "trailing HELP without a TYPE"
+
+    # histogram semantics: per label set, cumulative buckets are
+    # monotonically non-decreasing, le=+Inf equals _count, _sum/_count
+    # present exactly once
+    for base, ent in metrics_seen.items():
+        if ent["type"] != "histogram":
+            continue
+        by_key: dict = {}
+        for name, labels, value in ent["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            slot = by_key.setdefault(key, {"buckets": [], "sum": None,
+                                           "count": None})
+            if name == base + "_bucket":
+                assert "le" in labels, f"{base}: bucket without le"
+                slot["buckets"].append(
+                    (float(labels["le"].replace("Inf", "inf")), value))
+            elif name == base + "_sum":
+                assert slot["sum"] is None, f"{base}: duplicate _sum"
+                slot["sum"] = value
+            elif name == base + "_count":
+                assert slot["count"] is None, f"{base}: duplicate _count"
+                slot["count"] = value
+        for key, slot in by_key.items():
+            assert slot["sum"] is not None and slot["count"] is not None, \
+                f"{base}{dict(key)}: missing _sum/_count"
+            buckets = sorted(slot["buckets"])
+            assert buckets, f"{base}{dict(key)}: no buckets"
+            cum = [v for _, v in buckets]
+            assert all(b >= a for a, b in zip(cum, cum[1:])), \
+                f"{base}{dict(key)}: buckets not monotone: {cum}"
+            assert buckets[-1][0] == float("inf"), \
+                f"{base}{dict(key)}: no +Inf bucket"
+            assert buckets[-1][1] == slot["count"], \
+                f"{base}{dict(key)}: +Inf bucket != _count"
+    return metrics_seen
 
 
 def validate_event(ev: dict) -> None:
@@ -124,11 +250,29 @@ def test_cli_metrics_json_smoke(tmp_path):
     # two passes over 1500 rows: the final snapshot counts both scans
     assert max(rows) >= n
 
-    # the Prometheus twin landed next to the JSONL and parses as
-    # exposition text
+    # the Prometheus twin landed next to the JSONL and the FULL dump
+    # survives the exposition parser (TYPE/HELP pairing, histogram
+    # bucket monotonicity — parse_prom asserts internally)
     prom = open(mpath + ".prom").read()
-    assert "# TYPE tpuprof_ingest_rows_total counter" in prom
-    assert "tpuprof_span_seconds" in prom
+    parsed = parse_prom(prom)
+    assert parsed["tpuprof_ingest_rows_total"]["type"] == "counter"
+    assert parsed["tpuprof_span_seconds"]["type"] == "histogram"
+    assert parsed["tpuprof_span_seconds"]["samples"]
+
+    # the fleet exposition (obs/fleet.py; a fleet of one here) landed
+    # too, parses, and agrees with the per-process dump on the summed
+    # counters
+    fleet = parse_prom(open(mpath + ".fleet.prom").read())
+    rows_local = sum(v for _, _, v in
+                     parsed["tpuprof_ingest_rows_total"]["samples"])
+    rows_fleet = sum(v for _, _, v in
+                     fleet["tpuprof_ingest_rows_total"]["samples"])
+    assert rows_fleet == rows_local >= n
+    # fleet gauges carry the host label
+    assert all(l.get("host") == "0" for _, l, v in
+               fleet["tpuprof_checkpoint_bytes"]["samples"])
+    # the fleet_snapshot event rode the sink
+    assert "fleet_snapshot" in kinds
 
     # the report footer carries the pipeline line
     page = open(out).read()

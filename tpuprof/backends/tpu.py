@@ -482,6 +482,11 @@ class TPUStatsBackend:
                                                  merge_samplers,
                                                  merge_shift_estimates)
         pshard = (jax.process_index(), jax.process_count())
+        # crash flight recorder context: a postmortem from this process
+        # must name its rank (obs/blackbox.py; fingerprint is stamped by
+        # configure_from_config above)
+        obs.blackbox.set_context(process_index=pshard[0],
+                                 process_count=pshard[1])
         # multi-host spill works when unique_spill_dir is SHARED storage
         # (each host's runs validate present everywhere and the merge
         # adopts them — kernels/unique.py merge law); host-local dirs
@@ -608,6 +613,14 @@ class TPUStatsBackend:
                                        "restored": restored,
                                        "cursor": int(skip)})
             log_event("multihost_resume_barrier", peers=peers)
+            # fleet view at the barrier: a resumed fleet's first shared
+            # artifact says who restored, who fell back, and what the
+            # restore legs cost — before any scanning starts.
+            # Symmetric: every host in this block calls it.
+            from tpuprof.runtime.distributed import publish_fleet
+            publish_fleet("resume_barrier",
+                          metrics_path=obs.resolve_metrics_path(config),
+                          quarantined=len(quarantine.entries))
             flags = {r for _, r, _ in peers}
             if flags == {True, False}:
                 from tpuprof.utils.trace import logger
@@ -780,6 +793,8 @@ class TPUStatsBackend:
                     state, drain_timeout,
                     heartbeat=lambda: {"cursor": int(cursor),
                                        "rows": int(hostagg.n_rows)})
+            # drain boundary: HBM/RSS headroom gauges (silent on CPU)
+            obs.memory.sample()
         if resume is not None and resume.last_saved != cursor:
             # pass A complete: keep the final state on disk so a crash
             # during merge/pass-B resumes with the whole stream skipped
@@ -1019,10 +1034,22 @@ class TPUStatsBackend:
         # likewise the metrics snapshot (counters/spans/checkpoint
         # durations) for the report's pipeline-stats footer, plus a
         # final snapshot into the JSONL sink for offline reads
+        obs.memory.sample()     # final headroom reading rides the snapshot
         snap = obs.snapshot_if_enabled()
         if snap is not None:
             stats["_obs"] = snap
         obs.finalize(reason="collect")
+        # fleet aggregation (obs/fleet.py): gather every process's
+        # registry over DCN; host 0 writes <metrics_path>.fleet.prom +
+        # a fleet_snapshot event.  Symmetric collective — every host
+        # reaches this line (same reason the q_entries gather above is
+        # unconditional), and a disabled registry's wire is still valid,
+        # so mixed metrics settings cannot deadlock.
+        if pshard[1] > 1 or obs.enabled():
+            from tpuprof.runtime.distributed import publish_fleet
+            publish_fleet("collect",
+                          metrics_path=obs.resolve_metrics_path(config),
+                          quarantined=len(quarantine.entries))
         return stats
 
 

@@ -82,7 +82,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "close, checkpoint saves, and metric snapshots "
                         "(see OBSERVABILITY.md).  Also dumps the final "
                         "Prometheus text exposition next to PATH "
-                        "(PATH + '.prom')")
+                        "(PATH + '.prom'); host 0 additionally writes "
+                        "the fleet-merged view (PATH + '.fleet.prom' — "
+                        "counters summed across hosts, gauges labelled "
+                        "host=N)")
     p.add_argument("--metrics-interval", type=float, default=0.0,
                    metavar="SEC",
                    help="with --metrics-json: emit a metrics snapshot "
@@ -187,8 +190,15 @@ def build_parser() -> argparse.ArgumentParser:
 def cmd_profile(args: argparse.Namespace) -> int:
     from tpuprof import ProfileReport, ProfilerConfig
     from tpuprof.errors import (CorruptCheckpointError, InputError,
-                                WatchdogTimeout)
+                                PoisonBatchError, WatchdogTimeout,
+                                exit_code)
+    from tpuprof.obs import blackbox
     from tpuprof.utils.trace import phase_timer, trace_to
+
+    # crash flight recorder (obs/blackbox.py): always on unless
+    # TPUPROF_BLACKBOX=0 — SIGTERM/SIGUSR1 dump the ring, and every
+    # typed error below leaves a tpuprof-postmortem-<pid>.json
+    blackbox.install_signal_handlers()
 
     # flag-interaction constraints (--exact-distinct without a spill
     # dir, --parity with --single-pass, ...) are enforced ONCE, by
@@ -310,20 +320,30 @@ def cmd_profile(args: argparse.Namespace) -> int:
                 # user-input errors ONLY (unknown --columns names,
                 # checkpoint/source mismatch) speak the CLI convention;
                 # internal ValueErrors keep their traceback so real
-                # bugs stay diagnosable
+                # bugs stay diagnosable.  No postmortem: nothing
+                # crashed, the request itself was malformed.
                 print(f"tpuprof: error: {exc}", file=sys.stderr)
                 return 2
-            except CorruptCheckpointError as exc:
-                # the whole retention chain failed integrity: one line
-                # + a distinct code so wrappers can decide "delete the
-                # artifact and rerun" without parsing a traceback
+            except (CorruptCheckpointError, PoisonBatchError,
+                    WatchdogTimeout) as exc:
+                # the degradation ladder ran out (ROBUSTNESS.md): one
+                # line + a distinct exit code per failure shape
+                # (errors.exit_code), and the flight recorder dumps a
+                # postmortem bundle whose last ring entries name the
+                # failing site
                 print(f"tpuprof: error: {exc}", file=sys.stderr)
-                return 3
-            except WatchdogTimeout as exc:
-                # a watched blocking leg (device drain, resume barrier)
-                # overran its deadline — the heartbeat is in the message
-                print(f"tpuprof: error: {exc}", file=sys.stderr)
-                return 4
+                dump = blackbox.dump_postmortem(error=exc)
+                if dump:
+                    print(f"tpuprof: postmortem: {dump}",
+                          file=sys.stderr)
+                return exit_code(exc)
+            except Exception as exc:
+                # unexpected failure: keep the traceback (it is the
+                # diagnosis), but leave the flight-recorder bundle too —
+                # the ring holds the batch/dispatch context a traceback
+                # cannot show
+                blackbox.dump_postmortem(error=exc)
+                raise
         # every host computes the complete merged stats (the cross-host
         # merges are allgathers), but only host 0 renders + writes —
         # N processes racing one output path helps nobody

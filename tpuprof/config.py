@@ -121,6 +121,18 @@ def resolve_pass_b_kernel(value: Optional[str] = None) -> str:
     return "cumulative"
 
 
+def resolve_metrics_max_bytes(value: Optional[int] = None) -> Optional[int]:
+    """JSONL event-sink growth cap: an explicit config value wins; else
+    ``TPUPROF_METRICS_MAX_BYTES``; else None = unlimited (the
+    historical behavior).  When set, the sink rotates ``PATH`` ->
+    ``PATH.1`` once at the cap so week-long streams cannot fill the
+    disk (obs/events.JsonlSink)."""
+    if value is not None:
+        return int(value) if value > 0 else None
+    env = _env_int("TPUPROF_METRICS_MAX_BYTES")
+    return env if env and env > 0 else None
+
+
 def resolve_metrics_enabled(value: Optional[bool] = None,
                             metrics_path: Optional[str] = None) -> bool:
     """Observability switch (tpuprof/obs): an explicit config value
@@ -361,6 +373,14 @@ class ProfilerConfig:
                                             # snapshot events into the
                                             # sink (0 = final snapshot
                                             # only; CLI --metrics-interval)
+    metrics_max_bytes: Optional[int] = None  # JSONL sink growth cap:
+                                             # rotate PATH -> PATH.1
+                                             # once when the file would
+                                             # exceed this many bytes
+                                             # (disk bounded ~2x cap).
+                                             # None = auto:
+                                             # TPUPROF_METRICS_MAX_BYTES
+                                             # env, else unlimited
     metrics_block_sample: int = 0           # time every Nth device
                                             # dispatch with
                                             # jax.block_until_ready
@@ -456,6 +476,10 @@ class ProfilerConfig:
                 raise ValueError(f"{fname} must be > 0 (or None = off)")
         if self.metrics_interval < 0:
             raise ValueError("metrics_interval must be >= 0")
+        if self.metrics_max_bytes is not None \
+                and self.metrics_max_bytes < 1:
+            raise ValueError(
+                "metrics_max_bytes must be >= 1 (or None = unlimited)")
         if self.metrics_block_sample < 0:
             raise ValueError("metrics_block_sample must be >= 0 "
                              "(0 disables block-timing sampling)")
@@ -511,6 +535,17 @@ class ProfilerConfig:
             # (11 idx bits + 5 rho bits), not by HLL itself
             raise ValueError(
                 f"hll_precision must be in [4, {MAX_PRECISION}]")
+
+    def fingerprint(self) -> str:
+        """Short stable digest of every config field — the flight
+        recorder's context card (obs/blackbox.py) stamps it into each
+        postmortem so a crash dump names the configuration that crashed
+        without shipping the whole dataclass."""
+        import hashlib
+        items = sorted(
+            (f.name, repr(getattr(self, f.name, None)))
+            for f in dataclasses.fields(self))
+        return hashlib.sha1(repr(items).encode()).hexdigest()[:12]
 
     @classmethod
     def from_kwargs(cls, **kwargs) -> "ProfilerConfig":

@@ -67,3 +67,29 @@ class WatchdogTimeout(TimeoutError):
         self.site = site
         self.timeout_s = timeout_s
         self.heartbeat = heartbeat
+
+
+# the typed taxonomy the CLI (and the crash flight recorder's
+# postmortem dumps — obs/blackbox.py) treats as "expected failure
+# shapes": one-line message + distinct exit code, no traceback
+TYPED_ERRORS = (InputError, CorruptCheckpointError, PoisonBatchError,
+                WatchdogTimeout)
+
+_EXIT_CODES = (
+    # order matters: InputError and CorruptCheckpointError are both
+    # ValueErrors — the most specific class must match first
+    (CorruptCheckpointError, 3),
+    (WatchdogTimeout, 4),
+    (PoisonBatchError, 5),
+    (InputError, 2),
+)
+
+
+def exit_code(exc: BaseException) -> int:
+    """The CLI's exit code for a typed error (1 for anything else) —
+    kept here so wrappers, the CLI, and the postmortem bundle all speak
+    one mapping."""
+    for cls, code in _EXIT_CODES:
+        if isinstance(exc, cls):
+            return code
+    return 1

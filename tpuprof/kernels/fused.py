@@ -46,6 +46,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tpuprof.kernels import corr as kcorr
 from tpuprof.kernels import moments as kmoments
+from tpuprof.obs import blackbox as _blackbox
 from tpuprof.obs import metrics as _obs_metrics
 
 Array = jnp.ndarray
@@ -84,6 +85,10 @@ def observe_dispatch(program: str, result, batches: int = 1,
     returns the result unchanged so call sites stay expressions.
     ``kernel`` (pass-B sites only) additionally feeds the
     kernel-labelled pass-B series."""
+    # dispatch milestones land in the crash flight recorder even with
+    # metrics off (obs/blackbox.py): a postmortem of a wedged drain
+    # shows what the device was last asked to run
+    _blackbox.record("dispatch", program=program, batches=batches)
     if not _obs_metrics.enabled():
         return result
     _DISPATCHES.inc(program=program)

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from tpuprof.obs import events, metrics
+from tpuprof.obs import blackbox, events, fleet, memory, metrics
 from tpuprof.obs.events import emit, emit_snapshot
 from tpuprof.obs.metrics import (MetricsRegistry, counter, enabled, gauge,
                                  histogram, registry, set_enabled)
@@ -26,11 +26,12 @@ from tpuprof.obs.progress import RateEMA, Ticker, registry_progress_line
 from tpuprof.obs.spans import current_path, get_phase_report, span
 
 __all__ = [
-    "MetricsRegistry", "RateEMA", "Ticker", "block_sample", "configure",
-    "configure_from_config", "counter", "current_path", "emit",
-    "emit_snapshot", "enabled", "finalize", "gauge", "get_phase_report",
-    "histogram", "registry", "registry_progress_line", "set_enabled",
-    "snapshot_if_enabled", "span",
+    "MetricsRegistry", "RateEMA", "Ticker", "blackbox", "block_sample",
+    "configure", "configure_from_config", "counter", "current_path",
+    "emit", "emit_snapshot", "enabled", "finalize", "fleet", "gauge",
+    "get_phase_report", "histogram", "memory", "registry",
+    "registry_progress_line", "set_enabled", "snapshot_if_enabled",
+    "span",
 ]
 
 # every Nth device dispatch is block_until_ready-timed when > 0
@@ -44,13 +45,14 @@ def block_sample() -> int:
 
 def configure(enabled: Optional[bool] = None,
               jsonl_path: Optional[str] = None,
-              block_sample: Optional[int] = None) -> None:
+              block_sample: Optional[int] = None,
+              max_bytes: Optional[int] = None) -> None:
     """Flip the process-wide observability state.  ``None`` leaves a
     knob as it is, so CLI and backend can each set their half without
     clobbering the other."""
     global _block_sample
     if jsonl_path is not None:
-        events.set_sink(jsonl_path)
+        events.set_sink(jsonl_path, max_bytes=max_bytes)
         if enabled is None:     # a sink with recording off would be empty
             enabled = True
     if enabled is not None:
@@ -62,15 +64,29 @@ def configure(enabled: Optional[bool] = None,
 def configure_from_config(config) -> None:
     """Apply a ProfilerConfig's metrics knobs (backends call this at the
     top of collect / StreamingProfiler.__init__)."""
-    from tpuprof.config import resolve_metrics_enabled
+    from tpuprof.config import (resolve_metrics_enabled,
+                                resolve_metrics_max_bytes)
     on = resolve_metrics_enabled(config.metrics_enabled,
                                  config.metrics_path)
+    path = resolve_metrics_path(config)
+    configure(enabled=on, jsonl_path=path,
+              block_sample=config.metrics_block_sample,
+              max_bytes=resolve_metrics_max_bytes(
+                  getattr(config, "metrics_max_bytes", None)))
+    # the flight recorder's context card: enough to read a postmortem
+    # without the process that wrote it
+    blackbox.set_context(config_fingerprint=config.fingerprint())
+
+
+def resolve_metrics_path(config) -> Optional[str]:
+    """The JSONL sink path this config lands on (config field, else
+    ``TPUPROF_METRICS_PATH``) — also the base the fleet exposition
+    (``<path>.fleet.prom``) derives from."""
     path = config.metrics_path
     if path is None:
         import os
         path = os.environ.get("TPUPROF_METRICS_PATH") or None
-    configure(enabled=on, jsonl_path=path,
-              block_sample=config.metrics_block_sample)
+    return path
 
 
 def snapshot_if_enabled() -> Optional[dict]:

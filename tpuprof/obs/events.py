@@ -20,27 +20,53 @@ crash the pipeline they observe.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, Optional
 
-from tpuprof.obs import metrics
+from tpuprof.obs import blackbox, metrics
 
 
 class JsonlSink:
-    """Thread-safe append-only JSONL writer (line-buffered)."""
+    """Thread-safe append-only JSONL writer (line-buffered).
 
-    def __init__(self, path: str):
+    ``max_bytes`` (config ``metrics_max_bytes`` /
+    ``TPUPROF_METRICS_MAX_BYTES``; None/0 = unlimited) caps on-disk
+    growth: when the file would exceed the cap it rotates once to
+    ``path.1`` (replacing any previous rotation) and keeps appending to
+    a fresh ``path`` — a week-long stream's sink is then bounded at
+    ~2x max_bytes instead of filling the disk."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = int(max_bytes) if max_bytes else 0
         self._lock = threading.Lock()
         self._fh = open(path, "a", buffering=1)
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
+
+    def _rotate_locked(self) -> None:
+        self._fh.close()
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass            # rotation is best-effort; appending resumes
+        self._fh = open(self.path, "a", buffering=1)
+        self._bytes = 0
 
     def write(self, event: Dict[str, Any]) -> None:
-        line = json.dumps(event, default=str)
+        line = json.dumps(event, default=str) + "\n"
         with self._lock:
             if self._fh.closed:
                 return
-            self._fh.write(line + "\n")
+            if self.max_bytes and self._bytes \
+                    and self._bytes + len(line) > self.max_bytes:
+                self._rotate_locked()
+            self._fh.write(line)
+            self._bytes += len(line)
 
     def close(self) -> None:
         with self._lock:
@@ -52,17 +78,21 @@ _lock = threading.Lock()
 _sink: Optional[JsonlSink] = None
 
 
-def set_sink(path: Optional[str]) -> Optional[JsonlSink]:
+def set_sink(path: Optional[str],
+             max_bytes: Optional[int] = None) -> Optional[JsonlSink]:
     """Point the process-wide sink at ``path`` (None closes it).  A
-    repeated call with the sink's current path keeps it (appending),
-    so configure() is idempotent across CLI + backend."""
+    repeated call with the sink's current path keeps it (appending,
+    updating the growth cap), so configure() is idempotent across
+    CLI + backend."""
     global _sink
     with _lock:
         if _sink is not None and (path is None or _sink.path != path):
             _sink.close()
             _sink = None
         if path is not None and _sink is None:
-            _sink = JsonlSink(path)
+            _sink = JsonlSink(path, max_bytes=max_bytes)
+        elif _sink is not None and max_bytes is not None:
+            _sink.max_bytes = int(max_bytes) if max_bytes else 0
         return _sink
 
 
@@ -71,7 +101,10 @@ def get_sink() -> Optional[JsonlSink]:
 
 
 def emit(kind: str, **fields) -> None:
-    """Write one event to the sink, if any.  Cheap no-op otherwise."""
+    """Write one event to the sink, if any — and ALWAYS into the crash
+    flight recorder (obs/blackbox.py), so a run with metrics off still
+    leaves a ring of recent events behind a crash."""
+    blackbox.record(kind, **fields)
     sink = _sink
     if sink is None:
         return

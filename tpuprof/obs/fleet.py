@@ -1,0 +1,68 @@
+"""Fleet-wide metric aggregation (OBSERVABILITY.md "fleet view").
+
+The per-process obs layer leaves an N-host mesh with N separate
+``.prom``/JSONL files and no single place to read the fleet.  This
+module is the missing rung: every process ships its registry's wire
+form (``MetricsRegistry.to_wire()``) over the existing DCN allgather
+(runtime/distributed.publish_fleet calls :func:`merge_wires`), and
+host 0 writes ONE ``<metrics_path>.fleet.prom`` plus a
+``fleet_snapshot`` JSONL event covering every process.
+
+Merge laws (tests/test_fleet.py):
+
+* counters **sum** across hosts (fleet totals — rows, dispatches,
+  quarantines, watchdog timeouts);
+* gauges keep **per-host values** under an added ``host=`` label;
+* histograms **sum** their bucket ladders (same declared buckets).
+
+Everything here is host-side and import-light: no jax — the collective
+leg lives in runtime/distributed.py, which hands this module plain
+wire dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from tpuprof.obs import events, metrics
+
+
+def fleet_prom_path(metrics_path: str) -> str:
+    """Where the fleet exposition lands, next to the per-process
+    ``<metrics_path>.prom`` twin."""
+    return metrics_path + ".fleet.prom"
+
+
+def merge_wires(wires: List[Dict[str, Any]]) -> metrics.MetricsRegistry:
+    """Fold every host's wire into one registry (host i gets gauge
+    label ``host="i"`` — list order is the allgather's rank order)."""
+    merged = metrics.MetricsRegistry(enabled=True)
+    for i, wire in enumerate(wires):
+        merged.merge_wire(wire, host=str(i))
+    return merged
+
+
+def write_fleet(metrics_path: Optional[str],
+                wires: List[Dict[str, Any]],
+                reason: str = "collect",
+                quarantined_by_host: Optional[List[int]] = None) -> \
+        Optional[str]:
+    """Render + persist the fleet view (the HOST-0 half of a publish).
+
+    Writes ``<metrics_path>.fleet.prom`` when a metrics path is
+    configured, and emits one ``fleet_snapshot`` JSONL event (ring +
+    sink) either way.  Returns the path written, or None."""
+    merged = merge_wires(wires)
+    snap = merged.snapshot()
+    events.emit("fleet_snapshot", reason=reason, hosts=len(wires),
+                quarantined_by_host=list(quarantined_by_host or []),
+                snapshot=snap)
+    if not metrics_path:
+        return None
+    path = fleet_prom_path(metrics_path)
+    try:
+        with open(path, "w") as fh:
+            fh.write(merged.render_text())
+    except OSError:
+        return None         # the fleet dump must never fail the profile
+    return path

@@ -1,0 +1,94 @@
+"""Device + host memory telemetry (OBSERVABILITY.md "memory gauges").
+
+A long profile's failure mode on real hardware is headroom, not speed:
+HBM creeping toward the limit as staged batches pile up, or host RSS
+growing under a leaky prep cache.  This module samples both at drain
+boundaries (stream drains, pass flushes — never per batch):
+
+* ``tpuprof_device_memory_bytes{kind="in_use"|"limit", device=...}``
+  from ``device.memory_stats()`` — guarded: CPU/older backends return
+  None or lack the method entirely, and the gauges simply stay silent;
+* ``tpuprof_host_rss_bytes`` from ``/proc/self/statm`` (fallback:
+  ``resource.getrusage`` peak RSS — better than nothing on non-Linux).
+
+``sample()`` is also the plain-dict read the bench block and report
+footer consume; it records into the registry only when metrics are on.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence
+
+from tpuprof.obs import metrics as _obs_metrics
+
+_DEVICE_MEM = _obs_metrics.gauge(
+    "tpuprof_device_memory_bytes",
+    "accelerator memory bytes by device and kind (in_use/limit); "
+    "silent on backends without memory_stats()")
+_HOST_RSS = _obs_metrics.gauge(
+    "tpuprof_host_rss_bytes",
+    "resident set size of this process at the last drain boundary")
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Current RSS in bytes (None when unreadable)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            fields = fh.read().split()
+        return int(fields[1]) * os.sysconf("SC_PAGESIZE")
+    except (OSError, IndexError, ValueError):
+        pass
+    try:
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # linux reports KiB, macOS bytes — normalize heuristically
+        return int(ru) * (1 if ru > 1 << 32 else 1024)
+    except Exception:
+        return None
+
+
+def device_memory(devices: Optional[Sequence] = None) -> Dict[str, Dict[str, int]]:
+    """``{device_label: {"in_use": ..., "limit": ...}}`` for every local
+    device that reports memory stats ({} on CPU backends)."""
+    out: Dict[str, Dict[str, int]] = {}
+    try:
+        import jax
+        devs = devices if devices is not None else jax.local_devices()
+    except Exception:
+        return out
+    for d in devs:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue            # CPU backends return None
+        label = f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', '?')}"
+        ent: Dict[str, int] = {}
+        in_use = stats.get("bytes_in_use")
+        limit = stats.get("bytes_limit") or stats.get(
+            "bytes_reservable_limit")
+        if in_use is not None:
+            ent["in_use"] = int(in_use)
+        if limit is not None:
+            ent["limit"] = int(limit)
+        if ent:
+            out[label] = ent
+    return out
+
+
+def sample(devices: Optional[Sequence] = None) -> Dict[str, Any]:
+    """One telemetry sample: reads both sides, sets the gauges when
+    metrics are enabled, and returns the plain dict either way (bench
+    block / report assembly).  Cheap enough for drain boundaries; never
+    raises."""
+    devmem = device_memory(devices)
+    rss = host_rss_bytes()
+    if _obs_metrics.enabled():
+        for label, ent in devmem.items():
+            for kind, value in ent.items():
+                _DEVICE_MEM.set(value, device=label, kind=kind)
+        if rss is not None:
+            _HOST_RSS.set(rss)
+    return {"devices": devmem, "host_rss_bytes": rss}

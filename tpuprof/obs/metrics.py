@@ -44,10 +44,17 @@ def _label_key(labels: Dict[str, Any]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
+def _escape_label_value(v: str) -> str:
+    # Prometheus exposition escaping for label values: backslash first
+    # (the escape character itself), then quote and line feed — a value
+    # containing `"` or a newline would otherwise tear the sample line
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(key: LabelKey) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
@@ -270,6 +277,98 @@ class MetricsRegistry:
                     lines.append(
                         f"{inst.name}{_fmt_labels(key)} {_fmt_value(v)}")
         return "\n".join(lines) + "\n"
+
+    # -- fleet wire form (obs/fleet.py) ------------------------------------
+
+    def to_wire(self) -> Dict[str, Any]:
+        """Mergeable, picklable view of every series — what a host ships
+        over the DCN allgather for fleet aggregation (obs/fleet.py).
+
+        Label keys stay structured (lists of ``[name, value]`` pairs,
+        not the rendered ``{a="b"}`` strings) so :meth:`merge_wire` can
+        relabel and sum without parsing."""
+        wire: Dict[str, Any] = {"counters": {}, "gauges": {},
+                                "histograms": {}}
+        for inst in self._items():
+            with inst._lock:
+                series = dict(inst._series)
+            if isinstance(inst, Histogram):
+                wire["histograms"][inst.name] = {
+                    "help": inst.help,
+                    "buckets": list(inst.buckets),
+                    "series": [[list(map(list, k)),
+                                {"buckets": list(st["buckets"]),
+                                 "sum": float(st["sum"]),
+                                 "count": int(st["count"])}]
+                               for k, st in series.items()],
+                }
+            else:
+                kind = "counters" if isinstance(inst, Counter) else "gauges"
+                wire[kind][inst.name] = {
+                    "help": inst.help,
+                    "series": [[list(map(list, k)), float(v)]
+                               for k, v in series.items()],
+                }
+        return wire
+
+    def merge_wire(self, wire: Dict[str, Any],
+                   host: Optional[str] = None) -> None:
+        """Fold one host's :meth:`to_wire` payload into this registry.
+
+        Merge laws (the fleet view's contract, tests/test_fleet.py):
+
+        * **counters sum** across hosts per label set — fleet totals;
+        * **gauges keep per-host values** under an added ``host=`` label
+          (a gauge is a point-in-time reading; summing two hosts' queue
+          depths or RSS would fabricate a number nobody measured);
+        * **histograms sum** bucket ladders + sum + count when the
+          ladders match; a mismatched ladder (version skew across the
+          fleet) degrades to per-host series under ``host=`` rather
+          than silently mis-summing buckets.
+        """
+        host_pair = [] if host is None else [["host", str(host)]]
+        for name, ent in wire.get("counters", {}).items():
+            c = self.counter(name, ent.get("help", ""))
+            with c._lock:
+                for key, value in ent.get("series", []):
+                    k = tuple(tuple(p) for p in key)
+                    c._series[k] = c._series.get(k, 0.0) + float(value)
+        for name, ent in wire.get("gauges", {}).items():
+            g = self.gauge(name, ent.get("help", ""))
+            with g._lock:
+                for key, value in ent.get("series", []):
+                    k = _label_key(dict(list(map(tuple, key))
+                                        + host_pair))
+                    g._series[k] = float(value)
+        for name, ent in wire.get("histograms", {}).items():
+            buckets = tuple(float(b) for b in ent.get("buckets", ()))
+            h = self.histogram(name, ent.get("help", ""),
+                               buckets=buckets or TIME_BUCKETS)
+            same_ladder = h.buckets == buckets
+            with h._lock:
+                for key, st in ent.get("series", []):
+                    pairs = list(map(tuple, key))
+                    if not same_ladder:
+                        # version-skewed ladder: keep the host's series
+                        # intact (relabelled) instead of mis-summing
+                        pairs += [("host", str(host))] \
+                            if host is not None else []
+                        k = _label_key(dict(pairs))
+                        h._series[k] = {
+                            "buckets": [0] * len(h.buckets),
+                            "sum": float(st["sum"]),
+                            "count": int(st["count"])}
+                        continue
+                    k = tuple(pairs)
+                    mine = h._series.get(k)
+                    if mine is None:
+                        mine = h._series[k] = {
+                            "buckets": [0] * len(h.buckets),
+                            "sum": 0.0, "count": 0}
+                    for i, c in enumerate(st["buckets"]):
+                        mine["buckets"][i] += int(c)
+                    mine["sum"] += float(st["sum"])
+                    mine["count"] += int(st["count"])
 
     def reset(self) -> None:
         """Zero every series (instrument declarations survive) — test

@@ -69,10 +69,12 @@ def span(name: str, **meta: Any) -> Iterator[None]:
         with _lock:
             _phase_totals[name] = _phase_totals.get(name, 0.0) + dt
         _SPAN_SECONDS.observe(dt, name=name)
-        if events.get_sink() is not None:
-            events.emit("span", name=name, seconds=round(dt, 6),
-                        path=".".join(stack + [name]), depth=depth,
-                        **meta)
+        # unconditional: emit records into the crash flight recorder
+        # even with no sink configured (obs/blackbox.py), so span
+        # closes are visible in a postmortem of a metrics-off run
+        events.emit("span", name=name, seconds=round(dt, 6),
+                    path=".".join(stack + [name]), depth=depth,
+                    **meta)
         logger.debug("%s", json.dumps(
             {"event": "phase", "name": name, "seconds": round(dt, 4),
              **meta}, default=str))

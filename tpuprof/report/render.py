@@ -114,6 +114,18 @@ def _pipeline_stats_line(stats: Dict[str, Any]) -> str:
     if saves:
         secs = sum(s["sum"] for s in ck.values())
         parts.append(f"{int(saves)} checkpoints ({secs:.2f}s)")
+    gauges = snap.get("gauges") or {}
+    devmem = gauges.get("tpuprof_device_memory_bytes") or {}
+    in_use = sum(v for k, v in devmem.items() if 'kind="in_use"' in k)
+    if in_use:
+        frag = f"{formatters.fmt_bytesize(in_use)} device mem in use"
+        limit = sum(v for k, v in devmem.items() if 'kind="limit"' in k)
+        if limit:
+            frag += f" ({in_use / limit:.0%} of limit)"
+        parts.append(frag)
+    rss = sum((gauges.get("tpuprof_host_rss_bytes") or {}).values())
+    if rss:
+        parts.append(f"{formatters.fmt_bytesize(rss)} host rss")
     return " · ".join(parts)
 
 

@@ -188,7 +188,15 @@ def save(path: str, state: Any, host_blob: Any, cursor: int,
         raise
     _rotate(path, keep)
     os.replace(tmp, path)
-    if _obs_metrics.enabled():
+    # flight recorder: the save milestone + "last durable cursor" ride
+    # the postmortem context even with metrics off (obs/blackbox.py) —
+    # a crash dump must say how much work a resume would skip
+    from tpuprof.obs import blackbox
+    blackbox.set_context(last_checkpoint_cursor=int(cursor),
+                         last_checkpoint_path=path)
+    if not _obs_metrics.enabled():
+        blackbox.record("checkpoint_save", path=path, cursor=int(cursor))
+    else:
         dt = time.perf_counter() - t0
         _SAVES.inc()
         _SAVE_SECONDS.observe(dt)
@@ -263,6 +271,10 @@ def load_payload(path: str) -> Dict[str, Any]:
         events.emit("checkpoint_restore", path=path,
                     cursor=int(payload.get("cursor", -1)),
                     seconds=round(dt, 6))
+    else:
+        from tpuprof.obs import blackbox
+        blackbox.record("checkpoint_restore", path=path,
+                        cursor=int(payload.get("cursor", -1)))
     return payload
 
 

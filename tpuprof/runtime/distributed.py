@@ -102,6 +102,35 @@ def allgather_with_watchdog(obj, timeout_s=None, site: str = "barrier",
                          heartbeat=heartbeat)
 
 
+def publish_fleet(reason: str, metrics_path=None, quarantined=None,
+                  timeout_s=None):
+    """Fleet metric aggregation (obs/fleet.py): every process gathers
+    every process's registry wire form over the DCN allgather —
+    SYMMETRIC, so multi-host callers must invoke it on all hosts — and
+    process 0 writes ``<metrics_path>.fleet.prom`` + a
+    ``fleet_snapshot`` JSONL event covering the whole fleet.  The wire
+    of a disabled registry is a valid (mostly empty) payload, so a
+    fleet with mixed metrics settings cannot deadlock here.
+
+    ``quarantined`` is this host's quarantine-manifest length; it rides
+    the same gather so the snapshot can say which hosts degraded.
+    Returns the fleet .prom path written (process 0 with a metrics
+    path), else None."""
+    import jax
+
+    from tpuprof.obs import fleet, metrics
+    payload = {"wire": metrics.registry().to_wire(),
+               "quarantined": int(quarantined or 0)}
+    parts = allgather_with_watchdog(payload, timeout_s,
+                                    site="fleet_publish") \
+        if timeout_s else allgather_objects(payload)
+    if jax.process_index() != 0:
+        return None
+    return fleet.write_fleet(
+        metrics_path, [p["wire"] for p in parts], reason=reason,
+        quarantined_by_host=[p["quarantined"] for p in parts])
+
+
 def merge_host_aggs(hostagg):
     """Merge every host's HostAgg into a complete one (on all hosts).
     Misra-Gries merge keeps its mergeability bounds (kernels/topk.py)."""

@@ -114,6 +114,14 @@ class BatchGuard:
                     if self.backoff_s > 0:
                         self._sleep(self.backoff_s * (2 ** (attempt - 1)))
                     continue
+                # escalation (past the retry budget, or non-transient):
+                # land it in the crash flight recorder BEFORE raising —
+                # a postmortem's last ring entries must name the failing
+                # site even when metrics/sink are off (obs/blackbox.py)
+                from tpuprof.obs import blackbox
+                blackbox.record("batch_failed", site=site, key=key,
+                                attempts=attempt + 1,
+                                error=f"{type(exc).__name__}: {exc}")
                 if self.capture:
                     return PoisonBatch(
                         site=site,

@@ -94,6 +94,7 @@ class MeshRunner:
         if config.mesh_devices:
             devs = devs[: config.mesh_devices]
         self.n_dev = len(devs)
+        self.devices = devs     # memory telemetry reads these back
         self.mesh = Mesh(np.asarray(devs), ("data",))
         # host batches are padded to a device-divisible row count
         self.rows = -(-config.batch_rows // self.n_dev) * self.n_dev
@@ -563,8 +564,13 @@ class MeshRunner:
             faults.hit("device_wait")
             return jax.block_until_ready(tree)
 
-        return guard.watched(_wait, timeout_s, site="device_drain",
-                             heartbeat=heartbeat)
+        out = guard.watched(_wait, timeout_s, site="device_drain",
+                            heartbeat=heartbeat)
+        # the drain just synchronized the device anyway — the one spot a
+        # memory_stats() read costs nothing extra (obs/memory.py)
+        from tpuprof.obs import memory as _obs_memory
+        _obs_memory.sample(self.devices)
+        return out
 
     def finalize_spearman(self, state: Pytree):
         return jax.device_get(

@@ -355,6 +355,8 @@ class StreamingProfiler:
             _DRAIN_SECONDS.observe(dt)
             if len(slices) > 1 and dt > 0:
                 _OVERLAP_RATIO.set(max(0.0, 1.0 - wait_s / dt))
+            # drain boundary: device/host memory headroom gauges
+            obs.memory.sample(self.runner.devices)
 
     # -- liveness ----------------------------------------------------------
 
@@ -375,7 +377,9 @@ class StreamingProfiler:
             "uptime_s": round(_time.monotonic() - self._t_start, 3),
             "columns": len(self.plan.specs),
         }
-        obs.emit("heartbeat", **hb)
+        obs.emit("heartbeat", **hb)     # sink (if any) + flight recorder
+        # the postmortem context card carries the freshest liveness read
+        obs.blackbox.set_context(last_heartbeat=hb)
         return hb
 
     def progress(self) -> str:
