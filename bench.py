@@ -239,6 +239,18 @@ def _measure_host_prep() -> dict:
     return measure_prepare(1 << 15 if _SMOKE else 1 << 19)
 
 
+def _measure_artifact() -> dict:
+    """Stats-artifact + incremental costs (ISSUE 6): write/read seconds
+    for a fold-able artifact and the incremental-vs-full speedup at a
+    small host-only scale — the `drift` scenario (benchmarks/run.py)
+    tracks the full-size figures; these keys make a store/resume
+    regression visible in the headline BENCH line too."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.run import measure_drift
+    return measure_drift(1 << 15 if _SMOKE else 1 << 17)
+
+
 def _measure_guardrail() -> dict:
     """Clean-path cost of the fault-tolerance plumbing (ISSUE 4): the
     retry-guard wrapper on the serial prepare loop, A/B'd in the same
@@ -268,6 +280,7 @@ def main() -> None:
     with span("prep"):
         host_prep = _measure_host_prep()  # before any device traffic
     guardrail = _measure_guardrail()      # host-only A/B, same fixture
+    artifact = _measure_artifact()        # store + incremental costs
     render_s = _measure_render()          # host-only, before the device
 
     devices = jax.devices()[:1]           # single-chip measurement
@@ -352,6 +365,15 @@ def main() -> None:
         # flight-recorder cost on the prepare leg (ISSUE 5 acceptance:
         # < 0.5%) + HBM in use after the e2e runs (0 = no memory_stats)
         "blackbox_overhead_pct": guardrail["blackbox_overhead_pct"],
+        # stats-artifact store + incremental profiling (ISSUE 6): the
+        # persisted-state product's cost envelope — write/read seconds
+        # and the resume+delta vs full-rescan ratio at the small
+        # host-only scale (full-size figures: `drift` scenario)
+        "artifact_write_s": artifact["artifact_write_s"],
+        "artifact_read_s": artifact["artifact_read_s"],
+        "artifact_bytes": artifact["artifact_bytes"],
+        "incremental_vs_full_speedup":
+            artifact["incremental_vs_full_speedup"],
         "device_mem_in_use_bytes": int(device_mem_in_use),
         # per-stage breakdown (obs spans; NEW keys only — existing keys
         # above keep their names so BENCH_r* comparisons stay valid)

@@ -574,8 +574,113 @@ def run_passb(scale: float, workdir: str) -> dict:
             "default_kernel": resolve_pass_b_kernel(None)}
 
 
+def measure_drift(rows: int, batch_rows: int = 1 << 12) -> dict:
+    """Artifact + incremental + diff costs (ISSUE 6): write/read seconds
+    for a fold-able stats artifact, the incremental-vs-full speedup
+    (resume(artifact) + profile(delta) vs re-profiling the whole
+    window), and the `tpuprof diff` compute time.  Micro-batches are
+    device-batch aligned so the incremental leg runs the byte-stable
+    path (ARTIFACTS.md).  Shared by the `drift` scenario and bench.py."""
+    import tempfile
+
+    import pandas as pd
+
+    from benchmarks import scenarios
+    from tpuprof import ProfilerConfig
+    from tpuprof.artifact import (compute_drift, read_artifact,
+                                  resume_profiler, write_artifact)
+    from tpuprof.runtime.stream import StreamingProfiler
+
+    def _batches(seed, n_batches, per_batch):
+        rng = np.random.default_rng(seed)
+        return [scenarios.taxi_batch(rng, per_batch)
+                for _ in range(n_batches)]
+
+    cfg = ProfilerConfig(batch_rows=batch_rows)
+    probe = StreamingProfiler.for_example(
+        scenarios.taxi_batch(np.random.default_rng(0), 64), config=cfg)
+    per_batch = probe.runner.rows          # aligned micro-batches
+    n_total = max(rows // per_batch, 8)
+    n_base = max(n_total * 3 // 4, 1)      # window A; delta = the rest
+    base_b = _batches(0, n_base, per_batch)
+    delta_b = _batches(1, n_total - n_base, per_batch)
+
+    # warm the compiled programs so neither leg pays first-compile
+    for b in base_b[:2]:
+        probe.update(b)
+    probe.stats()
+
+    with tempfile.TemporaryDirectory() as td:
+        art_a = os.path.join(td, "a.artifact.json")
+        art_b = os.path.join(td, "b.artifact.json")
+
+        prof_a = StreamingProfiler.for_example(base_b[0], config=cfg)
+        for b in base_b:
+            prof_a.update(b)
+        t0 = time.perf_counter()
+        write_artifact(art_a, profiler=prof_a)
+        write_s = time.perf_counter() - t0
+        art_bytes = os.path.getsize(art_a)
+
+        t0 = time.perf_counter()
+        read_artifact(art_a)
+        read_s = time.perf_counter() - t0
+
+        # incremental: stored_state ⊕ profile(delta)
+        t0 = time.perf_counter()
+        inc = resume_profiler(art_a)
+        for b in delta_b:
+            inc.update(b)
+        write_artifact(art_b, profiler=inc)
+        incremental_s = time.perf_counter() - t0
+
+        # full re-profile of the whole window
+        t0 = time.perf_counter()
+        full = StreamingProfiler.for_example(base_b[0], config=cfg)
+        for b in base_b + delta_b:
+            full.update(b)
+        full.stats()
+        full_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        drift = compute_drift(read_artifact(art_a), read_artifact(art_b))
+        diff_s = time.perf_counter() - t0
+
+    total_rows = n_total * per_batch
+    return {
+        "rows": total_rows,
+        "delta_rows": len(delta_b) * per_batch,
+        "artifact_bytes": art_bytes,
+        "artifact_write_s": round(write_s, 4),
+        "artifact_read_s": round(read_s, 4),
+        "incremental_s": round(incremental_s, 3),
+        "full_s": round(full_s, 3),
+        "incremental_vs_full_speedup": round(full_s / incremental_s, 3),
+        "drift_compute_s": round(diff_s, 4),
+        "drift_verdict": drift["summary"]["verdict"],
+        # generic delta column: rows the incremental path "covered"
+        # (stored window + delta) per second of incremental work
+        "rows_per_sec": round(total_rows / incremental_s, 1),
+    }
+
+
+def run_drift(scale: float, workdir: str) -> dict:
+    # the leg builds several MeshRunner instances back to back (probe,
+    # window A, resume, full re-profile); this box's jaxlib
+    # intermittently aborts (abseil mutex / segv) when the persistent
+    # compilation cache is enabled across those rebuilds.  The leg's
+    # signals are host-dominated (artifact IO + incremental ratio), so
+    # run it uncached rather than flaky.
+    from tpuprof.backends.tpu import disable_compile_cache
+    disable_compile_cache()
+    rows = max(int(20_000_000 * scale), 100_000)
+    out = measure_drift(rows)
+    out["scenario"] = "drift"
+    return out
+
+
 REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
-                        "hostfed", "prepare", "passb", "faults")
+                        "hostfed", "prepare", "passb", "faults", "drift")
 
 
 def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
@@ -707,6 +812,8 @@ def run_regression(scale: float, workdir: str,
             notes = f"stream:single {r['stream_vs_singlepass']}"
         if "pass_b_cumulative_vs_legacy" in r:
             notes = f"cum:legacy {r['pass_b_cumulative_vs_legacy']}"
+        if "incremental_vs_full_speedup" in r:
+            notes = f"inc:full {r['incremental_vs_full_speedup']}"
         rate = r.get("rows_per_sec",
                      r.get("prepare_rows_per_sec", float("nan")))
         print(f"| {r['scenario']} | {r.get('rows', '—'):,} | "
@@ -720,7 +827,7 @@ def main() -> None:
     parser.add_argument("scenario", choices=["taxi", "tpch", "criteo",
                                              "wide1b", "streaming",
                                              "hostfed", "prepare",
-                                             "passb", "faults",
+                                             "passb", "faults", "drift",
                                              "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
@@ -756,7 +863,7 @@ def main() -> None:
         pass                      # older jaxlibs: warm == cold, still valid
 
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
-              "prepare", "passb", "faults"]
+              "prepare", "passb", "faults", "drift"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -773,6 +880,8 @@ def main() -> None:
             result = run_passb(args.scale, args.workdir)
         elif name == "faults":
             result = run_faults(args.scale, args.workdir)
+        elif name == "drift":
+            result = run_drift(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

@@ -380,6 +380,117 @@ def test_two_process_fleet_prom_sums_hosts(tmp_path):
     assert not os.path.exists(outs[1] + ".events.jsonl.fleet.prom")
 
 
+_EXPORT_WORKER = r"""
+import os, sys, json
+pid = int(sys.argv[1]); port = sys.argv[2]
+ds = sys.argv[3]; out = sys.argv[4]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, sys.argv[5])
+import jax
+jax.config.update("jax_platforms", "cpu")
+# this jaxlib's CPU client ships without default multiprocess
+# collectives; the gloo TCP implementation is compiled in and just
+# needs selecting before the backend initializes
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=2, process_id=pid)
+from tpuprof import ProfilerConfig
+from tpuprof.backends.tpu import TPUStatsBackend
+from tpuprof.report.export import stats_to_json
+stats = TPUStatsBackend().collect(
+    ds, ProfilerConfig(backend="tpu", batch_rows=512, spearman=True,
+                       quantile_sketch_size=16384))
+json.dump(stats_to_json(stats), open(out, "w"))
+"""
+
+
+def _assert_export_equal(got, want, path=""):
+    """Key-for-key equality: identical key sets and value types
+    everywhere; floats within the f32 collective-merge tolerance
+    (moment sums merge across hosts in a different order than a single
+    process folds them), everything else exactly equal."""
+    if isinstance(want, dict):
+        assert isinstance(got, dict) and set(got) == set(want), path
+        for k in want:
+            _assert_export_equal(got[k], want[k], f"{path}.{k}")
+    elif isinstance(want, list):
+        assert isinstance(got, list) and len(got) == len(want), path
+        for i, (g, w) in enumerate(zip(got, want)):
+            _assert_export_equal(g, w, f"{path}[{i}]")
+    elif isinstance(want, float) and not isinstance(want, bool):
+        # memorysize is Arrow BUFFER accounting: striped ingest reads
+        # per-stripe dictionary pages, so the byte totals differ a
+        # little by construction (not a data statistic)
+        rel = 0.02 if path.endswith("memorysize") else 1e-5
+        assert isinstance(got, float) and \
+            got == pytest.approx(want, rel=rel, abs=1e-7), \
+            (path, got, want)
+    else:
+        assert got == want, (path, got, want)
+
+
+def test_two_process_export_equals_single_process(tmp_path):
+    """VERDICT r5 #8: host 0's machine-readable export must equal the
+    single-process export on the same data key-for-key — the drift/
+    artifact product is only as trustworthy as the numbers a fleet
+    exports.  Also pins that every numeric stat in BOTH exports
+    round-trips as a JSON number (tpuprof-stats-v1)."""
+    rng = np.random.default_rng(11)
+    ds_dir = tmp_path / "ds"
+    ds_dir.mkdir()
+    for f in range(4):
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "a": rng.normal(5, 2, 2000),
+            "b": rng.exponential(1.5, 2000),
+            "c": rng.choice(["x", "y", "z"], 2000),
+        }), preserve_index=False), str(ds_dir / f"p{f}.parquet"))
+
+    from tpuprof import ProfilerConfig
+    from tpuprof.backends.tpu import TPUStatsBackend
+    from tpuprof.report.export import stats_to_json
+    ctrl = stats_to_json(TPUStatsBackend().collect(
+        str(ds_dir), ProfilerConfig(backend="tpu", batch_rows=512,
+                                    spearman=True,
+                                    quantile_sketch_size=16384)))
+    # the control export itself is pure JSON (numpy scalars gone)
+    ctrl = json.loads(json.dumps(ctrl))
+
+    worker = tmp_path / "export_worker.py"
+    worker.write_text(_EXPORT_WORKER)
+    port = str(_free_port())
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    outs = [str(tmp_path / f"e{i}.json") for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i), port, str(ds_dir),
+         outs[i], repo],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        assert p.returncode == 0, out.decode()[-2000:]
+    results = [json.load(open(o)) for o in outs]
+    # every host exports the same complete truth...
+    assert results[0] == results[1]
+    got = results[0]
+    assert got["schema"] == "tpuprof-stats-v1"
+    # the display section is the formatters applied to the raw values;
+    # its LAST significant digit can legitimately differ when the f32
+    # merge order does, so it is compared structurally (same key
+    # layout), not string-for-string
+    disp_got, disp_ctrl = got.pop("display"), ctrl.pop("display")
+    assert set(disp_got["table"]) == set(disp_ctrl["table"])
+    assert {n: set(v) for n, v in disp_got["variables"].items()} == \
+        {n: set(v) for n, v in disp_ctrl["variables"].items()}
+    # ...and it equals the single-process export key-for-key
+    _assert_export_equal(got, ctrl)
+    # raw numbers where numbers belong, exactly (not via display)
+    assert got["table"]["n"] == 8000
+    assert isinstance(got["variables"]["a"]["mean"], float)
+    assert isinstance(got["variables"]["c"]["distinct_count"], int)
+
+
 _CKPT_WORKER = r"""
 import os, sys, json
 pid = int(sys.argv[1]); port = sys.argv[2]

@@ -79,7 +79,10 @@ def test_opaque_renders_and_exports(nested_parquet, tmp_path):
     assert 'id="var-nest"' in page
     import json
     payload = json.load(open(sj))
-    assert payload["variables"]["nest"]["distinct_count"] == ""
+    # tpuprof-stats-v1: unknown cardinality is a raw null (the display
+    # twin renders it as the empty string the pre-v1 export carried)
+    assert payload["variables"]["nest"]["distinct_count"] is None
+    assert payload["display"]["variables"]["nest"]["distinct_count"] == ""
 
 
 def test_config_rejects_unknown_policy():

@@ -159,7 +159,8 @@ def test_cli_parity(frame, tmp_path):
                "--parity", "--stats-json", sj, "--no-compile-cache"])
     assert rc == 0
     payload = json.load(open(sj))
-    assert all(v["distinct_approx"] == "False"
+    # tpuprof-stats-v1: booleans export raw, not as formatted strings
+    assert all(v["distinct_approx"] is False
                for v in payload["variables"].values())
     assert "spearman" in payload["correlations"]
     assert main(["profile", path, "-o", out, "--parity",

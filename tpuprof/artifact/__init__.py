@@ -1,0 +1,38 @@
+"""tpuprof/artifact — persisted stats artifacts, incremental profiling
+and drift detection (ROADMAP item 4; ISSUE 6 tentpole).
+
+The subsystem turns every profile into a durable, comparable, fold-able
+product:
+
+* :func:`write_artifact` / :func:`read_artifact` — the versioned
+  ``tpuprof-stats-v1`` store (store.py): raw-number stats + drift
+  sketches + (optionally) the complete mergeable fold state, CRC-sealed
+  so a torn file is a typed :class:`~tpuprof.errors.CorruptArtifactError`,
+  never a silent wrong drift report.
+* :func:`resume_profiler` — incremental profiling (incremental.py):
+  rebuild a StreamingProfiler from a fold-able artifact and profile
+  only the delta; ``stored_state ⊕ profile(delta)`` equals a full
+  re-scan byte-for-byte.
+* :func:`compute_drift` / :func:`drift_to_html` — ``tpuprof diff A B``
+  (drift.py, render.py): per-column PSI/KS from the stored histograms,
+  distinct/top-k churn, schema changes, as machine-readable
+  ``tpuprof-drift-v1`` JSON plus an HTML page on the report templates.
+
+See ARTIFACTS.md for the schema, compatibility policy and metric
+definitions, and OBSERVABILITY.md for the ``tpuprof_artifact_*`` /
+``tpuprof_drift_*`` metrics.
+"""
+
+from tpuprof.artifact.drift import (DRIFT_SCHEMA_ID, DriftThresholds,
+                                    compute_drift, ks_statistic,
+                                    psi_statistic)
+from tpuprof.artifact.incremental import resume_profiler
+from tpuprof.artifact.render import drift_to_html
+from tpuprof.artifact.store import (SCHEMA_ID, Artifact, read_artifact,
+                                    write_artifact)
+
+__all__ = [
+    "Artifact", "DRIFT_SCHEMA_ID", "DriftThresholds", "SCHEMA_ID",
+    "compute_drift", "drift_to_html", "ks_statistic", "psi_statistic",
+    "read_artifact", "resume_profiler", "write_artifact",
+]

@@ -1,0 +1,26 @@
+"""Drift dict → HTML, through the report template environment.
+
+The drift page reuses the profile report's shell, CSS and formatter
+filters (report/render.py) so the two products look like one tool; the
+fragment itself is a NEW template (``drift.html``), so profile-report
+HTML stays byte-identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from markupsafe import Markup
+
+
+def drift_to_html(drift: Dict[str, Any],
+                  title: str = "tpuprof drift report") -> str:
+    """Standalone drift page for one ``tpuprof-drift-v1`` dict."""
+    from tpuprof import __version__
+    from tpuprof.report.render import _get_env
+    env = _get_env()
+    fragment = env.get_template("drift.html").render(
+        drift=drift, version=__version__)
+    return env.get_template("base.html").render(
+        title=title, version=__version__,
+        content=Markup(fragment)).lstrip()

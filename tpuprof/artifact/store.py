@@ -1,0 +1,348 @@
+"""Versioned stats-artifact store (ROADMAP item 4, ISSUE 6 tentpole).
+
+An *artifact* is one profile persisted as a single JSON document with
+schema id ``tpuprof-stats-v1``:
+
+* ``stats`` — the full machine-readable export (report/export.py): raw
+  JSON numbers everywhere, human formatting demoted to ``display``.
+* ``sketches`` — the drift inputs the export deliberately excludes as
+  render-layer detail: per-column histogram (counts, edges) and the
+  ranked top-k table, JSON-readable so ``tpuprof diff`` needs no
+  unpickling to compare two artifacts.
+* ``state`` (optional) — the fold-state payload: the SAME
+  ``(device pytree, host aggregators, cursor, meta)`` a streaming
+  checkpoint persists (runtime/stream.export_payload), npz+pickled and
+  base64-embedded with its own CRC.  An artifact carrying it is
+  *fold-able*: ``resume_profiler`` rebuilds the profiler and new
+  fragments merge state-for-state — ``stored_state ⊕ profile(delta)``
+  equals a full re-scan (tests/test_artifact.py merge-law extension).
+  One-shot ``tpuprof profile --artifact`` writes stats-only artifacts
+  (diffable, not fold-able); the fold state, like a checkpoint, is a
+  same-machine-class payload, not a wire-portable format.
+
+Integrity (the PR-4 durability ladder, applied to a NEW artifact
+class): the document carries a CRC32 over its own canonical
+serialization, the write is tmp+fsync+rename atomic, and EVERY read
+failure — truncation at any byte offset, bit rot, junk rewrite, a
+missing or foreign schema id, a torn state payload — surfaces as the
+typed :class:`~tpuprof.errors.CorruptArtifactError` (CLI exit code 6),
+never a raw ``JSONDecodeError``/``UnpicklingError``.  A torn artifact
+can therefore never silently feed a drift report.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import dataclasses
+import io
+import json
+import os
+import pickle
+import time
+import zlib
+from typing import Any, Dict, Optional
+
+from tpuprof.errors import CorruptArtifactError
+from tpuprof.obs import metrics as _obs_metrics
+from tpuprof.report.export import SCHEMA_ID, json_scalar, stats_to_json
+from tpuprof.testing import faults as _faults
+
+_WRITES = _obs_metrics.counter(
+    "tpuprof_artifact_writes_total", "stats artifacts written")
+_READS = _obs_metrics.counter(
+    "tpuprof_artifact_reads_total", "stats artifacts read back")
+_CORRUPT = _obs_metrics.counter(
+    "tpuprof_artifact_corrupt_total",
+    "artifact reads rejected by the integrity checks")
+_WRITE_SECONDS = _obs_metrics.histogram(
+    "tpuprof_artifact_write_seconds",
+    "wall seconds per atomic artifact write (serialize + fsync + rename)")
+_READ_SECONDS = _obs_metrics.histogram(
+    "tpuprof_artifact_read_seconds",
+    "wall seconds per artifact read (disk + CRC + decode)")
+_BYTES = _obs_metrics.gauge(
+    "tpuprof_artifact_bytes", "size of the newest artifact written")
+
+# how many ranked top-k rows ride the sketches section per CAT column —
+# the churn metric's working set (the stats dict's freq tables are
+# already capped at config.top_freq upstream)
+TOPK_SKETCH_ROWS = 50
+
+# canonical serialization the CRC covers: key-sorted, no whitespace —
+# any parsed-value change (even a flipped char inside a string) changes
+# these bytes, so crc32(canonical(parse(file))) detects every mutation
+# the JSON layer itself does not reject
+_CANON = {"sort_keys": True, "separators": (",", ":")}
+
+
+@dataclasses.dataclass
+class Artifact:
+    """One artifact, read back: the JSON sections plus the (already
+    integrity-checked) raw fold-state bytes when present."""
+
+    schema: str
+    meta: Dict[str, Any]
+    stats: Dict[str, Any]
+    sketches: Dict[str, Any]
+    state_bytes: Optional[bytes] = None
+    path: Optional[str] = None
+
+    @property
+    def foldable(self) -> bool:
+        return self.state_bytes is not None
+
+    @property
+    def rows(self) -> int:
+        return int(self.meta.get("rows") or 0)
+
+    @property
+    def columns(self) -> Dict[str, str]:
+        """Column name -> refined kind (NUM/CAT/DATE/...), in profile
+        order."""
+        return dict(self.meta.get("columns") or {})
+
+    def state_payload(self) -> Dict[str, Any]:
+        """Decode the fold-state payload (checkpoint-shaped dict).  Any
+        unpickle failure is typed: the CRC already passed, so a failure
+        here means an incompatible writer, which to a caller is the
+        same 'cannot trust this artifact'."""
+        if self.state_bytes is None:
+            raise CorruptArtifactError(
+                f"artifact {self.path!r} carries no fold state — written "
+                "by a one-shot profile (stats-only); incremental resume "
+                "needs an artifact written from a StreamingProfiler")
+        try:
+            payload = pickle.loads(self.state_bytes)
+        except Exception as exc:
+            raise CorruptArtifactError(
+                f"artifact {self.path!r} fold-state payload does not "
+                f"decode ({type(exc).__name__}: {exc})") from exc
+        if not isinstance(payload, dict) or "host_blob" not in payload:
+            raise CorruptArtifactError(
+                f"artifact {self.path!r} fold-state payload decodes to "
+                "an unexpected layout")
+        return payload
+
+
+def _config_meta(config) -> Dict[str, Any]:
+    """The config knobs two artifacts must agree on for their states
+    (and sketches) to be comparable/mergeable."""
+    if config is None:
+        return {}
+    keys = ("bins", "hll_precision", "topk_capacity",
+            "quantile_sketch_size", "seed", "batch_rows", "nested",
+            "exact_distinct", "top_freq")
+    out = {k: getattr(config, k, None) for k in keys}
+    out["fingerprint"] = config.fingerprint()
+    return out
+
+
+def build_sketches(stats: Dict[str, Any]) -> Dict[str, Any]:
+    """The JSON-readable drift inputs, extracted from a stats dict
+    BEFORE the export drops them: per-column histograms (the PSI/KS
+    substrate) and ranked top-k rows (the churn substrate)."""
+    hists: Dict[str, Any] = {}
+    for name, var in stats["variables"].items():
+        h = var.get("histogram")
+        if h is None:
+            continue
+        counts, edges = h
+        hists[str(name)] = {"counts": [int(c) for c in counts],
+                            "edges": [float(e) for e in edges]}
+    topk: Dict[str, Any] = {}
+    for col, vc in (stats.get("freq") or {}).items():
+        topk[str(col)] = [
+            {"value": json_scalar(idx), "count": int(cnt)}
+            for idx, cnt in list(vc.items())[:TOPK_SKETCH_ROWS]]
+    return {"histograms": hists, "topk": topk}
+
+
+def _encode_state(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Fold-state payload dict -> the embedded JSON entry.  The device
+    pytree is flattened to one npz archive exactly as a checkpoint's is
+    (runtime/checkpoint), so :func:`resume` feeds the SAME restore path
+    a checkpoint does."""
+    import jax
+    import numpy as np
+
+    from tpuprof.runtime import checkpoint as ckpt
+
+    flat = ckpt._flatten(jax.device_get(payload["state"])) \
+        if payload.get("state") is not None else {}
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    wire = {
+        "arrays_npz": buf.getvalue(),
+        "host_blob": payload["host_blob"],
+        # the writer's ProfilerConfig rides along so resume_profiler
+        # rebuilds the same batch/sketch geometry with no out-of-band
+        # config copy (stream.from_payload defaults to it)
+        "config": payload.get("config"),
+        "cursor": int(payload["cursor"]),
+        "meta": payload["meta"],
+    }
+    raw = pickle.dumps(wire, protocol=pickle.HIGHEST_PROTOCOL)
+    return {
+        "encoding": "npz+pickle/base64",
+        "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+        "length": len(raw),
+        "payload": base64.b64encode(raw).decode("ascii"),
+    }
+
+
+def write_artifact(path: str, stats: Optional[Dict[str, Any]] = None,
+                   config=None, profiler=None,
+                   source: Optional[str] = None) -> Dict[str, Any]:
+    """Write one ``tpuprof-stats-v1`` artifact atomically.
+
+    Two entry points:
+
+    * ``write_artifact(path, profiler=stream_prof)`` — snapshot the
+      profiler (force-drains buffered rows) AND embed its fold state:
+      the artifact is incremental-resumable.
+    * ``write_artifact(path, stats=stats_dict, config=cfg)`` — persist
+      an already-computed stats dict (the one-shot ``--artifact`` CLI
+      path): diffable, stats-only.
+
+    Returns the document's ``meta`` section (handy for logging)."""
+    if (profiler is None) == (stats is None):
+        raise ValueError("pass exactly one of profiler= or stats=")
+    t0 = time.perf_counter()
+    state_entry = None
+    if profiler is not None:
+        config = profiler.config
+        state_entry = _encode_state(profiler.export_payload())
+        stats = profiler.stats()
+    meta = {
+        "format": SCHEMA_ID,
+        "tpuprof_version": _version(),
+        "created_unix": round(time.time(), 3),
+        "rows": int(stats["table"]["n"]),
+        "columns": {str(name): var["type"]
+                    for name, var in stats["variables"].items()},
+        "config": _config_meta(config),
+        "foldable": state_entry is not None,
+        "degraded": bool(stats.get("_quarantine")),
+        "source": source,
+    }
+    core = {
+        "schema": SCHEMA_ID,
+        "meta": meta,
+        "stats": stats_to_json(stats),
+        "sketches": build_sketches(stats),
+        "state": state_entry,
+    }
+    doc = dict(core)
+    doc["integrity"] = {
+        "algorithm": "crc32/canonical-json",
+        "crc32": zlib.crc32(json.dumps(core, **_CANON).encode()) & 0xFFFFFFFF,
+    }
+    data = json.dumps(doc, indent=1).encode()
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as fh:
+            _faults.hit("artifact_write", key=meta["rows"])
+            fh.write(_faults.mangle("artifact_write", data))
+            # fsync BEFORE the rename (the checkpoint store's rationale:
+            # os.replace is atomic in the namespace, not for data pages)
+            fh.flush()
+            os.fsync(fh.fileno())
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+    if _obs_metrics.enabled():
+        _WRITES.inc()
+        _WRITE_SECONDS.observe(time.perf_counter() - t0)
+        _BYTES.set(len(data))
+        from tpuprof.obs import events
+        events.emit("artifact_write", path=path, rows=meta["rows"],
+                    bytes=len(data), foldable=meta["foldable"])
+    return meta
+
+
+def read_artifact(path: str) -> Artifact:
+    """Read + integrity-check one artifact.  Every failure mode is the
+    typed :class:`CorruptArtifactError` except a genuinely missing file
+    (``FileNotFoundError`` — "never written" and "rotted" are different
+    operator problems)."""
+    t0 = time.perf_counter()
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except FileNotFoundError:
+        raise
+    except OSError as exc:
+        _mark_corrupt()
+        raise CorruptArtifactError(
+            f"artifact {path!r} is unreadable "
+            f"({type(exc).__name__}: {exc})") from exc
+    try:
+        doc = json.loads(data)
+    except Exception as exc:
+        _mark_corrupt()
+        raise CorruptArtifactError(
+            f"artifact {path!r} is not valid JSON — truncated or "
+            f"corrupt ({type(exc).__name__}: {exc})") from exc
+    if not isinstance(doc, dict):
+        _mark_corrupt()
+        raise CorruptArtifactError(
+            f"artifact {path!r} decodes to {type(doc).__name__}, not an "
+            "artifact document")
+    if doc.get("schema") != SCHEMA_ID:
+        _mark_corrupt()
+        raise CorruptArtifactError(
+            f"artifact {path!r} has schema {doc.get('schema')!r}; this "
+            f"build reads {SCHEMA_ID!r}")
+    integrity = doc.pop("integrity", None)
+    if not isinstance(integrity, dict) or "crc32" not in integrity:
+        _mark_corrupt()
+        raise CorruptArtifactError(
+            f"artifact {path!r} lacks its integrity envelope — torn or "
+            "hand-edited")
+    canon = json.dumps(doc, **_CANON).encode()
+    if zlib.crc32(canon) & 0xFFFFFFFF != integrity["crc32"]:
+        _mark_corrupt()
+        raise CorruptArtifactError(
+            f"artifact {path!r} CRC mismatch — corrupt artifact")
+    state_bytes = None
+    state = doc.get("state")
+    if state is not None:
+        try:
+            state_bytes = base64.b64decode(
+                state["payload"].encode("ascii"), validate=True)
+        except (KeyError, TypeError, AttributeError,
+                binascii.Error) as exc:
+            _mark_corrupt()
+            raise CorruptArtifactError(
+                f"artifact {path!r} fold-state payload does not decode "
+                f"({type(exc).__name__}: {exc})") from exc
+        if len(state_bytes) != state.get("length") or \
+                zlib.crc32(state_bytes) & 0xFFFFFFFF != state.get("crc32"):
+            _mark_corrupt()
+            raise CorruptArtifactError(
+                f"artifact {path!r} fold-state payload fails its CRC — "
+                "torn write")
+    art = Artifact(schema=doc["schema"], meta=doc.get("meta") or {},
+                   stats=doc.get("stats") or {},
+                   sketches=doc.get("sketches") or {},
+                   state_bytes=state_bytes, path=path)
+    if _obs_metrics.enabled():
+        _READS.inc()
+        _READ_SECONDS.observe(time.perf_counter() - t0)
+    return art
+
+
+def _mark_corrupt() -> None:
+    _CORRUPT.inc()
+    from tpuprof.obs import blackbox
+    blackbox.record("artifact_corrupt")
+
+
+def _version() -> str:
+    from tpuprof import __version__
+    return __version__

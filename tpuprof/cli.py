@@ -1,6 +1,7 @@
 """Command-line interface: ``tpuprof profile data.parquet -o report.html``
-(SURVEY.md §7.1 stage 7; the reference has no CLI — notebook-only — so
-this is a capability the TPU framework adds for batch/cluster use)."""
+and ``tpuprof diff A.json B.json -o drift.html`` (SURVEY.md §7.1 stage 7;
+the reference has no CLI — notebook-only — so these are capabilities the
+TPU framework adds for batch/cluster/fleet use)."""
 
 from __future__ import annotations
 
@@ -73,7 +74,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "with no decode at all")
     p.add_argument("--stats-json", metavar="PATH",
                    help="also dump the FULL stats dict as JSON (table, "
-                        "variables, freq, correlations, messages, sample)")
+                        "variables, freq, correlations, messages, sample; "
+                        "tpuprof-stats-v1: raw numbers, human formatting "
+                        "under the parallel 'display' section)")
+    p.add_argument("--artifact", metavar="PATH",
+                   help="also persist the profile as a CRC-sealed "
+                        "tpuprof-stats-v1 stats artifact: the raw-number "
+                        "export plus the histogram/top-k sketches "
+                        "`tpuprof diff` compares (ARTIFACTS.md).  "
+                        "One-shot profiles write stats-only artifacts; "
+                        "fold-able (incremental-resumable) ones come "
+                        "from the StreamingProfiler API")
     p.add_argument("--trace", metavar="DIR",
                    help="capture a jax.profiler trace into DIR")
     p.add_argument("--metrics-json", metavar="PATH",
@@ -184,7 +195,65 @@ def build_parser() -> argparse.ArgumentParser:
     cache_group.add_argument(
         "--no-compile-cache", action="store_true",
         help="disable the persistent compilation cache")
+
+    d = sub.add_parser(
+        "diff", help="compare two stats artifacts and report per-column "
+                     "drift (PSI/KS from stored histograms, distinct/"
+                     "top-k churn, schema changes — ARTIFACTS.md)")
+    d.add_argument("baseline", help="baseline artifact (A) path")
+    d.add_argument("current", help="current artifact (B) path")
+    d.add_argument("-o", "--output", default="drift.html",
+                   help="drift report HTML path (default: drift.html)")
+    d.add_argument("--json", metavar="PATH", dest="drift_json",
+                   help="also write the machine-readable "
+                        "tpuprof-drift-v1 report here")
+    d.add_argument("--psi-threshold", type=float, default=None,
+                   metavar="X",
+                   help="PSI at or above X flags a column as drifting "
+                        "(default 0.25; warn band at half)")
+    d.add_argument("--ks-threshold", type=float, default=None,
+                   metavar="X",
+                   help="KS distance at or above X flags a column as "
+                        "drifting (default 0.2; warn band at half)")
+    d.add_argument("--fail-on-drift", action="store_true",
+                   help="exit 1 when any column reaches drift severity "
+                        "(CI gate); corrupt artifacts exit 6 either way")
     return parser
+
+
+def cmd_diff(args: argparse.Namespace) -> int:
+    from tpuprof.artifact import (DriftThresholds, compute_drift,
+                                  drift_to_html, read_artifact)
+    from tpuprof.errors import CorruptArtifactError, exit_code
+    try:
+        base = read_artifact(args.baseline)
+        current = read_artifact(args.current)
+    except FileNotFoundError as exc:
+        print(f"tpuprof: error: {exc}", file=sys.stderr)
+        return 2
+    except CorruptArtifactError as exc:
+        # the integrity rung (ROBUSTNESS.md): a torn artifact is a
+        # one-line typed failure with its own exit code — it must never
+        # silently become a wrong drift report
+        print(f"tpuprof: error: {exc}", file=sys.stderr)
+        return exit_code(exc)
+    thresholds = DriftThresholds.from_cli(psi=args.psi_threshold,
+                                          ks=args.ks_threshold)
+    drift = compute_drift(base, current, thresholds)
+    with open(args.output, "w", encoding="utf-8") as fh:
+        fh.write(drift_to_html(drift))
+    if args.drift_json:
+        with open(args.drift_json, "w") as fh:
+            json.dump(drift, fh, indent=2)
+    s = drift["summary"]
+    print(f"tpuprof: diff {args.baseline} -> {args.current}: "
+          f"{s['verdict'].upper()} — {s['n_drift']} drifting, "
+          f"{s['n_warn']} warning, {s['n_ok']} stable of "
+          f"{s['columns_compared']} columns -> {args.output}",
+          file=sys.stderr)
+    if args.fail_on_drift and s["n_drift"]:
+        return 1
+    return 0
 
 
 def cmd_profile(args: argparse.Namespace) -> int:
@@ -291,6 +360,7 @@ def cmd_profile(args: argparse.Namespace) -> int:
             else None,
             metrics_path=args.metrics_json,
             metrics_interval=args.metrics_interval,
+            artifact_path=args.artifact,
             compile_cache_dir=cache_dir)
     except ValueError as exc:
         # config validation (duplicate --columns, bad thresholds, ...)
@@ -354,6 +424,14 @@ def cmd_profile(args: argparse.Namespace) -> int:
         if write_output:
             with phase_timer("render"):
                 report.to_file(args.output)
+            if config.artifact_path:
+                # one-shot profiles persist a stats-only artifact
+                # (diffable by `tpuprof diff`; fold-able artifacts come
+                # from the StreamingProfiler API — ARTIFACTS.md)
+                from tpuprof.artifact import write_artifact
+                write_artifact(config.artifact_path,
+                               stats=report.description, config=config,
+                               source=str(args.source))
     elapsed = time.perf_counter() - t0
 
     if ticker is not None:
@@ -383,6 +461,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "profile":
         return cmd_profile(args)
+    if args.command == "diff":
+        return cmd_diff(args)
     raise AssertionError(args.command)
 
 

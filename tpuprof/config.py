@@ -282,6 +282,21 @@ class ProfilerConfig:
                                              # in-memory cache alone
                                              # never carries across
                                              # runs/processes)
+    artifact_path: Optional[str] = None     # persist the finished
+                                            # profile as a CRC-sealed
+                                            # tpuprof-stats-v1 stats
+                                            # artifact (tpuprof/artifact;
+                                            # ARTIFACTS.md): the raw-
+                                            # number export + the
+                                            # histogram/top-k sketches
+                                            # `tpuprof diff` compares.
+                                            # One-shot profiles write
+                                            # stats-only artifacts;
+                                            # fold-able (incremental-
+                                            # resumable) ones come from
+                                            # write_artifact(profiler=
+                                            # StreamingProfiler).
+                                            # CLI: --artifact
     checkpoint_path: Optional[str] = None   # batch-profile resumability:
                                             # persist the pass-A scan here
                                             # every checkpoint_every_batches

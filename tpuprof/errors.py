@@ -20,6 +20,10 @@ raised so existing ``except`` clauses keep working:
   integrity checks, or whose pickle/zip payload is torn.  Never a raw
   ``EOFError``/``UnpicklingError``/``BadZipFile``; the CLI maps it to
   exit code 3.
+* ``CorruptArtifactError`` (ValueError) — a persisted stats artifact
+  (tpuprof/artifact) failed its CRC/schema integrity checks; the CLI's
+  ``diff``/incremental paths map it to exit code 6 so automation can
+  tell "artifact rotted" from "inputs were wrong".
 * ``PoisonBatchError`` (RuntimeError) — a batch kept failing past the
   retry budget AND the quarantine budget (``max_quarantined``) is
   exhausted or disabled; carries the quarantine manifest so callers can
@@ -47,6 +51,14 @@ class CorruptCheckpointError(ValueError):
     truncation, version, undecodable payload)."""
 
 
+class CorruptArtifactError(ValueError):
+    """A stats artifact (tpuprof/artifact store) failed integrity
+    validation: truncated/undecodable JSON, a CRC32 mismatch, a missing
+    or unsupported schema id, or a torn fold-state payload.  A torn
+    artifact must never silently feed a drift report; the CLI maps this
+    to exit code 6."""
+
+
 class PoisonBatchError(RuntimeError):
     """A batch failed permanently and no quarantine budget remains."""
 
@@ -72,13 +84,15 @@ class WatchdogTimeout(TimeoutError):
 # the typed taxonomy the CLI (and the crash flight recorder's
 # postmortem dumps — obs/blackbox.py) treats as "expected failure
 # shapes": one-line message + distinct exit code, no traceback
-TYPED_ERRORS = (InputError, CorruptCheckpointError, PoisonBatchError,
-                WatchdogTimeout)
+TYPED_ERRORS = (InputError, CorruptCheckpointError, CorruptArtifactError,
+                PoisonBatchError, WatchdogTimeout)
 
 _EXIT_CODES = (
-    # order matters: InputError and CorruptCheckpointError are both
-    # ValueErrors — the most specific class must match first
+    # order matters: InputError, CorruptCheckpointError and
+    # CorruptArtifactError are all ValueErrors — the most specific
+    # classes must match first
     (CorruptCheckpointError, 3),
+    (CorruptArtifactError, 6),
     (WatchdogTimeout, 4),
     (PoisonBatchError, 5),
     (InputError, 2),

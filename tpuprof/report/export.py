@@ -6,10 +6,15 @@ of the contract (table, variables, freq, correlations, messages,
 sample), not just table+variables (VERDICT r4 #5 — a computed Spearman
 matrix appeared in the HTML but was dropped from ``--stats-json``).
 
-``table``/``variables`` keep the human-oriented formatter output they
-have had since v0.1 (pinned by tests/test_cli.py); the keys this module
-adds carry raw machine values: floats stay floats (non-finite → null —
-JSON has no NaN), counts stay ints, timestamps become ISO strings.
+Schema ``tpuprof-stats-v1`` (round-5 VERDICT #2): EVERY value in
+``table``/``variables`` is its raw machine form — floats stay floats
+(non-finite → null, JSON has no NaN), counts stay ints, nulls are
+``null``, timestamps become ISO strings.  The human formatter output
+those sections carried through v0.5 (``"distinct_count": "24,449"``)
+is demoted to a parallel ``display`` section with the same key layout,
+so dashboards keep their strings while every downstream consumer parses
+numbers.  The ``schema`` key pins the contract; tests/test_artifact.py
+golden-tests it.
 """
 
 from __future__ import annotations
@@ -58,16 +63,36 @@ def _corr_entry(matrix: pd.DataFrame) -> Dict[str, Any]:
     }
 
 
+# the export contract version: raw-number table/variables with the
+# parallel display section.  Bump ONLY on breaking layout changes; the
+# stats-artifact store (tpuprof/artifact) embeds this id and refuses
+# schemas it does not read.
+SCHEMA_ID = "tpuprof-stats-v1"
+
+
 def stats_to_json(stats: Dict[str, Any]) -> Dict[str, Any]:
     """The complete stats dict as a ``json.dump``-ready structure."""
+    # histograms are render-layer artifacts (bin arrays feeding the
+    # SVG), not column statistics — same exclusion as since v0.1
+    var_items = {
+        name: {k: v for k, v in var.items()
+               if k not in ("histogram", "mini_histogram")}
+        for name, var in stats["variables"].items()}
     out: Dict[str, Any] = {
-        "table": {k: fmt_value(v) for k, v in stats["table"].items()},
-        # histograms are render-layer artifacts (bin arrays feeding the
-        # SVG), not column statistics — same exclusion as since v0.1
+        "schema": SCHEMA_ID,
+        "table": {k: json_scalar(v) for k, v in stats["table"].items()},
         "variables": {
-            name: {k: fmt_value(v) for k, v in var.items()
-                   if k not in ("histogram", "mini_histogram")}
-            for name, var in stats["variables"].items()},
+            name: {k: json_scalar(v) for k, v in var.items()}
+            for name, var in var_items.items()},
+        # the human-formatted twins of table/variables (thousands
+        # separators, ∞/NaN glyphs) — what those sections carried
+        # before v1 demoted them; key layout mirrors the raw sections
+        "display": {
+            "table": {k: fmt_value(v) for k, v in stats["table"].items()},
+            "variables": {
+                name: {k: fmt_value(v) for k, v in var.items()}
+                for name, var in var_items.items()},
+        },
         "freq": {
             str(col): [{"value": json_scalar(idx), "count": int(cnt)}
                        for idx, cnt in vc.items()]

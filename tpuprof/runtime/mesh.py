@@ -235,6 +235,21 @@ class MeshRunner:
         return jax.vmap(lambda _: histogram.init(self.n_num, self.bins))(
             jnp.arange(self.n_dev))
 
+    def place_state(self, state: Pytree) -> Pytree:
+        """Commit host-numpy state leaves onto the mesh with the step
+        programs' state sharding (every leaf is the vmapped per-device
+        stack, P("data") over the leading axis).  Restore paths use
+        this so the first post-restore fold hits the SAME compiled
+        steady-state executable an uninterrupted run uses — uncommitted
+        numpy leaves would compile a fresh signature whose f32 sum
+        order can differ at the last ulp, breaking the incremental
+        path's byte-stability guarantee (tpuprof/artifact)."""
+        # P("data") shards axis 0 and leaves trailing axes whole — the
+        # same per-leaf layout the shard_map out_specs produce
+        sh = NamedSharding(self.mesh, P("data"))
+        return jax.tree.map(
+            lambda a: jax.device_put(np.asarray(a), sh), state)
+
     # -- compiled programs -------------------------------------------------
 
     def _build_programs(self) -> None:

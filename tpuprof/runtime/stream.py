@@ -444,10 +444,15 @@ class StreamingProfiler:
 
     # -- durability --------------------------------------------------------
 
-    def checkpoint(self, path: str) -> None:
-        """Persist (device state, host aggregators, cursor) atomically.
-        Buffered rows fold first — the artifact must cover every row the
-        caller handed to ``update`` (the buffer itself is not saved)."""
+    def export_payload(self) -> Dict[str, Any]:
+        """The state-extraction hook: force-drain, then return the full
+        durable state — ``(device state, host aggregators, cursor,
+        meta)`` — as one payload dict, WITHOUT writing anything.  This
+        is the exact content :meth:`checkpoint` persists; the
+        stats-artifact store (tpuprof/artifact) embeds it so a profile
+        artifact is fold-able (``stored_state ⊕ profile(delta)``), not
+        just readable.  Marks spill runs persistent for the same reason
+        checkpoint does: the returned payload references them by path."""
         with obs.span("drain", rows=int(self._buf_rows), forced=True):
             self._drain(force=True)
         # the artifact references unique-spill runs by path: a crash
@@ -465,12 +470,28 @@ class StreamingProfiler:
             # payloads keep the pre-quarantine byte layout
             host_blob["quarantine"] = list(self._quarantine.entries)
         from tpuprof import native
-        ckpt.save(path, self.state, host_blob, self.cursor,
-                  meta={"n_num": self.plan.n_num, "n_hash": self.plan.n_hash,
-                        "batch_rows": self.config.batch_rows,
-                        "has_state": self.state is not None,
-                        # HLL registers only merge with same-impl hashes
-                        "native_hash": native.available()},
+        return {
+            "state": self.state,
+            "host_blob": host_blob,
+            # the artifact store persists the config alongside the
+            # state so an incremental resume needs no out-of-band copy
+            # (checkpoint() does not write it — byte layout unchanged)
+            "config": self.config,
+            "cursor": self.cursor,
+            "meta": {"n_num": self.plan.n_num, "n_hash": self.plan.n_hash,
+                     "batch_rows": self.config.batch_rows,
+                     "has_state": self.state is not None,
+                     # HLL registers only merge with same-impl hashes
+                     "native_hash": native.available()},
+        }
+
+    def checkpoint(self, path: str) -> None:
+        """Persist (device state, host aggregators, cursor) atomically.
+        Buffered rows fold first — the artifact must cover every row the
+        caller handed to ``update`` (the buffer itself is not saved)."""
+        payload = self.export_payload()
+        ckpt.save(path, payload["state"], payload["host_blob"],
+                  payload["cursor"], meta=payload["meta"],
                   keep=self._ckpt_keep)
         # runs demoted since the previous save are no longer referenced
         # by any artifact — reclaim their disk now
@@ -517,6 +538,23 @@ class StreamingProfiler:
         dying; only a fully-corrupt chain raises
         :class:`CorruptCheckpointError`."""
         payload, _, _used = ckpt.restore_payload(path)
+        return cls.from_payload(payload, config=config, devices=devices)
+
+    @classmethod
+    def from_payload(cls, payload: Dict[str, Any],
+                     config: Optional[ProfilerConfig] = None,
+                     devices: Optional[Sequence] = None
+                     ) -> "StreamingProfiler":
+        """Rebuild a profiler from an already-loaded payload dict (the
+        restore twin of :meth:`export_payload`; the fold-state half of
+        a stats artifact lands here too — tpuprof/artifact).  The
+        payload's ``arrays_npz`` carries the device pytree; host
+        aggregators ride ``host_blob`` as in a checkpoint.  ``config``
+        defaults to the one the payload was written with (artifacts
+        persist it; checkpoint payloads do not — their callers pass
+        one, as ever)."""
+        if config is None:
+            config = payload.get("config")
         host_blob = payload["host_blob"]
         from tpuprof import native
         saved_native = payload["meta"].get("native_hash")
@@ -529,10 +567,13 @@ class StreamingProfiler:
         arrow_schema = pa.ipc.read_schema(pa.py_buffer(host_blob["schema"]))
         prof = cls(arrow_schema, config=config, devices=devices)
         if payload["meta"].get("has_state", True):
-            # leave leaves as host numpy (uncommitted): the first sharded
-            # step places them onto the mesh like freshly-init'd state
-            prof.state = ckpt.materialize(payload,
-                                          prof.runner.init_pass_a())
+            # commit the leaves with the step programs' state sharding:
+            # the first post-restore fold then reuses the steady-state
+            # executable, so a resumed stream folds bit-identically to
+            # an uninterrupted one (the incremental artifact path's
+            # byte-stability guarantee rests on this)
+            prof.state = prof.runner.place_state(
+                ckpt.materialize(payload, prof.runner.init_pass_a()))
         prof.hostagg = host_blob["hostagg"]
         saved_sampler = host_blob["sampler"]
         if saved_sampler.k != prof.config.quantile_sketch_size:

@@ -15,6 +15,7 @@ Sites wired today (grep ``faults.hit`` / ``faults.mangle``):
 ``prep``                  per-batch host prepare (retried; quarantinable)
 ``fold``                  per-batch fold into device/host state (quarantinable)
 ``checkpoint_write``      inside ``checkpoint.save``'s tmp-file write
+``artifact_write``        inside the stats-artifact store's tmp-file write
 ``device_wait``           the watched device drain (``block_until_ready``)
 ``barrier``               the watched multi-host resume barrier
 ========================  ==================================================
