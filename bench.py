@@ -251,6 +251,18 @@ def _measure_artifact() -> dict:
     return measure_drift(1 << 15 if _SMOKE else 1 << 17)
 
 
+def _measure_rebalance() -> dict:
+    """Elastic fleet cost envelope (ISSUE 7): the clean-path overhead
+    of the claim/contribute/finish machinery (``steal_overhead_pct``,
+    bound <1% like guardrail_overhead_pct) and the scheduler's
+    detect+steal+replay latency (``rebalance_latency_s``) — the
+    `rebalance` scenario (benchmarks/run.py) tracks the same figures."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.run import measure_rebalance
+    return measure_rebalance(1 << 15 if _SMOKE else 1 << 17)
+
+
 def _measure_guardrail() -> dict:
     """Clean-path cost of the fault-tolerance plumbing (ISSUE 4): the
     retry-guard wrapper on the serial prepare loop, A/B'd in the same
@@ -281,6 +293,7 @@ def main() -> None:
         host_prep = _measure_host_prep()  # before any device traffic
     guardrail = _measure_guardrail()      # host-only A/B, same fixture
     artifact = _measure_artifact()        # store + incremental costs
+    rebalance = _measure_rebalance()      # elastic scheduler envelope
     render_s = _measure_render()          # host-only, before the device
 
     devices = jax.devices()[:1]           # single-chip measurement
@@ -374,6 +387,11 @@ def main() -> None:
         "artifact_bytes": artifact["artifact_bytes"],
         "incremental_vs_full_speedup":
             artifact["incremental_vs_full_speedup"],
+        # elastic fleet runtime (ISSUE 7): clean-path cost of the
+        # claim/contribute machinery (bound <1%) and the scheduler's
+        # dead-member detect+steal+replay latency
+        "steal_overhead_pct": rebalance["steal_overhead_pct"],
+        "rebalance_latency_s": rebalance["rebalance_latency_s"],
         "device_mem_in_use_bytes": int(device_mem_in_use),
         # per-stage breakdown (obs spans; NEW keys only — existing keys
         # above keep their names so BENCH_r* comparisons stay valid)

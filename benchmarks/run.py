@@ -679,8 +679,115 @@ def run_drift(scale: float, workdir: str) -> dict:
     return out
 
 
+def measure_rebalance(rows: int, n_frags: int = 6) -> dict:
+    """Elastic fleet cost envelope (ISSUE 7).  Two figures:
+
+    * ``steal_overhead_pct`` — clean-path cost of running the SAME
+      profile through the elastic claim/contribute/finish machinery
+      (one member, nobody dies) vs the static stripe, A/B'd in one
+      process.  Acceptance bound <1% like ``guardrail_overhead_pct``;
+      at smoke scale the noise band swallows the true cost, so the
+      signal is 'persistently above 1%', not any single round.
+    * ``rebalance_latency_s`` — wall time for a survivor's finish
+      barrier to detect a departed member (deleted heartbeat), steal
+      its claimed fragments, replay them (host-side re-read), and
+      reach full coverage — the scheduler's contribution to recovery,
+      excluding device folds (those are the same folds any scan pays).
+    """
+    import tempfile
+
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from benchmarks import scenarios
+    from tpuprof import ProfilerConfig
+    from tpuprof.backends.tpu import (TPUStatsBackend,
+                                      disable_compile_cache)
+    from tpuprof.ingest.arrow import ArrowIngest
+    from tpuprof.runtime import fleet as fleetrt
+
+    # same reasoning as run_drift: the leg builds several MeshRunner
+    # instances back to back, which this box's jaxlib intermittently
+    # aborts on when the persistent compilation cache is enabled
+    disable_compile_cache()
+    rng = np.random.default_rng(0)
+    per_frag = max(rows // n_frags, 256)
+    with tempfile.TemporaryDirectory() as td:
+        ds = os.path.join(td, "ds")
+        os.makedirs(ds)
+        for f in range(n_frags):
+            pq.write_table(pa.Table.from_pandas(
+                scenarios.taxi_batch(rng, per_frag),
+                preserve_index=False), os.path.join(ds, f"p{f}.parquet"))
+
+        def run(elastic: bool, tag: str) -> float:
+            cfg = ProfilerConfig(
+                backend="tpu", batch_rows=1 << 12, elastic=elastic,
+                fleet_dir=os.path.join(td, f"fleet_{tag}")
+                if elastic else None,
+                fleet_host_id="bench" if elastic else None)
+            t0 = time.perf_counter()
+            TPUStatsBackend().collect(ds, cfg)
+            return time.perf_counter() - t0
+
+        run(False, "warm")              # compile warm-up: neither leg
+        static_s = run(False, "static")  # pays first-compile
+        elastic_s = run(True, "elastic")
+        overhead_pct = (elastic_s - static_s) / static_s * 100
+
+        # rebalance latency at the scheduler level: a departed member
+        # holds 2 uncontributed claims; the survivor's finish barrier
+        # must notice, steal, replay (host re-read) and cover
+        fdir = os.path.join(td, "fleet_lat")
+        ingest = ArrowIngest(ds, 1 << 12)
+        fp = ingest.fingerprint()
+        dead = fleetrt.FleetMember(fdir, "dead", n_frags, fp,
+                                   liveness_timeout_s=5.0)
+        assert dead.claim_next("a") == 0 and dead.claim_next("a") == 1
+        dead.depart()
+        survivor = fleetrt.FleetMember(fdir, "live", n_frags, fp,
+                                       liveness_timeout_s=5.0)
+        while survivor.claim_next("a") is not None:
+            pass
+        survivor.contribute("a", {"rows": 0},
+                            sorted(survivor.claimed("a")))
+
+        def replay(frags):
+            n = sum(rb.num_rows for fi in frags
+                    for _f, _b, rb in ingest.read_fragment(fi))
+            return {"rows": int(n)}
+
+        t0 = time.perf_counter()
+        parts = survivor.finish("a", replay, timeout_s=60)
+        latency_s = time.perf_counter() - t0
+        survivor.close()
+        stolen = sum(len(p["fragments"]) for p in parts
+                     if p["host"] == "live" and p["seq"] > 0)
+
+    total_rows = per_frag * n_frags
+    return {
+        "rows": total_rows,
+        "fragments": n_frags,
+        "static_s": round(static_s, 3),
+        "elastic_s": round(elastic_s, 3),
+        "steal_overhead_pct": round(overhead_pct, 4),
+        "rebalance_latency_s": round(latency_s, 4),
+        "fragments_stolen": int(stolen),
+        "rows_per_sec": round(total_rows / elastic_s, 1),
+    }
+
+
+def run_rebalance(scale: float, workdir: str) -> dict:
+    rows = max(int(5_000_000 * scale), 20_000)
+    out = measure_rebalance(rows)
+    out["scenario"] = "rebalance"
+    return out
+
+
 REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
-                        "hostfed", "prepare", "passb", "faults", "drift")
+                        "hostfed", "prepare", "passb", "faults", "drift",
+                        "rebalance")
 
 
 def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
@@ -828,6 +935,7 @@ def main() -> None:
                                              "wide1b", "streaming",
                                              "hostfed", "prepare",
                                              "passb", "faults", "drift",
+                                             "rebalance",
                                              "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
@@ -863,7 +971,7 @@ def main() -> None:
         pass                      # older jaxlibs: warm == cold, still valid
 
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
-              "prepare", "passb", "faults", "drift"]
+              "prepare", "passb", "faults", "drift", "rebalance"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -882,6 +990,8 @@ def main() -> None:
             result = run_faults(args.scale, args.workdir)
         elif name == "drift":
             result = run_drift(args.scale, args.workdir)
+        elif name == "rebalance":
+            result = run_rebalance(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

@@ -522,3 +522,50 @@ class TestTickerAndClose:
         prof.close()
         prof.close()                    # second close: no-op, no raise
         assert prof._closed is True
+
+
+class TestHostDeath:
+    """The ``host_death:@k`` site (ISSUE 7): a deterministic
+    participation kill — typed, unretryable, never quarantinable."""
+
+    def test_grammar_fires_exactly_once_at_k(self):
+        from tpuprof.errors import HostDeathError
+        faults.install(faults.FaultPlan.from_spec("host_death:@3"))
+        for k in range(2):
+            faults.hit("host_death", key=k)     # calls 1..2 pass
+        with pytest.raises(HostDeathError) as exc:
+            faults.hit("host_death", key=2)     # the 3rd call dies
+        assert exc.value.at_call == 3
+        assert faults.injected("host_death") == 1
+        # one-shot: the process is expected to be gone; later calls
+        # (e.g. a test harness reusing the plan) must not re-fire
+        faults.hit("host_death", key=3)
+        assert faults.injected("host_death") == 1
+
+    def test_grammar_rejects_bad_call_number(self):
+        with pytest.raises(ValueError):
+            faults.FaultPlan.from_spec("host_death:@0")
+
+    def test_stream_fold_honors_host_death(self):
+        from tpuprof.errors import HostDeathError
+        from tpuprof.runtime.stream import StreamingProfiler
+        frames = _micro_frames(6)
+        prof = StreamingProfiler.for_example(
+            frames[0], config=_stream_cfg(max_quarantined=100))
+        faults.configure("host_death:@4")
+        # quarantine budget MUST NOT absorb the death: it is not a
+        # poison batch, it is this process leaving the fleet
+        with pytest.raises(HostDeathError):
+            for f in frames:
+                prof.update(f)
+        assert prof.cursor == 3         # three batches folded, then dead
+        faults.reset()
+        prof.close()
+
+    def test_host_death_is_not_transient(self):
+        from tpuprof.errors import HostDeathError
+        assert not guard.is_transient(HostDeathError("x", 1))
+
+    def test_cli_maps_host_death_to_exit_8(self):
+        from tpuprof.errors import HostDeathError, exit_code
+        assert exit_code(HostDeathError("host_death", 4)) == 8

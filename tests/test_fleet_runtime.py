@@ -1,0 +1,664 @@
+"""Elastic fleet runtime suite (ISSUE 7; ROBUSTNESS.md rung 5).
+
+Covers the work-stealing fragment scheduler's shared-directory
+primitives (claims, done markers, steal arbitration, CRC-sealed
+manifest/parts), the end-to-end equalities — elastic == static on one
+host, survivor == clean run after a deterministic ``host_death:@k``
+kill, join/adopt == uninterrupted at fold-boundary alignment — and the
+satellites: manifest-durability corruption sweeps, the taxonomy-doc
+sync check, retry-backoff/elastic env round-trips, and the
+elasticity-off byte-identity pins.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof import ProfilerConfig
+from tpuprof.errors import (CorruptManifestError, HostDeathError,
+                            InputError, exit_code)
+from tpuprof.obs import metrics as obs_metrics
+from tpuprof.runtime import fleet as fleetrt
+from tpuprof.testing import faults
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    faults.reset()
+    was = obs_metrics.enabled()
+    obs_metrics.set_enabled(True)       # counters record for asserts
+    obs_metrics.registry().reset()
+    yield
+    obs_metrics.registry().reset()
+    obs_metrics.set_enabled(was)
+    faults.reset()
+
+
+def _member(tmp_path, host, n=4, fp="src", **kw):
+    kw.setdefault("liveness_timeout_s", 30.0)
+    return fleetrt.FleetMember(str(tmp_path / "fleet"), host, n, fp, **kw)
+
+
+def _make_ds(tmp_path, n_frags=4, rows_each=1500, seed=0, name="ds"):
+    rng = np.random.default_rng(seed)
+    ds_dir = tmp_path / name
+    ds_dir.mkdir()
+    for f in range(n_frags):
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "a": rng.normal(5, 2, rows_each),
+            "b": rng.exponential(1.5, rows_each),
+            "c": rng.choice(["x", "y", "z"], rows_each),
+        }), preserve_index=False), str(ds_dir / f"p{f}.parquet"))
+    return str(ds_dir)
+
+
+# ---------------------------------------------------------------------------
+# shared-directory primitives
+# ---------------------------------------------------------------------------
+
+class TestPrimitives:
+
+    def test_claims_are_exclusive_and_exhaustive(self, tmp_path):
+        a = _member(tmp_path, "a", n=5)
+        b = _member(tmp_path, "b", n=5)
+        got_a = set()
+        got_b = set()
+        while True:
+            k = a.claim_next("a")
+            if k is None:
+                break
+            got_a.add(k)
+            k = b.claim_next("a")
+            if k is not None:
+                got_b.add(k)
+        assert not (got_a & got_b)
+        assert got_a | got_b == set(range(5))
+        a.close(), b.close()
+
+    def test_manifest_mismatch_is_input_error(self, tmp_path):
+        a = _member(tmp_path, "a", n=4, fp="src1")
+        with pytest.raises(InputError):
+            _member(tmp_path, "b", n=4, fp="src2")
+        with pytest.raises(InputError):
+            _member(tmp_path, "c", n=5, fp="src1")
+        a.close()
+
+    def test_adoption_restores_claims_and_done(self, tmp_path):
+        a = _member(tmp_path, "a", n=4)
+        assert a.claim_next("a") == 0
+        assert a.claim_next("a") == 1
+        a.mark_done("a", 0)
+        a.depart()                      # simulated death
+        heir = _member(tmp_path, "a", n=4)
+        assert heir.claimed("a") == {0, 1}
+        assert heir.done("a") == {0}
+        heir.undo_done("a", [0])
+        assert heir.done("a") == set()
+        heir.close()
+
+    def test_steal_arbitration_single_winner(self, tmp_path):
+        dead = _member(tmp_path, "dead", n=3)
+        assert dead.claim_next("a") == 0
+        dead.depart()
+        s1 = _member(tmp_path, "s1", n=3)
+        s2 = _member(tmp_path, "s2", n=3)
+        # both survivors observe the same dead owner + generation and
+        # race the O_EXCL create: exactly one wins
+        _, g1 = s1._owner_gen("a", 0)
+        _, g2 = s2._owner_gen("a", 0)
+        assert g1 == g2 == 1
+        assert {s1._steal("a", 0, g1), s2._steal("a", 0, g2)} \
+            == {True, False}
+        # the thief is now the owner; a stale decision cannot re-rob a
+        # live thief (the generation moved on)
+        live = s1.live_hosts()
+        owner = s1._owner("a", 0)
+        assert owner in ("s1", "s2") and not s1.is_dead(owner, live)
+        s1.close(), s2.close()
+
+    def test_finish_steals_dead_hosts_fragments(self, tmp_path):
+        dead = _member(tmp_path, "dead", n=3)
+        assert dead.claim_next("x") == 0
+        assert dead.claim_next("x") == 1
+        dead.depart()                   # contributed nothing
+
+        survivor = _member(tmp_path, "s", n=3)
+        assert survivor.claim_next("x") == 2
+        survivor.contribute("x", {"v": 1}, [2])
+        scanned = []
+
+        def steal_scan(frags):
+            scanned.append(list(frags))
+            return {"v": 2}
+
+        parts = survivor.finish("x", steal_scan, timeout_s=30)
+        assert scanned == [[0, 1]]
+        # deterministic merge order: (host, seq) — the survivor's own
+        # contribution (seq 0) precedes its steal part (seq 1)
+        assert [p["fragments"] for p in parts] == [[2], [0, 1]]
+        reg = obs_metrics.registry()
+        assert reg.counter(
+            "tpuprof_fleet_rebalances_total").total() == 1
+        assert reg.counter(
+            "tpuprof_fragments_stolen_total").total() == 2
+        survivor.close()
+
+    def test_finish_waits_for_live_peer(self, tmp_path):
+        """A LIVE peer's unfinished fragment is waited on, not stolen —
+        the watchdog deadline converts a genuinely wedged fleet into a
+        typed failure instead of a wrong steal."""
+        from tpuprof.errors import WatchdogTimeout
+        slow = _member(tmp_path, "slow", n=2)
+        assert slow.claim_next("x") == 0
+        fast = _member(tmp_path, "fast", n=2)
+        assert fast.claim_next("x") == 1
+        fast.contribute("x", {}, [1])
+        with pytest.raises(WatchdogTimeout):
+            fast.finish("x", lambda f: {}, timeout_s=0.6)
+        assert fleetrt._STOLEN.total() == 0
+        slow.close(), fast.close()
+
+    def test_part_roundtrip_and_corruption_sweep(self):
+        payload = {"rows": 123, "arr": np.arange(4)}
+        raw = fleetrt.write_part_bytes(payload)
+        back = fleetrt.read_part_bytes(raw)
+        assert back["rows"] == 123
+        # torn at EVERY byte offset: always the typed error, never a
+        # raw EOFError/UnpicklingError (the PR-4 sweep, for parts)
+        for cut in range(len(raw)):
+            with pytest.raises(CorruptManifestError):
+                fleetrt.read_part_bytes(raw[:cut])
+        # bit flips in the payload region trip the CRC
+        flipped = bytearray(raw)
+        flipped[-1] ^= 0xFF
+        with pytest.raises(CorruptManifestError):
+            fleetrt.read_part_bytes(bytes(flipped))
+
+    def test_manifest_bytes_corruption_sweep(self):
+        doc = {"n_fragments": 7, "fingerprint": "abc"}
+        raw = fleetrt.write_manifest_bytes(doc)
+        assert fleetrt.read_manifest_bytes(raw) == doc
+        for cut in range(len(raw) - 1):
+            with pytest.raises(CorruptManifestError):
+                fleetrt.read_manifest_bytes(raw[:cut])
+        with pytest.raises(CorruptManifestError):
+            fleetrt.read_manifest_bytes(raw.replace(b"abc", b"abd"))
+
+    def test_torn_manifest_file_is_typed(self, tmp_path):
+        a = _member(tmp_path, "a", n=4)
+        a.close()
+        path = tmp_path / "fleet" / "manifest.json"
+        path.write_bytes(path.read_bytes()[:20])
+        with pytest.raises(CorruptManifestError):
+            _member(tmp_path, "b", n=4)
+
+    def test_read_parts_skips_in_flight_tmp_files(self, tmp_path):
+        """A reader racing another member's atomic part write must see
+        either nothing or the complete file — never the in-flight tmp
+        bytes.  Regression: the tmp used to be named
+        ``part.<phase>.<host>.<seq>.tmp.<pid>``, which still matched
+        the ``part.<phase>.`` prefix scan, so a concurrent finish
+        barrier read torn bytes and died with CorruptManifestError."""
+        a = _member(tmp_path, "a", n=2)
+        a.contribute("a", {"rows": 5}, [0])
+        fleet = tmp_path / "fleet"
+        # an in-flight write: both the current dot-prefixed tmp naming
+        # and the old colliding one must be ignored by the scans
+        (fleet / ".tmp.part.a.b.0.77").write_bytes(b"torn")
+        (fleet / "part.a.b.0.tmp.77").write_bytes(b"torn")
+        (fleet / ".tmp.wire.b.77").write_bytes(b"torn")
+        parts = a.read_parts("a")
+        assert [p["host"] for p in parts] == ["a"]
+        assert a.coverage("a") == {0}
+        # a COMPLETED torn part still raises — only tmps are skipped
+        (fleet / "part.a.b.0").write_bytes(b"torn")
+        with pytest.raises(CorruptManifestError):
+            a.read_parts("a")
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end equalities
+# ---------------------------------------------------------------------------
+
+def _collect(ds, **kw):
+    from tpuprof.backends.tpu import TPUStatsBackend
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("batch_rows", 512)
+    return TPUStatsBackend().collect(ds, ProfilerConfig(**kw))
+
+
+def _key_stats(stats):
+    v = stats["variables"]
+    return {
+        "n": stats["table"]["n"],
+        "mean_a": float(v["a"]["mean"]),
+        "std_a": float(v["a"]["std"]),
+        "min_a": float(v["a"]["min"]),
+        "max_a": float(v["a"]["max"]),
+        "hist_a": [int(x) for x in v["a"]["histogram"][0]],
+        "distinct_c": int(v["c"]["distinct_count"]),
+        "top_c": str(v["c"]["top"]),
+        "freq_c": int(v["c"]["freq"]),
+    }
+
+
+class TestElasticCollect:
+
+    def test_single_member_matches_static_exactly(self, tmp_path):
+        ds = _make_ds(tmp_path)
+        static = _key_stats(_collect(ds))
+        elastic = _key_stats(_collect(
+            ds, elastic=True, fleet_dir=str(tmp_path / "fleet"),
+            fleet_host_id="h0", liveness_timeout_s=30.0))
+        # one member claims fragments in manifest order = the static
+        # stream; every statistic (f32 sums included) matches exactly
+        assert elastic == static
+
+    def test_elastic_requires_fleet_dir(self, tmp_path):
+        ds = _make_ds(tmp_path, n_frags=1, rows_each=64)
+        with pytest.raises(InputError):
+            _collect(ds, elastic=True)
+
+    def test_host_id_cannot_be_a_path(self, tmp_path):
+        with pytest.raises(InputError):
+            _member(tmp_path, "../evil")
+
+    def test_elastic_rejects_cpu_oracle(self, tmp_path):
+        """The oracle ignores runtime knobs silently (perf-only), but
+        elastic changes WHO does the work: N oracle members would each
+        profile everything and race on the output — reject loudly."""
+        from tpuprof.api import describe
+        df = pd.DataFrame({"a": [1.0, 2.0, 3.0]})
+        with pytest.raises(InputError, match="streaming engine"):
+            describe(df, ProfilerConfig(
+                backend="cpu", elastic=True,
+                fleet_dir=str(tmp_path / "fleet"), fleet_host_id="h0"))
+
+    def test_join_adopts_manifest_and_checkpoint_byte_identical(
+            self, tmp_path):
+        """ISSUE 7 acceptance: a process joining at a resume barrier
+        adopts the manifest + checkpoint cursor (handoff token) and the
+        final report is byte-identical to an uninterrupted elastic
+        run's at fold-boundary alignment (the kill lands right after a
+        checkpoint save)."""
+        from tpuprof.backends.tpu import TPUStatsBackend
+        from tpuprof.report.render import to_standalone_html
+        ds = _make_ds(tmp_path, seed=3)
+
+        def cfg(tag):
+            return ProfilerConfig(
+                backend="tpu", batch_rows=512, scan_batches=3,
+                elastic=True, fleet_dir=str(tmp_path / f"fleet{tag}"),
+                fleet_host_id="h0", liveness_timeout_s=30.0,
+                checkpoint_path=str(tmp_path / f"ck{tag}"),
+                checkpoint_every_batches=3)
+
+        def html(stats, config):
+            # the pipeline footer carries wall-clock timings — the one
+            # legitimately non-deterministic section; everything else
+            # must match byte-for-byte
+            stats = dict(stats)
+            stats.pop("_phases", None)
+            stats.pop("_obs", None)
+            return to_standalone_html(stats, config)
+
+        c1 = cfg(1)
+        control = html(TPUStatsBackend().collect(ds, c1), c1)
+
+        # die on the 7th fold: cursor 6 (= two full fragments) is on
+        # the cadence-3 checkpoint boundary, so the handoff is
+        # fold-boundary aligned
+        faults.configure("host_death:@7", seed=0)
+        c2 = cfg(2)
+        with pytest.raises(HostDeathError):
+            TPUStatsBackend().collect(ds, c2)
+        faults.reset()
+        assert os.path.exists(str(tmp_path / "ck2"))
+        # the joiner presents the same fleet_host_id: it adopts the
+        # manifest claims + the checkpoint cursor and finishes
+        resumed = html(TPUStatsBackend().collect(ds, c2), c2)
+        assert resumed == control       # byte-for-byte
+
+    def test_checkpoint_carries_fleet_done_manifest(self, tmp_path):
+        """The completed-fragment claims are durable: they ride the
+        checkpoint payload (inside its CRC envelope)."""
+        from tpuprof.backends.tpu import TPUStatsBackend
+        from tpuprof.runtime import checkpoint as ckpt
+        ds = _make_ds(tmp_path)
+        cfg = ProfilerConfig(
+            backend="tpu", batch_rows=512, elastic=True,
+            fleet_dir=str(tmp_path / "fleet"), fleet_host_id="h0",
+            liveness_timeout_s=30.0,
+            checkpoint_path=str(tmp_path / "ck"),
+            checkpoint_every_batches=6)
+        faults.configure("host_death:@8", seed=0)
+        with pytest.raises(HostDeathError):
+            TPUStatsBackend().collect(ds, cfg)
+        faults.reset()
+        payload = ckpt.load_payload(str(tmp_path / "ck"))
+        assert payload["host_blob"]["fleet_done"] == [0]
+        assert payload["cursor"] == 6
+
+    def test_elastic_checkpoint_truncation_sweep_is_typed(
+            self, tmp_path):
+        """Manifest durability (ISSUE 7 satellite): the fleet_done
+        manifest rides the checkpoint — truncating the artifact at a
+        sweep of byte offsets must surface as the typed checkpoint
+        error (or fall back cleanly), NEVER a raw unpickle/EOF."""
+        from tpuprof.backends.tpu import TPUStatsBackend
+        from tpuprof.errors import CorruptCheckpointError
+        from tpuprof.runtime import checkpoint as ckpt
+        ds = _make_ds(tmp_path, n_frags=2, rows_each=600)
+        path = str(tmp_path / "ck")
+        cfg = ProfilerConfig(
+            backend="tpu", batch_rows=512, elastic=True,
+            fleet_dir=str(tmp_path / "fleet"), fleet_host_id="h0",
+            liveness_timeout_s=30.0, checkpoint_path=path,
+            checkpoint_every_batches=2)
+        faults.configure("host_death:@3", seed=0)
+        with pytest.raises(HostDeathError):
+            TPUStatsBackend().collect(ds, cfg)
+        faults.reset()
+        raw = open(path, "rb").read()
+        assert b"fleet_done" in raw     # the manifest is really there
+        step = max(len(raw) // 64, 1)
+        for cut in list(range(0, len(raw), step)) + [len(raw) - 1]:
+            with open(path, "wb") as fh:
+                fh.write(raw[:cut])
+            with pytest.raises(CorruptCheckpointError):
+                ckpt.load_payload(path)
+
+
+@pytest.mark.smoke
+class TestTwoProcessHostDeath:
+
+    _WORKER = r"""
+import os, sys, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[5])
+host, ds, out, fleet = sys.argv[1:5]
+from tpuprof import ProfilerConfig
+from tpuprof.backends.tpu import TPUStatsBackend
+from tpuprof.errors import HostDeathError, exit_code
+from tpuprof.testing import faults
+from tpuprof.obs import metrics
+try:
+    stats = TPUStatsBackend().collect(ds, ProfilerConfig(
+        backend="tpu", batch_rows=512, elastic=True, fleet_dir=fleet,
+        fleet_host_id=host, liveness_timeout_s=4.0,
+        metrics_enabled=True, metrics_path=out + ".events.jsonl"))
+except HostDeathError as exc:
+    json.dump({"died": True,
+               "injected": faults.injected("host_death")},
+              open(out, "w"))
+    sys.exit(exit_code(exc))
+v = stats["variables"]
+reg = metrics.registry()
+json.dump({
+    "n": stats["table"]["n"],
+    "mean_a": float(v["a"]["mean"]),
+    "std_a": float(v["a"]["std"]),
+    "distinct_c": int(v["c"]["distinct_count"]),
+    "top_c": str(v["c"]["top"]),
+    "freq_c": int(v["c"]["freq"]),
+    "hist_a": [int(x) for x in v["a"]["histogram"][0]],
+    "stolen": reg.counter("tpuprof_fragments_stolen_total").total(),
+    "rebalances": reg.counter("tpuprof_fleet_rebalances_total").total(),
+}, open(out, "w"))
+"""
+
+    def test_survivor_completes_with_clean_run_stats(self, tmp_path):
+        """ISSUE 7 acceptance: one of two members hits
+        ``host_death:@k`` after k batches; the survivor re-shards the
+        manifest, replays the dead member's uncheckpointed work, and
+        finishes with stats equal to a clean single-process run —
+        ``.fleet.prom`` shows the rebalance and the stolen-fragment
+        count cross-checks the steal markers on disk."""
+        ds = _make_ds(tmp_path, n_frags=6, seed=7)
+        ctrl = _key_stats(_collect(ds))
+
+        worker = tmp_path / "worker.py"
+        worker.write_text(self._WORKER)
+        fleet = str(tmp_path / "fleet")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        outs = [str(tmp_path / f"r{i}.json") for i in range(2)]
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("PYTHONPATH", "TPUPROF_FAULTS")}
+        env_victim = dict(env)
+        # deterministic per rank: only the victim carries the spec
+        env_victim["TPUPROF_FAULTS"] = "host_death:@4"
+        procs = [subprocess.Popen(
+            [sys.executable, str(worker), f"h{i}", ds, outs[i], fleet,
+             repo],
+            env=(env_victim if i == 0 else env),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for i in range(2)]
+        logs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            logs.append(out.decode())
+        assert procs[0].returncode == 8, logs[0][-2000:]    # exit_code map
+        assert procs[1].returncode == 0, logs[1][-2000:]
+
+        victim = json.load(open(outs[0]))
+        assert victim == {"died": True, "injected": 1}
+        got = json.load(open(outs[1]))
+        # merge-law equality vs the clean run: exact where the laws are
+        # exact, f32-merge tolerance on the moment sums
+        assert got["n"] == ctrl["n"]
+        assert got["mean_a"] == pytest.approx(ctrl["mean_a"], rel=1e-6)
+        assert got["std_a"] == pytest.approx(ctrl["std_a"], rel=1e-5)
+        assert got["hist_a"] == ctrl["hist_a"]              # exact
+        assert got["distinct_c"] == ctrl["distinct_c"] == 3
+        assert (got["top_c"], got["freq_c"]) == \
+            (ctrl["top_c"], ctrl["freq_c"])                 # exact recount
+        # the rebalance happened and was counted
+        assert got["rebalances"] >= 1
+        steal_markers = [n for n in os.listdir(fleet)
+                         if n.startswith("steal.")]
+        assert got["stolen"] == len(steal_markers) >= 1
+
+        # .fleet.prom: written by the surviving leader, shows the
+        # rebalance counters with host labels intact
+        from test_obs_smoke import parse_prom
+        prom_path = outs[1] + ".events.jsonl.fleet.prom"
+        assert os.path.exists(prom_path), "survivor wrote no fleet dump"
+        prom = parse_prom(open(prom_path).read())
+        reb = sum(v for _, _, v in
+                  prom["tpuprof_fleet_rebalances_total"]["samples"])
+        stol = sum(v for _, _, v in
+                   prom["tpuprof_fragments_stolen_total"]["samples"])
+        assert reb >= 1
+        assert stol == got["stolen"]
+        hosts = {l.get("host") for _, l, _ in
+                 prom["tpuprof_fleet_fragments_claimed"]["samples"]}
+        assert "h1" in hosts
+
+
+# ---------------------------------------------------------------------------
+# satellites: byte-identity off-path, taxonomy sync, env round-trips
+# ---------------------------------------------------------------------------
+
+class TestFixedMembershipUntouched:
+
+    def test_default_config_resolves_elastic_off(self, monkeypatch):
+        from tpuprof.config import resolve_elastic
+        monkeypatch.delenv("TPUPROF_ELASTIC", raising=False)
+        assert resolve_elastic(ProfilerConfig().elastic) is False
+
+    def test_default_checkpoint_payload_has_no_fleet_keys(
+            self, tmp_path):
+        """Elasticity off (the default) must leave checkpoint payload
+        bytes untouched: no fleet_done key ever enters the host blob."""
+        from tpuprof.backends.tpu import TPUStatsBackend
+        from tpuprof.runtime import checkpoint as ckpt
+        ds = _make_ds(tmp_path, n_frags=2, rows_each=600)
+        path = str(tmp_path / "ck")
+        cfg = ProfilerConfig(backend="tpu", batch_rows=512,
+                             checkpoint_path=path,
+                             checkpoint_every_batches=2)
+
+        saved = []
+        real = ckpt.save
+
+        def spy(p, state, host_blob, cursor, meta, **kw):
+            saved.append(set(host_blob))
+            return real(p, state, host_blob, cursor, meta, **kw)
+
+        import unittest.mock as mock
+        with mock.patch.object(ckpt, "save", spy):
+            TPUStatsBackend().collect(ds, cfg)
+        assert saved and all("fleet_done" not in keys for keys in saved)
+
+    def test_default_html_identical_to_explicit_elastic_false(
+            self, tmp_path):
+        from tpuprof.report.render import to_standalone_html
+        ds = _make_ds(tmp_path, n_frags=2, rows_each=600)
+
+        def html(**kw):
+            cfg = ProfilerConfig(backend="tpu", batch_rows=512, **kw)
+            stats = dict(_collect(ds, **kw))
+            stats.pop("_phases", None)
+            return to_standalone_html(stats, cfg)
+
+        assert html() == html(elastic=False)
+
+
+class TestTaxonomyDocSync:
+    """ISSUE 7 satellite: every typed error in tpuprof/errors.py must
+    have a documented exit code in ROBUSTNESS.md's taxonomy table —
+    and the documented codes must match errors.exit_code — so the
+    table can never drift again (it had: PoisonBatchError was mapped
+    to exit 5 in PR 5 while the doc still said 'traceback', and
+    CorruptArtifactError was missing entirely)."""
+
+    @staticmethod
+    def _doc_rows():
+        import re
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        text = open(os.path.join(here, "ROBUSTNESS.md")).read()
+        rows = {}
+        for line in text.splitlines():
+            m = re.match(r"\|\s*`(\w+)`\s*\|.*\|\s*([^|]+?)\s*\|\s*$",
+                         line)
+            if m:
+                rows[m.group(1)] = m.group(2)
+        return rows
+
+    def test_every_typed_error_is_documented_with_its_exit_code(self):
+        from tpuprof import errors
+        rows = self._doc_rows()
+        for cls in errors.TYPED_ERRORS:
+            assert cls.__name__ in rows, \
+                f"{cls.__name__} missing from the ROBUSTNESS.md table"
+            documented = rows[cls.__name__]
+            exc = cls.__new__(cls)      # exit_code only isinstance-checks
+            assert str(errors.exit_code(exc)) in documented, \
+                (cls.__name__, documented)
+        # the retry rung's marker class is absorbed, never an exit code
+        assert "TransientError" in rows
+
+    def test_no_undocumented_error_classes(self):
+        """Every exception defined in errors.py appears in the table —
+        adding a class without documenting it fails here."""
+        import inspect
+
+        from tpuprof import errors
+        rows = self._doc_rows()
+        for name, obj in vars(errors).items():
+            if inspect.isclass(obj) \
+                    and issubclass(obj, BaseException) \
+                    and obj.__module__ == "tpuprof.errors":
+                assert name in rows, \
+                    f"{name} is not documented in ROBUSTNESS.md"
+
+
+class TestConfigRoundTrips:
+    """The usual resolve_* env round-trips for the new knobs (the
+    ROBUSTNESS.md config-table contract: every ladder knob has an env
+    twin)."""
+
+    def test_retry_backoff_round_trip(self, monkeypatch):
+        from tpuprof.config import resolve_retry_backoff
+        monkeypatch.delenv("TPUPROF_RETRY_BACKOFF_S", raising=False)
+        assert resolve_retry_backoff(None) == 0.05      # default
+        monkeypatch.setenv("TPUPROF_RETRY_BACKOFF_S", "0.25")
+        assert resolve_retry_backoff(None) == 0.25      # env
+        assert resolve_retry_backoff(1.5) == 1.5        # explicit wins
+        monkeypatch.setenv("TPUPROF_RETRY_BACKOFF_S", "0")
+        assert resolve_retry_backoff(None) == 0.0       # 0 = no sleep
+
+    def test_retry_backoff_cli_flag(self):
+        from tpuprof.cli import build_parser
+        args = build_parser().parse_args(
+            ["profile", "x.parquet", "--retry-backoff", "0.75"])
+        assert args.retry_backoff == 0.75
+        cfg = ProfilerConfig(retry_backoff_s=args.retry_backoff)
+        from tpuprof.config import resolve_retry_backoff
+        assert resolve_retry_backoff(cfg.retry_backoff_s) == 0.75
+
+    def test_elastic_env_round_trips(self, monkeypatch):
+        from tpuprof.config import (resolve_elastic, resolve_fleet_dir,
+                                    resolve_fleet_host_id,
+                                    resolve_liveness_timeout)
+        monkeypatch.setenv("TPUPROF_ELASTIC", "1")
+        assert resolve_elastic(None) is True
+        monkeypatch.setenv("TPUPROF_ELASTIC", "0")
+        assert resolve_elastic(None) is False
+        assert resolve_elastic(True) is True            # explicit wins
+        monkeypatch.setenv("TPUPROF_FLEET_DIR", "/shared/f")
+        assert resolve_fleet_dir(None) == "/shared/f"
+        assert resolve_fleet_dir("/x") == "/x"
+        monkeypatch.setenv("TPUPROF_FLEET_HOST_ID", "slot-3")
+        assert resolve_fleet_host_id(None) == "slot-3"
+        assert resolve_fleet_host_id("me") == "me"
+        monkeypatch.setenv("TPUPROF_LIVENESS_TIMEOUT_S", "2.5")
+        assert resolve_liveness_timeout(None) == 2.5
+        assert resolve_liveness_timeout(9.0) == 9.0
+
+    def test_elastic_cli_flags(self):
+        from tpuprof.cli import build_parser
+        args = build_parser().parse_args(
+            ["profile", "x.parquet", "--elastic", "--fleet-dir", "/f",
+             "--fleet-host-id", "h7", "--liveness-timeout", "3"])
+        assert args.elastic is True
+        assert args.fleet_dir == "/f"
+        assert args.fleet_host_id == "h7"
+        assert args.liveness_timeout == 3.0
+        # default: None — resolution (env, then off) happens in config
+        args = build_parser().parse_args(["profile", "x.parquet"])
+        assert args.elastic is None
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            ProfilerConfig(liveness_timeout_s=0)
+        with pytest.raises(ValueError):
+            ProfilerConfig(retry_backoff_s=-1)
+
+    def test_elastic_rejects_collective_runtime(self, tmp_path,
+                                                monkeypatch):
+        """Elastic + jax.distributed is a config error, reported before
+        any scanning — verified via the backend's pshard check."""
+        from tpuprof.backends import tpu as tpu_mod
+        ds = _make_ds(tmp_path, n_frags=1, rows_each=64)
+        import jax
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        with pytest.raises(InputError):
+            tpu_mod.TPUStatsBackend().collect(ds, ProfilerConfig(
+                backend="tpu", elastic=True,
+                fleet_dir=str(tmp_path / "fleet")))
+
+    def test_exit_codes_for_new_errors(self):
+        assert exit_code(CorruptManifestError("x")) == 7
+        assert exit_code(HostDeathError("s", 1)) == 8

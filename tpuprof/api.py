@@ -35,6 +35,19 @@ def describe(source: Any, config: Optional[ProfilerConfig] = None,
             f"(got config and {sorted(kwargs)})")
     config = config or ProfilerConfig.from_kwargs(**kwargs)
     backend = get_backend(config.backend)
+    if backend.name == "cpu":
+        from tpuprof.config import resolve_elastic
+        if resolve_elastic(config.elastic):
+            # the oracle ignores runtime knobs silently (checkpoints,
+            # watchdogs — perf-only), but elastic changes WHO does the
+            # work: N oracle members would each profile everything and
+            # race on the output believing it was split
+            from tpuprof.errors import InputError
+            raise InputError(
+                "elastic fleet mode needs the streaming engine — the "
+                f"selected backend is the CPU oracle (backend="
+                f"{config.backend!r}); pass backend='tpu' (it runs on "
+                "CPU hosts too)")
     stats = backend.collect(source, config)
     problems = validate_stats(stats)
     if problems:

@@ -317,7 +317,7 @@ class _CollectCheckpoint:
                 "nested": self.config.nested}
 
     def save(self, state, sampler, hostagg, host_hll, cursor,
-             frag_pos=None, quarantine=None) -> None:
+             frag_pos=None, quarantine=None, fleet_done=None) -> None:
         from tpuprof.runtime import checkpoint as ckpt
         # this artifact will reference the tracker's spill runs by path:
         # from now on a crash must leave them on disk for resume (GC
@@ -331,6 +331,13 @@ class _CollectCheckpoint:
             # only degraded runs carry the key: clean-run payloads stay
             # byte-identical to the pre-quarantine layout
             blob["quarantine"] = list(quarantine.entries)
+        if fleet_done is not None:
+            # elastic members persist the completed-fragment claims
+            # with the fold state that covers them (runtime/fleet.py):
+            # the durable half of the work-stealing manifest, riding
+            # the same CRC envelope as everything else here.  Absent
+            # for fixed-membership runs — payload bytes unchanged.
+            blob["fleet_done"] = sorted(int(k) for k in fleet_done)
         ckpt.save(self.path, state, blob, cursor, meta=self._meta(),
                   keep=self.keep)
         # the new artifact no longer references runs demoted since the
@@ -378,11 +385,152 @@ class _CollectCheckpoint:
         log_event("collect_resume", cursor=payload["cursor"], path=used)
         return (state, blob["sampler"], blob["hostagg"],
                 blob["host_hll"], payload["cursor"],
-                blob.get("frag_pos"), blob.get("quarantine") or [])
+                blob.get("frag_pos"), blob.get("quarantine") or [],
+                blob.get("fleet_done"))
 
     def clear(self) -> None:
         from tpuprof.runtime import checkpoint as ckpt
         ckpt.clear(self.path)
+
+
+# ---------------------------------------------------------------------------
+# Elastic fleet plumbing (runtime/fleet.py; ROBUSTNESS.md rung 5)
+# ---------------------------------------------------------------------------
+
+def _fleet_stream(member, phase, ingest, resume_frag=None, replay=()):
+    """Claim-driven raw-batch stream: first the adopted checkpoint's
+    partial fragment (resumed at the saved batch boundary), then the
+    adopted claims whose fold state died with the predecessor (replayed
+    from scratch), then fresh pulls from the shared manifest until it
+    is exhausted.  Fragments are read one at a time so a slow member
+    naturally claims less — the whole scheduler is this loop."""
+    if resume_frag is not None:
+        fi, bi = resume_frag
+        yield from ingest.read_fragment(fi, skip_batches=bi + 1)
+    for fi in replay:
+        yield from ingest.read_fragment(fi)
+    while True:
+        fi = member.claim_next(phase)
+        if fi is None:
+            return
+        yield from ingest.read_fragment(fi)
+
+
+def _scan_fragments_pass_a(frags, ingest, plan, pad, config, runner,
+                           batch_guard, use_host_hll):
+    """Replay a stolen fragment set from scratch into a fresh finalized
+    pass-A part (the ``steal_scan`` contract of
+    runtime/fleet.FleetMember.finish).  The dead owner's partial folds
+    died with it, so a clean re-scan plus the merge laws is exactly
+    what makes the survivor's totals equal an uninterrupted run."""
+    from tpuprof.runtime import guard as _guard
+    hostagg = HostAgg(plan, config)
+    sampler = RowSampler(config.quantile_sketch_size, plan.n_num,
+                         seed=config.seed)
+    host_hll = khll.HostRegisters(plan.n_hash, config.hll_precision) \
+        if use_host_hll else None
+    state = None
+    q_entries = []
+
+    def _stream():
+        for fi in frags:
+            yield from ingest.read_fragment(fi)
+
+    for hb in prefetch_prepared(ingest, plan, pad, config.hll_precision,
+                                workers=config.prepare_workers,
+                                prep_workers=config.prep_workers,
+                                full_hashes=config.exact_distinct,
+                                batch_guard=batch_guard,
+                                raw_stream=_stream()):
+        if isinstance(hb, _guard.PoisonBatch):
+            # the skip is recorded on the part (it rides to every
+            # survivor's report); the thief's own budget already
+            # admitted comparable skips on its primary scan
+            q_entries.append({"site": hb.site + "_stolen",
+                              "cursor": None, "rows": hb.rows,
+                              "frag_pos": list(hb.frag_pos)
+                              if hb.frag_pos else None,
+                              "error": hb.error})
+            continue
+        if state is None:
+            state = runner.init_pass_a(estimate_shift(hb))
+        db = runner.put_batch(hb, with_hll=host_hll is None)
+        state = runner.step_a(state, db)
+        sampler.update(hb.x, hb.nrows)
+        if host_hll is not None:
+            host_hll.update(hb.hll, hb.nrows)
+        hostagg.update(hb)
+    if state is None:
+        state = runner.init_pass_a()
+    hostagg.unique.persistent = True     # the part references the runs
+    return {"kind": "pass_a", "res_a": runner.finalize_a(state),
+            "hostagg": hostagg, "sampler": sampler,
+            "host_hll": host_hll, "quarantine": q_entries,
+            "rows": int(hostagg.n_rows)}
+
+
+def _part_regs(part):
+    """A part's effective HLL registers: host registers where the
+    member folded them host-side, its device plane otherwise — the two
+    formats are bit-identical (kernels/hll.HostRegisters)."""
+    hh = part.get("host_hll")
+    return hh.regs if hh is not None else part["res_a"]["hll"]
+
+
+def _elastic_merge_a(fleet_member, res_a, hostagg, sampler, host_hll,
+                     quarantine, steal_scan, timeout_s):
+    """Contribute this member's finalized pass-A part, hold the elastic
+    resume barrier (stealing dead members' fragments via
+    ``steal_scan``), and fold every contribution with the same merge
+    laws the fixed-membership collectives apply
+    (runtime/distributed.merge_*_parts).  Returns
+    ``(res_a, hostagg, sampler, hll_regs, q_entries, q_mark)`` — the
+    merged whole-fleet accumulators, the max-folded effective HLL
+    registers, the deterministic concatenation of every part's
+    quarantine manifest, and the index into this member's local
+    manifest where post-contribution (pass-B) entries start."""
+    from tpuprof.runtime.distributed import (merge_host_agg_parts,
+                                             merge_pass_a_parts,
+                                             merge_sampler_parts)
+    hostagg.unique.persistent = True     # the part references the runs
+    q_mark = len(quarantine.entries)
+    mine = {"kind": "pass_a", "res_a": res_a, "hostagg": hostagg,
+            "sampler": sampler, "host_hll": host_hll,
+            "quarantine": list(quarantine.entries),
+            "rows": int(hostagg.n_rows)}
+    fleet_member.contribute("a", mine,
+                            sorted(fleet_member.claimed("a")))
+    parts = fleet_member.finish("a", steal_scan, timeout_s=timeout_s)
+    regs = _part_regs(parts[0]).copy()
+    for part in parts[1:]:
+        regs = np.maximum(regs, _part_regs(part))
+    q_entries = [e for p in parts for e in (p.get("quarantine") or [])]
+    res_a = merge_pass_a_parts([p["res_a"] for p in parts])
+    hostagg = merge_host_agg_parts([p["hostagg"] for p in parts])
+    sampler = merge_sampler_parts([p["sampler"] for p in parts])
+    log_event("fleet_merge_a", parts=len(parts),
+              rows=int(hostagg.n_rows))
+    return res_a, hostagg, sampler, regs, q_entries, q_mark
+
+
+def _elastic_merge_b(fleet_member, my_part, steal_scan, timeout_s):
+    """The pass-B twin: contribute, barrier (phase ``b`` claims), fold.
+    Returns ``(res_b, counts, rho_spear)``; ``res_b``/``rho_spear``
+    are None for recount-only parts."""
+    from tpuprof.runtime.distributed import (merge_corr_parts,
+                                             merge_pass_b_parts,
+                                             merge_recount_parts)
+    fleet_member.contribute("b", my_part,
+                            sorted(fleet_member.claimed("b")))
+    parts = fleet_member.finish("b", steal_scan, timeout_s=timeout_s)
+    res_bs = [p["res_b"] for p in parts if p.get("res_b") is not None]
+    res_b = merge_pass_b_parts(res_bs) if res_bs else None
+    counts = merge_recount_parts([p["counts"] for p in parts])
+    spears = [p["spear"] for p in parts if p.get("spear") is not None]
+    rho_spear = kcorr.finalize(merge_corr_parts(spears)) \
+        if spears else None
+    log_event("fleet_merge_b", parts=len(parts))
+    return res_b, counts, rho_spear
 
 
 _UNSET = object()
@@ -487,6 +635,29 @@ class TPUStatsBackend:
         # configure_from_config above)
         obs.blackbox.set_context(process_index=pshard[0],
                                  process_count=pshard[1])
+        # ---- elastic fleet membership (runtime/fleet.py; ROBUSTNESS.md
+        # rung 5): fragments are PULLED from a shared manifest instead
+        # of striped, merges fold contribution parts off shared storage
+        # instead of collectives, and a dead member's fragments are
+        # stolen + replayed by survivors.  Off by default — every
+        # fixed-membership byte-path below is untouched then.
+        from tpuprof.config import resolve_elastic, resolve_fleet_dir
+        from tpuprof.errors import HostDeathError, InputError
+        elastic = resolve_elastic(config.elastic)
+        fleet_member = None
+        if elastic:
+            if pshard[1] > 1:
+                raise InputError(
+                    "elastic fleet mode replaces the jax.distributed "
+                    "collective runtime (collectives cannot survive "
+                    "membership change) — launch independent processes "
+                    "sharing --fleet-dir instead of --coordinator/"
+                    "--num-processes")
+            if not resolve_fleet_dir(config.fleet_dir):
+                raise InputError(
+                    "elastic mode needs fleet_dir (--fleet-dir / "
+                    "TPUPROF_FLEET_DIR) on storage shared by every "
+                    "member")
         # multi-host spill works when unique_spill_dir is SHARED storage
         # (each host's runs validate present everywhere and the merge
         # adopts them — kernels/unique.py merge law); host-local dirs
@@ -529,6 +700,7 @@ class TPUStatsBackend:
         # to the historical fail-fast behavior.
         from tpuprof.config import (resolve_ingest_retries,
                                     resolve_max_quarantined,
+                                    resolve_retry_backoff,
                                     resolve_watchdog_timeout)
         from tpuprof.runtime import guard as _guard
         from tpuprof.testing import faults as _faults
@@ -537,7 +709,8 @@ class TPUStatsBackend:
             log_path=config.quarantine_log)
         batch_guard = _guard.BatchGuard(
             resolve_ingest_retries(config.ingest_retries),
-            config.retry_backoff_s, capture=quarantine.enabled)
+            resolve_retry_backoff(config.retry_backoff_s),
+            capture=quarantine.enabled)
         drain_timeout = resolve_watchdog_timeout(
             config.drain_timeout_s, "TPUPROF_DRAIN_TIMEOUT_S")
         barrier_timeout = resolve_watchdog_timeout(
@@ -546,18 +719,34 @@ class TPUStatsBackend:
         # the pass-A scan persists (device state, host sketches, batch
         # cursor) every N batches; a crashed profile resumes by skipping
         # the already-folded prefix of the (deterministic) batch stream.
+        if elastic:
+            from tpuprof.config import (resolve_fleet_host_id,
+                                        resolve_liveness_timeout)
+            from tpuprof.runtime import fleet as _fleetrt
+            # the manifest fingerprint pins source content + the knobs
+            # that change batch enumeration — members with a divergent
+            # view must be rejected, not merged
+            fleet_member = _fleetrt.FleetMember(
+                resolve_fleet_dir(config.fleet_dir),
+                resolve_fleet_host_id(config.fleet_host_id),
+                ingest.fragment_count(),
+                f"{ingest.fingerprint()}:{config.batch_rows}"
+                f":{config.nested}",
+                liveness_timeout_s=resolve_liveness_timeout(
+                    config.liveness_timeout_s))
         resume = _CollectCheckpoint(config, plan, runner, pshard,
                                     ingest.fingerprint(),
                                     table_source=ingest._table is not None) \
             if config.checkpoint_path else None
         skip = 0
         resume_frag = None
+        fleet_ck_done = None
         restored = resume is not None and resume.exists()
         state = None
         if restored:
             try:
                 (state, sampler, hostagg, host_hll, skip,
-                 resume_frag, prior_q) = resume.load()
+                 resume_frag, prior_q, fleet_ck_done) = resume.load()
                 # a degraded prefix stays degraded: the restored
                 # manifest keeps riding checkpoints and the final report
                 quarantine.seed(prior_q)
@@ -584,6 +773,7 @@ class TPUStatsBackend:
                     pshard[0], resume.path, exc)
                 restored = False
                 state, skip, resume_frag = None, 0, None
+                fleet_ck_done = None
                 quarantine.seed([])
                 hostagg = HostAgg(plan, config)
                 sampler = RowSampler(config.quantile_sketch_size,
@@ -631,6 +821,32 @@ class TPUStatsBackend:
                     "the fresh hosts simply rescan their stripes",
                     sorted(p for p, r, _ in peers if r),
                     sorted(p for p, r, _ in peers if not r))
+        fleet_replay: List[int] = []
+        if fleet_member is not None:
+            # the elastic join/leave barrier: reconcile adopted manifest
+            # claims against the checkpoint cursor (the handoff token).
+            # Claims the checkpoint covers are final; claims marked done
+            # AFTER the last save — and any claim with no checkpoint at
+            # all — are replayed from scratch, because the fold state
+            # covering them died with the predecessor.
+            ck_done = set(fleet_ck_done or []) if restored else set()
+            for k in sorted(ck_done):
+                fleet_member.mark_done("a", k)
+            in_progress = {resume_frag[0]} \
+                if restored and resume_frag is not None else set()
+            fleet_replay = sorted(fleet_member.claimed("a")
+                                  - ck_done - in_progress)
+            fleet_member.undo_done("a", fleet_replay)
+            if restored:
+                # commit the restored leaves with the step programs'
+                # state sharding (runtime/mesh.place_state) so the
+                # joined member's first fold reuses the steady-state
+                # executable — the byte-stability the join acceptance
+                # test pins rests on this
+                state = runner.place_state(jax.device_get(state))
+                log_event("fleet_adopt", host=fleet_member.host_id,
+                          cursor=int(skip), done=sorted(ck_done),
+                          replay=fleet_replay)
         cursor = skip
         # fragment-positioned streaming whenever checkpointing is on, so
         # saved cursors carry (fragment, batch) and resume skips whole
@@ -686,6 +902,33 @@ class TPUStatsBackend:
         def flush_a(pending):
             flush_group(pending, _staged_a, _one_a)
 
+        def _hit_host_death(key):
+            # the participation kill switch (faults site host_death):
+            # NOT quarantinable, NOT retryable — an elastic member
+            # departs loudly (deletes its heartbeat) so survivors
+            # detect the death immediately; fixed-membership runs let
+            # the typed error escape to the CLI (exit 8)
+            try:
+                _faults.hit("host_death", key=key)
+            except HostDeathError:
+                if fleet_member is not None:
+                    fleet_member.depart()
+                raise
+
+        # elastic done-marking: fragment k is marked complete when the
+        # first batch of a LATER fragment folds (in-order delivery means
+        # every batch of k folded first); the final fragment closes at
+        # stream end
+        _cur_frag = [resume_frag[0]
+                     if restored and resume_frag is not None else None]
+
+        def _note_frag(fp, phase="a"):
+            if fleet_member is None or fp is None:
+                return
+            if _cur_frag[0] is not None and fp[0] != _cur_frag[0]:
+                fleet_member.mark_done(phase, _cur_frag[0])
+            _cur_frag[0] = fp[0]
+
         with span("scan_a", cols=len(plan.specs), n_num=plan.n_num,
                   n_hash=plan.n_hash):
             # centering shift from the first batch's prefix — any value
@@ -702,7 +945,12 @@ class TPUStatsBackend:
                 workers=config.prepare_workers,
                 prep_workers=config.prep_workers,
                 full_hashes=config.exact_distinct,
-                batch_guard=batch_guard)
+                batch_guard=batch_guard,
+                raw_stream=_fleet_stream(
+                    fleet_member, "a", ingest,
+                    resume_frag=resume_frag if restored else None,
+                    replay=fleet_replay)
+                if fleet_member is not None else None)
             # the shift estimate needs a REAL first batch; quarantined
             # heads are re-chained below so cursor accounting stays
             # in stream order
@@ -740,6 +988,7 @@ class TPUStatsBackend:
                         # advances — the batch WAS consumed from the raw
                         # stream, so a resume must not replay it.
                         cursor += 1
+                        _note_frag(hb.frag_pos)
                         last_frag = hb.frag_pos or last_frag
                         quarantine.admit(site=hb.site, error=hb.error,
                                          cursor=cursor, rows=hb.rows,
@@ -749,8 +998,12 @@ class TPUStatsBackend:
                             resume.save(state, sampler, hostagg,
                                         host_hll, cursor,
                                         frag_pos=last_frag,
-                                        quarantine=quarantine)
+                                        quarantine=quarantine,
+                                        fleet_done=fleet_member.done("a")
+                                        if fleet_member else None)
                         continue
+                    _note_frag(hb.frag_pos)
+                    _hit_host_death(cursor)
                     try:
                         _faults.hit("fold", key=cursor)
                         # host-side folds run as batches arrive (they
@@ -784,8 +1037,14 @@ class TPUStatsBackend:
                         if ckpt_due:
                             resume.save(state, sampler, hostagg, host_hll,
                                         cursor, frag_pos=last_frag,
-                                        quarantine=quarantine)
+                                        quarantine=quarantine,
+                                        fleet_done=fleet_member.done("a")
+                                        if fleet_member else None)
                 flush_a(pending)
+                if fleet_member is not None and _cur_frag[0] is not None:
+                    # the stream drained completely: the last fragment
+                    # read is fully folded
+                    fleet_member.mark_done("a", _cur_frag[0])
             if drain_timeout and state is not None:
                 # bound the device-side drain: a wedged dispatch fails
                 # with a heartbeat instead of hanging the run
@@ -800,7 +1059,9 @@ class TPUStatsBackend:
             # during merge/pass-B resumes with the whole stream skipped
             # instead of rescanning; cleared only after assembly
             resume.save(state, sampler, hostagg, host_hll, cursor,
-                        frag_pos=last_frag, quarantine=quarantine)
+                        frag_pos=last_frag, quarantine=quarantine,
+                        fleet_done=fleet_member.done("a")
+                        if fleet_member else None)
         # single-host pass-B bounds come off the DEVICE (the twin of
         # khistogram.pass_b_bounds, parity-pinned): the bounds jit
         # enqueues BEFORE the merged-state fetch, so pass B never waits
@@ -808,22 +1069,44 @@ class TPUStatsBackend:
         # Multi-host keeps the host recipe: bin edges must come from the
         # GLOBALLY merged moments or each host would bin differently.
         bounds_d = None
-        if pshard[1] == 1 and config.exact_passes and plan.n_num > 0:
+        if pshard[1] == 1 and fleet_member is None \
+                and config.exact_passes and plan.n_num > 0:
+            # elastic fleets keep the host recipe too: bin edges must
+            # come from the FLEET-merged moments or members would bin
+            # differently
             bounds_d = runner.bounds_b_device(state)
+        fleet_regs = None
+        fleet_q: Optional[List] = None
         with span("merge", hosts=pshard[1]):
             res_a = runner.finalize_a(state)
-            # cross-host: each host's device sketches merged over ICI by
-            # the mesh collectives; the finalized states and host-side
-            # aggregates ride DCN gathers
-            res_a = merge_pass_a_states(res_a)
-            hostagg = merge_host_aggs(hostagg)
-            if pshard[1] > 1:
-                # one k-way spill resolve for the fleet (rank 0 reads,
-                # everyone adopts) instead of N identical re-reads
-                from tpuprof.runtime.distributed import (
-                    resolve_unique_distributed)
-                resolve_unique_distributed(hostagg.unique)
-            sampler = merge_samplers(sampler)
+            if fleet_member is not None:
+                # elastic resume barrier: contribute this member's
+                # finalized part, wait for full fragment coverage
+                # (stealing + replaying dead members' fragments), fold
+                # every part with the same merge laws the collectives
+                # apply (runtime/fleet.py)
+                def _steal_scan_a(frags):
+                    return _scan_fragments_pass_a(
+                        frags, ingest, plan, pad, config, runner,
+                        batch_guard, host_hll is not None)
+
+                (res_a, hostagg, sampler, fleet_regs, fleet_q,
+                 fleet_q_mark) = _elastic_merge_a(
+                    fleet_member, res_a, hostagg, sampler, host_hll,
+                    quarantine, _steal_scan_a, barrier_timeout)
+            else:
+                # cross-host: each host's device sketches merged over
+                # ICI by the mesh collectives; the finalized states and
+                # host-side aggregates ride DCN gathers
+                res_a = merge_pass_a_states(res_a)
+                hostagg = merge_host_aggs(hostagg)
+                if pshard[1] > 1:
+                    # one k-way spill resolve for the fleet (rank 0
+                    # reads, everyone adopts) instead of N re-reads
+                    from tpuprof.runtime.distributed import (
+                        resolve_unique_distributed)
+                    resolve_unique_distributed(hostagg.unique)
+                sampler = merge_samplers(sampler)
         log_event("pass_a", rows=hostagg.n_rows, devices=runner.n_dev,
                   n_num=plan.n_num, n_hash=plan.n_hash)
 
@@ -832,7 +1115,12 @@ class TPUStatsBackend:
         probes = list(config.quantile_probes)
         quants = sampler.quantiles(probes)
         sample_vals, sample_kept = sampler.columns()
-        if host_hll is not None:
+        if fleet_regs is not None:
+            # elastic: per-part effective registers (host regs where a
+            # member had them, its device plane otherwise — the formats
+            # are bit-identical) already max-folded across parts
+            hll_est = khll.finalize(fleet_regs)
+        elif host_hll is not None:
             from tpuprof.runtime.distributed import merge_hll_registers
             hll_est = khll.finalize(merge_hll_registers(host_hll).regs)
         else:
@@ -930,6 +1218,40 @@ class TPUStatsBackend:
             def flush_b(pending):
                 flush_group(pending, _staged_b, _one_b)
 
+            def _steal_scan_b(frags):
+                """Replay stolen fragments into a fresh finalized
+                pass-B part (bounds/candidates are fleet-global, so any
+                member can recount any fragment)."""
+                st_b = runner.init_pass_b()
+                sp_st = runner.init_spearman() \
+                    if spear_state is not None else None
+                rec = Recounter(hostagg)
+
+                def _stream():
+                    for fi in frags:
+                        yield from ingest.read_fragment(fi)
+
+                for shb in prefetch_prepared(
+                        ingest, plan, pad, config.hll_precision,
+                        hashes=False, workers=config.prepare_workers,
+                        prep_workers=config.prep_workers,
+                        batch_guard=batch_guard, raw_stream=_stream()):
+                    if isinstance(shb, _guard.PoisonBatch):
+                        quarantine.admit(site=shb.site + "_pass_b",
+                                         error=shb.error, rows=shb.rows,
+                                         frag_pos=shb.frag_pos)
+                        continue
+                    rec.update(shb)
+                    sdb = runner.put_batch(shb, with_hll=False)
+                    st_b = runner.step_b(st_b, sdb, lo_d, hi_d, mean_d)
+                    if sp_st is not None:
+                        sp_st = fold_spear(sp_st, sdb, False)
+                return {"kind": "pass_b",
+                        "res_b": runner.finalize_b(st_b),
+                        "counts": rec.counts,
+                        "spear": runner.finalize_spearman(sp_st)
+                        if sp_st is not None else None}
+
             with span("scan_b", spearman=config.spearman):
                 # hashes=False: pass B never reads the HLL plane, so the
                 # host hash loop is skipped on the second scan
@@ -940,7 +1262,14 @@ class TPUStatsBackend:
                                             hashes=False,
                                             workers=config.prepare_workers,
                                             prep_workers=config.prep_workers,
-                                            batch_guard=batch_guard):
+                                            batch_guard=batch_guard,
+                                            raw_stream=_fleet_stream(
+                                                fleet_member, "b", ingest,
+                                                replay=sorted(
+                                                    fleet_member
+                                                    .claimed("b")))
+                                            if fleet_member is not None
+                                            else None):
                     if isinstance(hb, _guard.PoisonBatch):
                         # pass-B skip shares the pass-A budget; the
                         # entry's pass field keeps the manifest honest
@@ -954,8 +1283,21 @@ class TPUStatsBackend:
                     if len(pending_b) >= scan_s:
                         flush_b(pending_b)
                 flush_b(pending_b)
-                res_b = merge_pass_b_states(runner.finalize_b(state_b))
-                recounter.counts = merge_recount_arrays(recounter.counts)
+                if fleet_member is not None:
+                    res_b, counts, rho_spear = _elastic_merge_b(
+                        fleet_member,
+                        {"kind": "pass_b",
+                         "res_b": runner.finalize_b(state_b),
+                         "counts": recounter.counts,
+                         "spear": runner.finalize_spearman(spear_state)
+                         if spear_state is not None else None},
+                        _steal_scan_b, barrier_timeout)
+                    recounter.counts = counts
+                    spear_state = None     # finalized + merged above
+                else:
+                    res_b = merge_pass_b_states(runner.finalize_b(state_b))
+                    recounter.counts = merge_recount_arrays(
+                        recounter.counts)
             if spear_state is not None:
                 rho_spear = kcorr.finalize(merge_corr_states(
                     runner.finalize_spearman(spear_state)))
@@ -982,21 +1324,55 @@ class TPUStatsBackend:
             # hashes=False: the recount reads categorical codes only, so
             # the host hash + HLL-packing loop is skipped on this scan.
             recounter = Recounter(hostagg)
+
+            def _steal_recount(frags):
+                rec = Recounter(hostagg)
+
+                def _stream():
+                    for fi in frags:
+                        yield from ingest.read_fragment(fi)
+
+                for shb in prefetch_prepared(
+                        ingest, plan, pad, config.hll_precision,
+                        hashes=False, workers=config.prepare_workers,
+                        prep_workers=config.prep_workers,
+                        batch_guard=batch_guard, raw_stream=_stream()):
+                    if isinstance(shb, _guard.PoisonBatch):
+                        quarantine.admit(site=shb.site + "_pass_b",
+                                         error=shb.error, rows=shb.rows,
+                                         frag_pos=shb.frag_pos)
+                        continue
+                    rec.update(shb)
+                return {"kind": "pass_b", "res_b": None,
+                        "counts": rec.counts, "spear": None}
+
             with span("scan_b", recount_only=True):
                 for hb in prefetch_prepared(
                         ingest, plan, pad,
                         config.hll_precision, hashes=False,
                         workers=config.prepare_workers,
                         prep_workers=config.prep_workers,
-                        batch_guard=batch_guard):
+                        batch_guard=batch_guard,
+                        raw_stream=_fleet_stream(
+                            fleet_member, "b", ingest,
+                            replay=sorted(fleet_member.claimed("b")))
+                        if fleet_member is not None else None):
                     if isinstance(hb, _guard.PoisonBatch):
                         quarantine.admit(site=hb.site + "_pass_b",
                                          error=hb.error, rows=hb.rows,
                                          frag_pos=hb.frag_pos)
                         continue
                     recounter.update(hb)
-                # each host recounts only its own fragment stripe
-                recounter.counts = merge_recount_arrays(recounter.counts)
+                if fleet_member is not None:
+                    _, recounter.counts, _ = _elastic_merge_b(
+                        fleet_member,
+                        {"kind": "pass_b", "res_b": None,
+                         "counts": recounter.counts, "spear": None},
+                        _steal_recount, barrier_timeout)
+                else:
+                    # each host recounts only its own fragment stripe
+                    recounter.counts = merge_recount_arrays(
+                        recounter.counts)
 
         stats = _assemble(plan, config, ingest.sample(config.sample_rows),
                           hostagg, momf, rho_all, quants, sample_vals,
@@ -1004,7 +1380,13 @@ class TPUStatsBackend:
                           probes, rho_spear=rho_spear,
                           spear_approx=spear_approx)
         q_entries = quarantine.entries
-        if pshard[1] > 1:
+        if fleet_member is not None:
+            # the fleet's pass-A skips rode the contribution parts
+            # (deterministic part order); this member's LATER entries
+            # (pass-B steals) follow
+            q_entries = list(fleet_q or []) \
+                + quarantine.entries[fleet_q_mark:]
+        elif pshard[1] > 1:
             # every host gathers every stripe's skips (symmetric
             # collective — all hosts call it, even with empty lists);
             # host 0's report then lists the fleet's degradation
@@ -1045,7 +1427,15 @@ class TPUStatsBackend:
         # reaches this line (same reason the q_entries gather above is
         # unconditional), and a disabled registry's wire is still valid,
         # so mixed metrics settings cannot deadlock.
-        if pshard[1] > 1 or obs.enabled():
+        if fleet_member is not None:
+            # elastic twin of publish_fleet: wires ride the fleet dir,
+            # the surviving leader writes <metrics>.fleet.prom with
+            # per-host labels + the rebalance counters — no collective,
+            # so a dead member cannot wedge the dump
+            fleet_member.publish(obs.resolve_metrics_path(config),
+                                 reason="collect")
+            fleet_member.close()
+        elif pshard[1] > 1 or obs.enabled():
             from tpuprof.runtime.distributed import publish_fleet
             publish_fleet("collect",
                           metrics_path=obs.resolve_metrics_path(config),

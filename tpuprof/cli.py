@@ -153,6 +153,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="transient per-batch prep failures retried "
                          "with exponential backoff before escalating "
                          "(default: TPUPROF_INGEST_RETRIES, else 2)")
+    ft.add_argument("--retry-backoff", type=float, default=None,
+                    metavar="SEC",
+                    help="first retry's sleep; each further attempt "
+                         "doubles it (default: TPUPROF_RETRY_BACKOFF_S, "
+                         "else 0.05; 0 retries back-to-back)")
     ft.add_argument("--max-quarantined", type=int, default=None,
                     metavar="N",
                     help="poison-batch budget: skip (and report) up to "
@@ -174,6 +179,35 @@ def build_parser() -> argparse.ArgumentParser:
                     help="watchdog deadline on the multi-host resume "
                          "barrier (default: TPUPROF_BARRIER_TIMEOUT_S, "
                          "else off)")
+    fleet = p.add_argument_group(
+        "elastic fleet", "work-stealing membership (ROBUSTNESS.md rung "
+        "5): launch N independent processes sharing --fleet-dir; each "
+        "pulls fragments from the shared manifest, survivors steal a "
+        "dead member's fragments and finish with correct stats, and a "
+        "restarted member presenting the same --fleet-host-id adopts "
+        "its predecessor's claims + checkpoint.  Mutually exclusive "
+        "with the --coordinator collective runtime")
+    fleet.add_argument("--elastic", action="store_true", default=None,
+                       help="enable elastic membership (default: "
+                            "TPUPROF_ELASTIC, else off — the "
+                            "fixed-membership byte-paths stay "
+                            "untouched)")
+    fleet.add_argument("--fleet-dir", metavar="DIR",
+                       help="shared coordination directory (manifest, "
+                            "claims, heartbeats, contributions) on "
+                            "storage every member sees (default: "
+                            "TPUPROF_FLEET_DIR)")
+    fleet.add_argument("--fleet-host-id", metavar="ID",
+                       help="stable member identity — pin per slot so "
+                            "a restart adopts its predecessor's work "
+                            "(default: TPUPROF_FLEET_HOST_ID, else "
+                            "hostname-pid)")
+    fleet.add_argument("--liveness-timeout", type=float, default=None,
+                       metavar="SEC",
+                       help="heartbeat staleness before a member is "
+                            "declared dead and its fragments stolen "
+                            "(default: TPUPROF_LIVENESS_TIMEOUT_S, "
+                            "else 10)")
     dist = p.add_argument_group(
         "multi-host", "launch the same command on every host (the "
         "framework owns its launch — no spark-submit analogue needed); "
@@ -258,9 +292,10 @@ def cmd_diff(args: argparse.Namespace) -> int:
 
 def cmd_profile(args: argparse.Namespace) -> int:
     from tpuprof import ProfileReport, ProfilerConfig
-    from tpuprof.errors import (CorruptCheckpointError, InputError,
-                                PoisonBatchError, WatchdogTimeout,
-                                exit_code)
+    from tpuprof.errors import (CorruptCheckpointError,
+                                CorruptManifestError, HostDeathError,
+                                InputError, PoisonBatchError,
+                                WatchdogTimeout, exit_code)
     from tpuprof.obs import blackbox
     from tpuprof.utils.trace import phase_timer, trace_to
 
@@ -352,6 +387,11 @@ def cmd_profile(args: argparse.Namespace) -> int:
             checkpoint_every_batches=args.checkpoint_every,
             checkpoint_keep=args.checkpoint_keep,
             ingest_retries=args.ingest_retries,
+            retry_backoff_s=args.retry_backoff,
+            elastic=args.elastic,
+            fleet_dir=args.fleet_dir,
+            fleet_host_id=args.fleet_host_id,
+            liveness_timeout_s=args.liveness_timeout,
             max_quarantined=args.max_quarantined,
             quarantine_log=args.quarantine_log,
             drain_timeout_s=args.drain_timeout,
@@ -394,8 +434,9 @@ def cmd_profile(args: argparse.Namespace) -> int:
                 # crashed, the request itself was malformed.
                 print(f"tpuprof: error: {exc}", file=sys.stderr)
                 return 2
-            except (CorruptCheckpointError, PoisonBatchError,
-                    WatchdogTimeout) as exc:
+            except (CorruptCheckpointError, CorruptManifestError,
+                    PoisonBatchError, WatchdogTimeout,
+                    HostDeathError) as exc:
                 # the degradation ladder ran out (ROBUSTNESS.md): one
                 # line + a distinct exit code per failure shape
                 # (errors.exit_code), and the flight recorder dumps a

@@ -68,6 +68,17 @@ def resolve_ingest_retries(value: Optional[int] = None) -> int:
     return max(env, 0) if env is not None else 2
 
 
+def resolve_retry_backoff(value: Optional[float] = None) -> float:
+    """First retry's sleep (each further attempt doubles it): an
+    explicit config value wins; else ``TPUPROF_RETRY_BACKOFF_S``; else
+    0.05 — the only ladder knob that had no env/CLI surface until
+    ISSUE 7.  0 disables the sleep (retries fire back-to-back)."""
+    if value is not None:
+        return max(float(value), 0.0)
+    env = _env_float("TPUPROF_RETRY_BACKOFF_S")
+    return max(env, 0.0) if env is not None else 0.05
+
+
 def resolve_max_quarantined(value: Optional[int] = None) -> int:
     """Poison-batch quarantine budget: an explicit config value wins;
     else ``TPUPROF_MAX_QUARANTINED``; else 0 — the historical fail-fast
@@ -97,6 +108,54 @@ def resolve_watchdog_timeout(value: Optional[float], var: str
         return float(value) if value > 0 else None
     env = _env_float(var)
     return env if env and env > 0 else None
+
+
+def resolve_elastic(value: Optional[bool] = None) -> bool:
+    """Elastic fleet membership switch (runtime/fleet.py): an explicit
+    config value wins; else ``TPUPROF_ELASTIC`` ("0"/"" = off); else
+    off — the fixed-membership paths stay byte-identical by default."""
+    if value is not None:
+        return bool(value)
+    env = os.environ.get("TPUPROF_ELASTIC")
+    if env is not None:
+        return env not in ("", "0", "false", "no")
+    return False
+
+
+def resolve_fleet_dir(value: Optional[str] = None) -> Optional[str]:
+    """Shared fleet-coordination directory (manifest, claims,
+    heartbeats, contributions): explicit config value, else
+    ``TPUPROF_FLEET_DIR``, else None.  Elastic mode requires one on
+    storage shared by every member."""
+    if value:
+        return str(value)
+    return os.environ.get("TPUPROF_FLEET_DIR") or None
+
+
+def resolve_fleet_host_id(value: Optional[str] = None) -> str:
+    """This member's stable fleet identity: explicit config value, else
+    ``TPUPROF_FLEET_HOST_ID``, else ``<hostname>-<pid>``.  A RESTARTED
+    member that presents the same id at the next resume barrier adopts
+    its predecessor's manifest claims + checkpoint cursor (the
+    join/leave handoff token), so production deployments should pin it
+    per slot, not per process."""
+    if value:
+        return str(value)
+    env = os.environ.get("TPUPROF_FLEET_HOST_ID")
+    if env:
+        return env
+    import socket
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
+def resolve_liveness_timeout(value: Optional[float] = None) -> float:
+    """Heartbeat staleness after which a fleet member is declared dead
+    and its unfinished fragments become stealable: explicit config
+    value, else ``TPUPROF_LIVENESS_TIMEOUT_S``, else 10 seconds."""
+    if value is not None:
+        return float(value)
+    env = _env_float("TPUPROF_LIVENESS_TIMEOUT_S")
+    return env if env and env > 0 else 10.0
 
 
 PASS_B_KERNELS = ("cumulative", "legacy")
@@ -319,8 +378,11 @@ class ProfilerConfig:
                                             # raise).  None = auto:
                                             # TPUPROF_INGEST_RETRIES
                                             # env, else 2; 0 disables
-    retry_backoff_s: float = 0.05           # first retry's sleep; each
-                                            # further attempt doubles it
+    retry_backoff_s: Optional[float] = None  # first retry's sleep; each
+                                             # further attempt doubles
+                                             # it.  None = auto:
+                                             # TPUPROF_RETRY_BACKOFF_S
+                                             # env, else 0.05
     max_quarantined: Optional[int] = None   # poison-batch budget: how
                                             # many permanently-failing
                                             # batches may be SKIPPED
@@ -348,6 +410,42 @@ class ProfilerConfig:
                                                # barrier; None = auto:
                                                # TPUPROF_BARRIER_TIMEOUT_S
                                                # env, else off
+    elastic: Optional[bool] = None          # elastic fleet membership
+                                            # (runtime/fleet.py): pull
+                                            # fragments from a shared
+                                            # manifest instead of owning
+                                            # a static stripe; survive
+                                            # peer death by stealing the
+                                            # dead host's fragments.
+                                            # None = auto:
+                                            # TPUPROF_ELASTIC env, else
+                                            # off (fixed-membership
+                                            # byte-paths untouched).
+                                            # Requires fleet_dir;
+                                            # incompatible with the
+                                            # jax.distributed collective
+                                            # runtime
+    fleet_dir: Optional[str] = None         # shared coordination dir
+                                            # (manifest/claims/
+                                            # heartbeats/contribution
+                                            # parts) — must be storage
+                                            # every member sees.  None =
+                                            # auto: TPUPROF_FLEET_DIR
+    fleet_host_id: Optional[str] = None     # stable member identity; a
+                                            # restarted process with the
+                                            # same id adopts its
+                                            # predecessor's claims +
+                                            # checkpoint (join/leave
+                                            # handoff).  None = auto:
+                                            # TPUPROF_FLEET_HOST_ID env,
+                                            # else hostname-pid
+    liveness_timeout_s: Optional[float] = None  # heartbeat staleness
+                                                # before a member is
+                                                # declared dead and its
+                                                # fragments stolen.
+                                                # None = auto:
+                                                # TPUPROF_LIVENESS_
+                                                # TIMEOUT_S env, else 10
     prepare_workers: Optional[int] = None   # cross-batch host-prep
                                             # pipeline width (decode/hash/
                                             # pack of DIFFERENT batches in
@@ -481,8 +579,11 @@ class ProfilerConfig:
             raise ValueError("checkpoint_keep must be >= 1 (or None)")
         if self.ingest_retries is not None and self.ingest_retries < 0:
             raise ValueError("ingest_retries must be >= 0 (or None)")
-        if self.retry_backoff_s < 0:
-            raise ValueError("retry_backoff_s must be >= 0")
+        if self.retry_backoff_s is not None and self.retry_backoff_s < 0:
+            raise ValueError("retry_backoff_s must be >= 0 (or None)")
+        if self.liveness_timeout_s is not None \
+                and self.liveness_timeout_s <= 0:
+            raise ValueError("liveness_timeout_s must be > 0 (or None)")
         if self.max_quarantined is not None and self.max_quarantined < 0:
             raise ValueError("max_quarantined must be >= 0 (or None)")
         for fname in ("drain_timeout_s", "barrier_timeout_s"):

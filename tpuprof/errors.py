@@ -32,6 +32,19 @@ raised so existing ``except`` clauses keep working:
   drain, multi-host resume barrier) exceeded its configured timeout;
   carries the site and a heartbeat snapshot taken at expiry.  CLI exit
   code 4.
+
+The elastic fleet runtime (runtime/fleet.py) adds two more:
+
+* ``CorruptManifestError`` (ValueError) — a fleet-directory artifact
+  (fragment manifest, claim record, contribution part) failed its CRC/
+  schema integrity checks.  A torn manifest must never silently
+  re-shard a fleet; the CLI maps it to exit code 7.
+* ``HostDeathError`` (RuntimeError) — this process's participation in
+  the fleet was killed (today: only by the deterministic
+  ``host_death:@k`` fault site — tpuprof/testing/faults.py).  The
+  fleet layer deletes this host's heartbeat on the way out so
+  survivors detect the death immediately; the CLI maps it to exit
+  code 8.
 """
 
 from typing import Any, Dict, List, Optional
@@ -68,6 +81,27 @@ class PoisonBatchError(RuntimeError):
         self.manifest = list(manifest or [])
 
 
+class CorruptManifestError(ValueError):
+    """A fleet-directory artifact (fragment manifest, claim record,
+    contribution part — runtime/fleet.py) failed integrity validation:
+    truncated/undecodable bytes, a CRC32 mismatch, or a schema the
+    fleet cannot trust.  Never a raw ``EOFError``/``UnpicklingError``;
+    the CLI maps it to exit code 7."""
+
+
+class HostDeathError(RuntimeError):
+    """This process's fleet participation was deterministically killed
+    (the ``host_death:@k`` fault site).  Carries the batch count at
+    death so tests can assert the injection point."""
+
+    def __init__(self, site: str, at_call: int):
+        super().__init__(
+            f"injected host death at {site!r} (call {at_call}) — this "
+            "process stops participating in the fleet")
+        self.site = site
+        self.at_call = at_call
+
+
 class WatchdogTimeout(TimeoutError):
     """A watched blocking call overran its deadline."""
 
@@ -85,16 +119,19 @@ class WatchdogTimeout(TimeoutError):
 # postmortem dumps — obs/blackbox.py) treats as "expected failure
 # shapes": one-line message + distinct exit code, no traceback
 TYPED_ERRORS = (InputError, CorruptCheckpointError, CorruptArtifactError,
-                PoisonBatchError, WatchdogTimeout)
+                CorruptManifestError, PoisonBatchError, WatchdogTimeout,
+                HostDeathError)
 
 _EXIT_CODES = (
-    # order matters: InputError, CorruptCheckpointError and
-    # CorruptArtifactError are all ValueErrors — the most specific
-    # classes must match first
+    # order matters: InputError, CorruptCheckpointError,
+    # CorruptArtifactError and CorruptManifestError are all ValueErrors
+    # — the most specific classes must match first
     (CorruptCheckpointError, 3),
     (CorruptArtifactError, 6),
+    (CorruptManifestError, 7),
     (WatchdogTimeout, 4),
     (PoisonBatchError, 5),
+    (HostDeathError, 8),
     (InputError, 2),
 )
 

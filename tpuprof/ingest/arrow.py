@@ -660,7 +660,7 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
                       workers: Optional[int] = None,
                       full_hashes: bool = False,
                       prep_workers: Optional[int] = None,
-                      batch_guard=None):
+                      batch_guard=None, raw_stream=None):
     """Yield prepared HostBatches with decode/hash/pack of DIFFERENT
     batches pipelined across a small thread pool (``workers``, default
     ``_prepare_workers()``), so one process can saturate its cores
@@ -687,7 +687,14 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
     * ``skip_batches=N``: drop the stream's first N raw batches without
       preparing them (fallback for resume cursors saved without a
       position — current artifacts carry positions for file-backed AND
-      in-memory sources)."""
+      in-memory sources).
+    * ``raw_stream``: an explicit ``(frag, batch, record_batch)``
+      iterator replacing the ingest's own enumeration — the elastic
+      fleet scheduler (runtime/fleet.py) feeds CLAIMED fragments
+      through here (``ArrowIngest.read_fragment``), pulled lazily as
+      the pipeline drains, so claim order follows actual progress
+      rather than a static stripe.  Positions are stamped; resume
+      modes are the stream's concern."""
     import queue
     import threading
     from concurrent.futures import ThreadPoolExecutor
@@ -756,7 +763,12 @@ def prefetch_prepared(ingest: "ArrowIngest", plan: "ColumnPlan", pad: int,
         # runs up to w prepares concurrently while the queue preserves
         # delivery order
         try:
-            if positions and ingest.supports_positions():
+            if raw_stream is not None:
+                for fi, bi, rb in raw_stream:
+                    if not _put(pool.submit(_prep, rb, (fi, bi),
+                                            (fi, bi))):
+                        return
+            elif positions and ingest.supports_positions():
                 start_frag, done = resume_pos if resume_pos else (0, 0)
                 for fi, bi, rb in ingest.raw_batches_positioned(
                         skip_fragments=start_frag):
@@ -1084,6 +1096,54 @@ class ArrowIngest:
                 except OSError:
                     if attempt == self.max_retries:
                         raise
+
+    def fragment_count(self) -> int:
+        """How many fragments the GLOBAL manifest has (not this host's
+        stripe) — the elastic fleet's work-unit count.  In-memory
+        tables count as one pseudo-fragment."""
+        if self._dataset is None:
+            return 1
+        return sum(1 for _ in self._dataset.get_fragments())
+
+    def read_fragment(self, fi: int, skip_batches: int = 0
+                      ) -> Iterator[Tuple[int, int, pa.RecordBatch]]:
+        """Positioned batches of ONE fragment by GLOBAL index — the
+        elastic scheduler's pull unit (a claimed fragment is read here
+        regardless of any process stripe).  ``skip_batches`` skips the
+        fragment's first N batches without yielding them (the adopted-
+        checkpoint partial-fragment resume); batch boundaries are
+        deterministic for a fixed batch size, so positions are stable
+        across processes and restarts.  Same retry/dedup contract as
+        ``raw_batches_positioned``."""
+        if self._dataset is None:
+            if fi != 0:
+                raise ValueError(
+                    f"in-memory tables have one pseudo-fragment; got "
+                    f"fragment index {fi}")
+            for _fi, bi, rb in self.raw_batches_positioned():
+                if bi >= skip_batches:
+                    yield fi, bi, rb
+            return
+        for k, fragment in enumerate(self._dataset.get_fragments()):
+            if k == fi:
+                break
+        else:
+            raise ValueError(f"dataset has no fragment {fi}")
+        self.fragments_opened += 1
+        delivered = int(skip_batches)
+        for attempt in range(self.max_retries + 1):
+            try:
+                for bi, rb in enumerate(
+                        fragment.to_batches(batch_size=self.batch_rows,
+                                            columns=self._columns)):
+                    if bi < delivered:
+                        continue        # skipped or already yielded
+                    yield fi, bi, rb
+                    delivered = bi + 1
+                break
+            except OSError:
+                if attempt == self.max_retries:
+                    raise
 
     def batches(self, hll_precision: int = 11) -> Iterator[HostBatch]:
         for rb in self.raw_batches():

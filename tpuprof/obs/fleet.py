@@ -42,6 +42,31 @@ def merge_wires(wires: List[Dict[str, Any]]) -> metrics.MetricsRegistry:
     return merged
 
 
+def write_fleet_labeled(metrics_path: Optional[str],
+                        wires_by_host: Dict[str, Dict[str, Any]],
+                        reason: str = "collect") -> Optional[str]:
+    """The elastic-fleet twin of :func:`write_fleet`: wires arrive
+    keyed by STABLE host id (runtime/fleet.py contribution wires), not
+    allgather rank, so the ``host=`` gauge labels survive membership
+    churn — a report written by the surviving leader still names the
+    dead member's series by its id."""
+    merged = metrics.MetricsRegistry(enabled=True)
+    for host in sorted(wires_by_host):
+        merged.merge_wire(wires_by_host[host], host=host)
+    snap = merged.snapshot()
+    events.emit("fleet_snapshot", reason=reason,
+                hosts=len(wires_by_host), snapshot=snap)
+    if not metrics_path:
+        return None
+    path = fleet_prom_path(metrics_path)
+    try:
+        with open(path, "w") as fh:
+            fh.write(merged.render_text())
+    except OSError:
+        return None         # the fleet dump must never fail the profile
+    return path
+
+
 def write_fleet(metrics_path: Optional[str],
                 wires: List[Dict[str, Any]],
                 reason: str = "collect",

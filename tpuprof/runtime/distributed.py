@@ -135,9 +135,7 @@ def merge_host_aggs(hostagg):
     """Merge every host's HostAgg into a complete one (on all hosts).
     Misra-Gries merge keeps its mergeability bounds (kernels/topk.py)."""
     parts = allgather_objects(hostagg)
-    merged = parts[0]
-    for other in parts[1:]:
-        merged = _merge_pair(merged, other)
+    merged = merge_host_agg_parts(parts)
     if len(parts) > 1:
         # run-file ownership transfers: the caller is about to rebind
         # its reference to the merged copy, which must reap the fleet's
@@ -182,11 +180,7 @@ def merge_samplers(sampler):
     """Merge every host's RowSampler (ingest/sample.py) into a complete
     one — the host-side analogue of the device sketch collectives; the
     bottom-k priority merge law makes the result order-independent."""
-    parts = allgather_objects(sampler)
-    merged = parts[0]
-    for other in parts[1:]:
-        merged = merged.merge(other)
-    return merged
+    return merge_sampler_parts(allgather_objects(sampler))
 
 
 def merge_hll_registers(host_hll):
@@ -199,20 +193,33 @@ def merge_hll_registers(host_hll):
     return merged
 
 
-def merge_pass_a_states(res_a):
-    """Cross-host merge of the per-host finalized pass-A device states
-    (runtime/mesh.finalize_a output: host numpy dicts) — the DCN leg of
-    the sketch merge.  Folds with the kernels' own commutative merges
-    (moments/corr rebase onto a common shift exactly; HLL registers
-    max), so the result is what one host scanning everything would have
-    produced — the same laws tests/test_merge_laws.py pins.  No-op
-    single-process."""
+# ---------------------------------------------------------------------------
+# Part-level merge laws: the pure fold half of each cross-host merge,
+# factored out of the allgather wrappers so BOTH membership runtimes
+# speak one law — the fixed-membership collectives below hand these the
+# allgather's rank-ordered parts, and the elastic fleet runtime
+# (runtime/fleet.py) hands them contribution parts read off shared
+# storage in deterministic (host, seq) order.
+# ---------------------------------------------------------------------------
+
+def merge_sampler_parts(parts):
+    """Fold RowSampler parts (bottom-k priority merge — order-free)."""
+    merged = parts[0]
+    for other in parts[1:]:
+        merged = merged.merge(other)
+    return merged
+
+
+def merge_pass_a_parts(parts):
+    """Fold finalized pass-A states (runtime/mesh.finalize_a output:
+    host numpy dicts) with the kernels' own commutative merges —
+    moments/corr rebase onto a common shift exactly, HLL registers
+    max — so the result is what one host scanning everything would
+    have produced (the laws tests/test_merge_laws.py pins)."""
     import jax
-    if jax.process_count() == 1:
-        return res_a
+
     from tpuprof.kernels import corr as kcorr
     from tpuprof.kernels import moments as kmoments
-    parts = allgather_objects(res_a)
     merged = parts[0]
     for other in parts[1:]:
         merged = {
@@ -225,6 +232,59 @@ def merge_pass_a_states(res_a):
     return merged
 
 
+def merge_corr_parts(parts):
+    """Fold finalized corr/Spearman Gram states (the kernel's own
+    rebasing merge — parts may legitimately carry different shifts)."""
+    import jax
+
+    from tpuprof.kernels import corr as kcorr
+    merged = parts[0]
+    for other in parts[1:]:
+        merged = jax.device_get(kcorr.merge(merged, other))
+    return merged
+
+
+def merge_pass_b_parts(parts):
+    """Fold finalized pass-B histogram/MAD states (pure sums)."""
+    merged = parts[0]
+    for other in parts[1:]:
+        merged["counts"] = merged["counts"] + other["counts"]
+        merged["abs_dev"] = merged["abs_dev"] + other["abs_dev"]
+    return merged
+
+
+def merge_recount_parts(parts):
+    """Sum exact pass-B recount vectors (candidate sets are identical
+    in every part: they derive from the merged HostAgg)."""
+    merged = parts[0]
+    for other in parts[1:]:
+        for name, arr in other.items():
+            merged[name] = merged[name] + arr
+    return merged
+
+
+def merge_host_agg_parts(parts):
+    """Fold HostAgg parts with :func:`_merge_pair` (commutative laws —
+    Misra-Gries bounded merge, unique-run adoption, date min/max).
+    Mutates and returns ``parts[0]``; run-file ownership is the
+    CALLER's concern (the collective wrapper and the fleet runtime
+    have different owners to disown)."""
+    merged = parts[0]
+    for other in parts[1:]:
+        merged = _merge_pair(merged, other)
+    return merged
+
+
+def merge_pass_a_states(res_a):
+    """Cross-host merge of the per-host finalized pass-A device states
+    — the DCN leg of the sketch merge (laws: merge_pass_a_parts).
+    No-op single-process."""
+    import jax
+    if jax.process_count() == 1:
+        return res_a
+    return merge_pass_a_parts(allgather_objects(res_a))
+
+
 def merge_corr_states(state):
     """Cross-host merge of a finalized corr/Spearman Gram state (the
     kernel's own rebasing merge — hosts on the adaptive-shift XLA path
@@ -232,12 +292,7 @@ def merge_corr_states(state):
     import jax
     if jax.process_count() == 1:
         return state
-    from tpuprof.kernels import corr as kcorr
-    parts = allgather_objects(state)
-    merged = parts[0]
-    for other in parts[1:]:
-        merged = jax.device_get(kcorr.merge(merged, other))
-    return merged
+    return merge_corr_parts(allgather_objects(state))
 
 
 def merge_pass_b_states(res_b):
@@ -246,23 +301,13 @@ def merge_pass_b_states(res_b):
     import jax
     if jax.process_count() == 1:
         return res_b
-    parts = allgather_objects(res_b)
-    merged = parts[0]
-    for other in parts[1:]:
-        merged["counts"] = merged["counts"] + other["counts"]
-        merged["abs_dev"] = merged["abs_dev"] + other["abs_dev"]
-    return merged
+    return merge_pass_b_parts(allgather_objects(res_b))
 
 
 def merge_recount_arrays(counts_by_col):
     """Sum each host's exact pass-B recount vectors (candidate sets are
     identical on every host: they derive from the merged HostAgg)."""
-    parts = allgather_objects(counts_by_col)
-    merged = parts[0]
-    for other in parts[1:]:
-        for name, arr in other.items():
-            merged[name] = merged[name] + arr
-    return merged
+    return merge_recount_parts(allgather_objects(counts_by_col))
 
 
 def _merge_pair(a, b):

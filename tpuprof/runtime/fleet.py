@@ -1,0 +1,605 @@
+"""Elastic fleet runtime: work-stealing fragment scheduler, host-death
+survival, join/leave at resume barriers (ROBUSTNESS.md rung 5).
+
+The fixed-membership runtime (runtime/distributed.py) stripes fragments
+statically and runs collectives that EVERY process must reach — one dead
+host wedges (or watchdog-kills) the whole run.  This module is the
+elastic alternative: membership is a shared **fleet directory** instead
+of a collective group, and work assignment is a **pull**, not an
+ownership stripe.
+
+Coordination is plain atomic filesystem operations on storage every
+member sees (the same class of shared storage ``unique_spill_dir``
+already requires for multi-host exactness):
+
+* ``manifest.json`` — the fragment manifest: fragment count + source/
+  config fingerprint, written once by the first arriver (``O_EXCL``;
+  losers read and validate).  CRC-sealed: a torn manifest surfaces as
+  :class:`CorruptManifestError`, never a raw JSON error.
+* ``claim.<phase>.<k>`` — fragment k is being scanned by the host named
+  in the file.  ``O_EXCL`` creation is the arbiter: exactly one winner,
+  no read-modify-write races.  A slow host simply claims fewer
+  fragments; a dead host stops claiming — that is the whole
+  work-stealing scheduler.
+* ``done.<phase>.<k>`` — the claimant folded every batch of fragment k.
+* ``steal.<phase>.<k>.<g>`` — generation-g takeover of a dead host's
+  fragment (``O_EXCL`` again arbitrates concurrent stealers; thieves
+  are subject to liveness like anyone else, so a dead thief's loot is
+  re-stealable at generation g+1).
+* ``hb.<host>`` — heartbeat, mtime refreshed by a daemon thread.  Stale
+  (``liveness_timeout_s``) or missing ⇒ dead.  An injected
+  ``host_death`` deletes the file on the way out (:meth:`depart`) so
+  deterministic tests detect the death immediately; a kill -9 leaves
+  the file to go stale — both roads lead to the same steal.
+* ``part.<phase>.<host>.<seq>`` — a CRC-sealed contribution: the
+  finalized, mergeable fold state covering an explicit fragment list.
+  **Durability contract**: a fragment only counts as covered when some
+  part lists it.  A host that claimed (even finished) fragments but
+  died before contributing left nothing behind that anyone merged, so
+  its fragments are replayed from scratch — final stats equal a clean
+  run by the merge laws (runtime/distributed.merge_*_parts).
+* ``wire.<host>`` — each member's final metrics wire; the surviving
+  leader merges them into ``<metrics_path>.fleet.prom`` (obs/fleet.py)
+  with per-host labels plus the rebalance counters.
+
+Join/leave happens at the resume-barrier points: a NEW process simply
+starts claiming from the manifest; a RESTARTED process presenting the
+same ``fleet_host_id`` adopts its predecessor's claims and — when a
+checkpoint path is configured — the checkpoint cursor as its handoff
+token (backends/tpu.py re-commits the restored leaves with
+``runtime/mesh.place_state``, so the resumed fold is byte-stable).
+Claims marked done after the adopted checkpoint's last save are
+un-done and replayed: the fold state for them died with the
+predecessor.
+
+Elastic mode deliberately does NOT join ``jax.distributed``: the
+collective runtime cannot survive membership change, and every
+cross-host merge tpuprof needs is a host-side fold of finalized parts
+(the same laws the DCN allgathers apply).  ``backends/tpu.py`` rejects
+the combination.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+from tpuprof.errors import CorruptManifestError, InputError
+from tpuprof.obs import metrics as _obs_metrics
+
+MANIFEST_SCHEMA = "tpuprof-fleet-manifest-v1"
+PART_VERSION = 1
+
+_REBALANCES = _obs_metrics.counter(
+    "tpuprof_fleet_rebalances_total",
+    "dead-host rebalance events (one per steal sweep that took work)")
+_STOLEN = _obs_metrics.counter(
+    "tpuprof_fragments_stolen_total",
+    "fragments taken over from dead fleet members, by phase")
+_CLAIMED = _obs_metrics.gauge(
+    "tpuprof_fleet_fragments_claimed",
+    "fragments this member has claimed from the manifest (by phase)")
+_DONE = _obs_metrics.gauge(
+    "tpuprof_fleet_fragments_done",
+    "claimed fragments this member finished folding (by phase)")
+
+
+def _canonical(doc: Dict[str, Any]) -> bytes:
+    return json.dumps(doc, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    # dot-prefixed so an in-flight write can NEVER match the prefix
+    # scans (``part.``/``wire.``) — a reader racing the os.replace must
+    # see either nothing or the complete file, not torn bytes
+    tmp = os.path.join(os.path.dirname(path) or ".",
+                       f".tmp.{os.path.basename(path)}.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+
+
+def _excl_create(path: str, content: str) -> bool:
+    """Atomically create ``path`` with ``content``; False if it already
+    exists (someone else won).  The O_EXCL open is the fleet's only
+    arbiter — no locks, no read-modify-write."""
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    try:
+        os.write(fd, content.encode("utf-8"))
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    return True
+
+
+def _read_small(path: str) -> Optional[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return fh.read().strip()
+    except OSError:
+        return None
+
+
+def write_part_bytes(payload: Dict[str, Any]) -> bytes:
+    """Serialize one contribution part: a header pickle carrying the
+    payload CRC32 + length, then the raw payload pickle — the same
+    torn-write envelope checkpoints use (runtime/checkpoint.py v5)."""
+    body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    header = pickle.dumps(
+        {"part_version": PART_VERSION,
+         "payload_crc32": zlib.crc32(body) & 0xFFFFFFFF,
+         "payload_len": len(body)},
+        protocol=pickle.HIGHEST_PROTOCOL)
+    return header + body
+
+
+def read_part_bytes(raw: bytes, origin: str = "part") -> Dict[str, Any]:
+    """Decode + integrity-check a contribution part.  ANY failure —
+    truncation at any offset, bit rot, a foreign version — surfaces as
+    :class:`CorruptManifestError`: a torn part must never silently
+    merge into fleet statistics."""
+    import io
+    try:
+        buf = io.BytesIO(raw)
+        header = pickle.load(buf)
+        if not isinstance(header, dict) \
+                or header.get("part_version") != PART_VERSION:
+            raise CorruptManifestError(
+                f"fleet {origin} has unsupported version "
+                f"{header.get('part_version') if isinstance(header, dict) else header!r}")
+        body = buf.read()
+        if len(body) != header.get("payload_len"):
+            raise CorruptManifestError(
+                f"fleet {origin} payload is {len(body)} bytes, header "
+                f"says {header.get('payload_len')} — truncated write")
+        if zlib.crc32(body) & 0xFFFFFFFF != header.get("payload_crc32"):
+            raise CorruptManifestError(
+                f"fleet {origin} payload CRC mismatch — corrupt")
+        payload = pickle.loads(body)
+        if not isinstance(payload, dict):
+            raise CorruptManifestError(
+                f"fleet {origin} decodes to {type(payload).__name__}, "
+                "not a payload dict")
+        return payload
+    except CorruptManifestError:
+        raise
+    except Exception as exc:
+        raise CorruptManifestError(
+            f"fleet {origin} is unreadable "
+            f"({type(exc).__name__}: {exc})") from exc
+
+
+def write_manifest_bytes(doc: Dict[str, Any]) -> bytes:
+    body = _canonical(doc)
+    return _canonical({"schema": MANIFEST_SCHEMA,
+                       "crc32": zlib.crc32(body) & 0xFFFFFFFF}
+                      ) + b"\n" + body + b"\n"
+
+
+def read_manifest_bytes(raw: bytes) -> Dict[str, Any]:
+    try:
+        head, _, body = raw.partition(b"\n")
+        envelope = json.loads(head)
+        if envelope.get("schema") != MANIFEST_SCHEMA:
+            raise CorruptManifestError(
+                f"fleet manifest schema {envelope.get('schema')!r} is "
+                f"not {MANIFEST_SCHEMA!r}")
+        body = body.rstrip(b"\n")
+        if zlib.crc32(body) & 0xFFFFFFFF != envelope.get("crc32"):
+            raise CorruptManifestError(
+                "fleet manifest CRC mismatch — torn or hand-edited")
+        return json.loads(body)
+    except CorruptManifestError:
+        raise
+    except Exception as exc:
+        raise CorruptManifestError(
+            f"fleet manifest is unreadable "
+            f"({type(exc).__name__}: {exc})") from exc
+
+
+class FleetMember:
+    """One process's membership in an elastic fleet.
+
+    Lifecycle::
+
+        member = FleetMember(fleet_dir, host_id, n_fragments, fp)
+        while (k := member.claim_next("a")) is not None:
+            ... scan fragment k ...
+            member.mark_done("a", k)
+        parts = member.finish("a", my_payload, my_fragments, steal_scan)
+        ... merge parts (runtime/distributed.merge_*_parts) ...
+        member.close()
+
+    ``finish`` is the resume-barrier point: it contributes this
+    member's part, then waits until EVERY manifest fragment is covered
+    by some part — stealing and re-scanning (via ``steal_scan``) any
+    fragment whose current owner died uncontributed."""
+
+    def __init__(self, fleet_dir: str, host_id: str, n_fragments: int,
+                 fingerprint: str, liveness_timeout_s: float = 10.0,
+                 poll_s: Optional[float] = None):
+        if "/" in host_id or host_id in ("", ".", ".."):
+            raise InputError(
+                f"fleet_host_id {host_id!r} must be a plain filename "
+                "token (it names heartbeat/claim files)")
+        self.dir = fleet_dir
+        self.host_id = host_id
+        self.n_fragments = int(n_fragments)
+        self.liveness_timeout_s = float(liveness_timeout_s)
+        self.poll_s = poll_s if poll_s is not None \
+            else min(max(self.liveness_timeout_s / 10.0, 0.05), 1.0)
+        os.makedirs(self.dir, exist_ok=True)
+        self._ensure_manifest(fingerprint)
+        self._claimed: Dict[str, Set[int]] = {}
+        self._done: Dict[str, Set[int]] = {}
+        self._scan_cursor: Dict[str, int] = {}
+        self._stolen_total = 0
+        self._adopted = self._adopt()
+        # heartbeat BEFORE any claim: a claim by a host with no
+        # heartbeat file would read as instantly dead
+        self._hb_path = self._p(f"hb.{self.host_id}")
+        _atomic_write(self._hb_path, b"alive\n")
+        self._stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._beat, daemon=True,
+            name=f"tpuprof-fleet-hb-{self.host_id}")
+        self._hb_thread.start()
+        from tpuprof.obs import events
+        events.emit("fleet_join", host=self.host_id,
+                    fragments=self.n_fragments,
+                    adopted=sorted(self._adopted))
+
+    # -- paths -------------------------------------------------------------
+
+    def _p(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    def _claim_path(self, phase: str, k: int) -> str:
+        return self._p(f"claim.{phase}.{k}")
+
+    def _done_path(self, phase: str, k: int) -> str:
+        return self._p(f"done.{phase}.{k}")
+
+    def _steal_path(self, phase: str, k: int, g: int) -> str:
+        return self._p(f"steal.{phase}.{k}.{g}")
+
+    # -- manifest ----------------------------------------------------------
+
+    def _ensure_manifest(self, fingerprint: str) -> None:
+        path = self._p("manifest.json")
+        doc = {"n_fragments": self.n_fragments,
+               "fingerprint": fingerprint}
+        if not os.path.exists(path):
+            tmp = f"{path}.{self.host_id}.new"
+            _atomic_write(tmp, write_manifest_bytes(doc))
+            try:
+                # link-style exclusivity via O_EXCL marker + rename is
+                # overkill: os.replace would clobber a racing winner.
+                # O_EXCL on the final name decides; the loser validates.
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                try:
+                    with open(tmp, "rb") as src:
+                        os.write(fd, src.read())
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            except FileExistsError:
+                pass
+            finally:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        with open(path, "rb") as fh:
+            existing = read_manifest_bytes(fh.read())
+        if existing != doc:
+            raise InputError(
+                f"fleet manifest at {path!r} describes "
+                f"{existing.get('n_fragments')} fragments of source "
+                f"{existing.get('fingerprint')!r}; this member sees "
+                f"{self.n_fragments} fragments of {fingerprint!r} — "
+                "members must profile the same source with the same "
+                "config (point fleet_dir somewhere fresh)")
+
+    def _adopt(self) -> Set[int]:
+        """Claims already held by this host id (a previous incarnation
+        that died or was restarted) — adopted as ours.  Done markers are
+        re-read by the caller against its checkpoint coverage; here we
+        only rebuild the ownership view."""
+        adopted: Set[int] = set()
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return adopted
+        for name in names:
+            if not (name.startswith("claim.") or name.startswith("steal.")):
+                continue
+            if _read_small(self._p(name)) != self.host_id:
+                continue
+            bits = name.split(".")
+            phase, k = bits[1], int(bits[2])
+            self._claimed.setdefault(phase, set()).add(k)
+            adopted.add(k)
+            if os.path.exists(self._done_path(phase, k)):
+                self._done.setdefault(phase, set()).add(k)
+        return adopted
+
+    # -- heartbeat / liveness ----------------------------------------------
+
+    def _beat(self) -> None:
+        interval = min(max(self.liveness_timeout_s / 4.0, 0.05), 1.0)
+        while not self._stop.wait(interval):
+            try:
+                os.utime(self._hb_path)
+            except OSError:
+                pass        # a deleted heartbeat means we departed
+
+    def live_hosts(self) -> Set[str]:
+        now = time.time()
+        live = set()
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return live
+        for name in names:
+            if not name.startswith("hb."):
+                continue
+            try:
+                age = now - os.path.getmtime(self._p(name))
+            except OSError:
+                continue
+            if age <= self.liveness_timeout_s:
+                live.add(name[len("hb."):])
+        return live
+
+    def is_dead(self, host: Optional[str], live: Set[str]) -> bool:
+        """A host with no fresh heartbeat is dead.  ``None`` (a claim
+        whose content was torn/unreadable) is treated as dead too —
+        nobody can vouch for it."""
+        return host is None or host not in live
+
+    def depart(self) -> None:
+        """Leave the fleet LOUDLY: delete the heartbeat so survivors
+        detect the death immediately instead of waiting out the
+        staleness window (the ``host_death`` injection path; a real
+        SIGKILL skips this and survivors wait for staleness)."""
+        self._stop.set()
+        try:
+            os.remove(self._hb_path)
+        except OSError:
+            pass
+        from tpuprof.obs import events
+        events.emit("fleet_depart", host=self.host_id)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._hb_thread.is_alive():
+            self._hb_thread.join(timeout=2.0)
+
+    # -- work-stealing scheduler -------------------------------------------
+
+    def claim_next(self, phase: str) -> Optional[int]:
+        """Pull the next unclaimed fragment off the manifest (ascending
+        id — deterministic single-host order, racy-by-design multi-host
+        with O_EXCL as the arbiter).  None when every fragment is
+        claimed or done."""
+        mine = self._claimed.setdefault(phase, set())
+        start = self._scan_cursor.get(phase, 0)
+        for k in range(start, self.n_fragments):
+            if k in mine:
+                continue
+            if os.path.exists(self._done_path(phase, k)) \
+                    or os.path.exists(self._claim_path(phase, k)):
+                if k == start:
+                    self._scan_cursor[phase] = k + 1
+                continue
+            if _excl_create(self._claim_path(phase, k), self.host_id):
+                mine.add(k)
+                _CLAIMED.set(len(mine), phase=phase)
+                return k
+            # lost the race — somebody else owns k now; keep scanning
+        return None
+
+    def mark_done(self, phase: str, k: int) -> None:
+        done = self._done.setdefault(phase, set())
+        done.add(k)
+        _DONE.set(len(done), phase=phase)
+        _excl_create(self._done_path(phase, k), self.host_id)
+
+    def undo_done(self, phase: str, ks: Sequence[int]) -> None:
+        """Un-mark fragments a restarted member must replay: their done
+        markers postdate the adopted checkpoint's last save, so the
+        fold state covering them died with the predecessor."""
+        done = self._done.setdefault(phase, set())
+        for k in ks:
+            done.discard(k)
+            try:
+                os.remove(self._done_path(phase, k))
+            except OSError:
+                pass
+
+    def claimed(self, phase: str) -> Set[int]:
+        return set(self._claimed.get(phase, set()))
+
+    def done(self, phase: str) -> Set[int]:
+        return set(self._done.get(phase, set()))
+
+    def _owner_gen(self, phase: str, k: int):
+        """(current owner, next steal generation) of fragment k: the
+        latest steal generation's thief, else the original claimant."""
+        g = 1
+        owner = _read_small(self._claim_path(phase, k))
+        while os.path.exists(self._steal_path(phase, k, g)):
+            owner = _read_small(self._steal_path(phase, k, g))
+            g += 1
+        return owner, g
+
+    def _owner(self, phase: str, k: int) -> Optional[str]:
+        return self._owner_gen(phase, k)[0]
+
+    def _steal(self, phase: str, k: int, gen: Optional[int] = None
+               ) -> bool:
+        """Take over fragment k at steal generation ``gen`` — the one
+        OBSERVED alongside the dead owner, so a racing survivor who
+        already took generation g (and is alive, owning the fragment)
+        cannot be re-robbed at g+1 by a stale decision; False when
+        another survivor won the O_EXCL race."""
+        if gen is None:
+            gen = self._owner_gen(phase, k)[1]
+        if _excl_create(self._steal_path(phase, k, gen), self.host_id):
+            self._claimed.setdefault(phase, set()).add(k)
+            return True
+        return False
+
+    # -- contributions / the finish barrier --------------------------------
+
+    def contribute(self, phase: str, payload: Dict[str, Any],
+                   fragments: Sequence[int]) -> str:
+        """Persist one CRC-sealed contribution part covering
+        ``fragments`` (atomic write — a crash mid-contribute leaves no
+        torn part, just an uncovered fragment set for survivors)."""
+        seq = 0
+        while os.path.exists(self._p(
+                f"part.{phase}.{self.host_id}.{seq}")):
+            seq += 1
+        envelope = dict(payload)
+        envelope["fragments"] = sorted(int(k) for k in fragments)
+        envelope["host"] = self.host_id
+        envelope["seq"] = seq
+        path = self._p(f"part.{phase}.{self.host_id}.{seq}")
+        _atomic_write(path, write_part_bytes(envelope))
+        from tpuprof.obs import events
+        events.emit("fleet_contribute", host=self.host_id, phase=phase,
+                    seq=seq, fragments=len(envelope["fragments"]))
+        return path
+
+    def read_parts(self, phase: str) -> List[Dict[str, Any]]:
+        """Every contribution part of ``phase``, sorted by (host, seq)
+        — the deterministic merge order every survivor agrees on.  A
+        torn part raises :class:`CorruptManifestError` (fleet stats
+        must never silently lose a member's rows)."""
+        parts = []
+        prefix = f"part.{phase}."
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            names = []
+        for name in names:
+            if not name.startswith(prefix) or ".tmp." in name:
+                continue
+            with open(self._p(name), "rb") as fh:
+                parts.append(read_part_bytes(fh.read(), origin=name))
+        parts.sort(key=lambda p: (str(p.get("host")), int(p.get("seq", 0))))
+        return parts
+
+    def coverage(self, phase: str) -> Set[int]:
+        covered: Set[int] = set()
+        for part in self.read_parts(phase):
+            covered.update(part.get("fragments", ()))
+        return covered
+
+    def finish(self, phase: str,
+               steal_scan: Callable[[List[int]], Dict[str, Any]],
+               timeout_s: Optional[float] = None) -> List[Dict[str, Any]]:
+        """The elastic resume barrier: wait until every manifest
+        fragment is covered by a contribution, stealing (and re-scanning
+        via ``steal_scan``) any fragment whose owner died uncontributed.
+        Returns all parts in deterministic merge order.
+
+        ``steal_scan(frag_ids)`` must scan the fragments from scratch
+        into a FRESH finalized part payload — the dead owner's partial
+        folds died with it, and replay-from-zero plus the merge laws is
+        exactly what makes the survivor's totals equal a clean run."""
+        from tpuprof.runtime.guard import Deadline
+        from tpuprof.obs import events
+        deadline = Deadline(timeout_s, site="fleet_finish",
+                            heartbeat=lambda: {
+                                "host": self.host_id, "phase": phase,
+                                "covered": len(self.coverage(phase)),
+                                "fragments": self.n_fragments})
+        all_frags = set(range(self.n_fragments))
+        while True:
+            covered = self.coverage(phase)
+            missing = sorted(all_frags - covered)
+            if not missing:
+                return self.read_parts(phase)
+            deadline.check()
+            live = self.live_hosts()
+            stolen: List[int] = []
+            for k in missing:
+                # unclaimed fragments (a member died between manifest
+                # write and claiming) go through the normal claim path
+                if not os.path.exists(self._claim_path(phase, k)):
+                    if _excl_create(self._claim_path(phase, k),
+                                    self.host_id):
+                        self._claimed.setdefault(phase, set()).add(k)
+                        stolen.append(k)
+                    continue
+                owner, gen = self._owner_gen(phase, k)
+                if owner == self.host_id:
+                    continue        # ours; covered once we contribute
+                if self.is_dead(owner, live) \
+                        and self._steal(phase, k, gen):
+                    stolen.append(k)
+            if stolen:
+                self._stolen_total += len(stolen)
+                _STOLEN.inc(len(stolen), phase=phase)
+                _REBALANCES.inc()
+                events.emit("fleet_rebalance", host=self.host_id,
+                            phase=phase, stolen=stolen)
+                payload = steal_scan(stolen)
+                self.contribute(phase, payload, stolen)
+                continue
+            time.sleep(self.poll_s)
+
+    # -- fleet metrics publication -----------------------------------------
+
+    def publish(self, metrics_path: Optional[str],
+                reason: str = "collect") -> Optional[str]:
+        """The elastic twin of runtime/distributed.publish_fleet: every
+        member drops its registry wire into the fleet dir; the LIVE
+        leader (lowest live host id) merges whatever wires exist into
+        ``<metrics_path>.fleet.prom`` with per-host labels.  No
+        collective — a dead member simply contributes no wire."""
+        from tpuprof.obs import fleet as obs_fleet
+        from tpuprof.obs import metrics
+        wire = metrics.registry().to_wire()
+        _atomic_write(self._p(f"wire.{self.host_id}"),
+                      write_part_bytes({"wire": wire}))
+        live = self.live_hosts()
+        if live and min(live) != self.host_id:
+            return None
+        wires: Dict[str, Dict[str, Any]] = {}
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            names = []
+        for name in names:
+            if not name.startswith("wire.") or ".tmp." in name:
+                continue
+            try:
+                with open(self._p(name), "rb") as fh:
+                    wires[name[len("wire."):]] = \
+                        read_part_bytes(fh.read(), origin=name)["wire"]
+            except (OSError, CorruptManifestError):
+                continue    # a torn wire degrades the dump, not the run
+        return obs_fleet.write_fleet_labeled(metrics_path, wires,
+                                            reason=reason)

@@ -194,6 +194,39 @@ class Quarantine:
             self.entries = list(entries or [])
 
 
+class Deadline:
+    """A polling-loop watchdog (the thread-based :func:`watched` does
+    not fit loops that must keep doing work between checks — the fleet
+    finish barrier steals and re-scans fragments while it waits).
+    ``check()`` raises :class:`WatchdogTimeout` once the deadline has
+    passed; a ``timeout_s`` of None/0 never expires (zero overhead
+    beyond one monotonic read per check)."""
+
+    def __init__(self, timeout_s: Optional[float], site: str,
+                 heartbeat: Optional[Callable[[], Dict[str, Any]]] = None):
+        self.timeout_s = float(timeout_s) if timeout_s else None
+        self.site = site
+        self.heartbeat = heartbeat
+        self._t0 = time.monotonic()
+
+    def check(self) -> None:
+        if self.timeout_s is None:
+            return
+        if time.monotonic() - self._t0 <= self.timeout_s:
+            return
+        _WATCHDOG_TIMEOUTS.inc(site=self.site)
+        hb = None
+        if self.heartbeat is not None:
+            try:
+                hb = self.heartbeat()
+            except Exception:
+                hb = None
+        from tpuprof.obs import events
+        events.emit("watchdog_timeout", site=self.site,
+                    timeout_s=self.timeout_s, heartbeat=hb)
+        raise WatchdogTimeout(self.site, self.timeout_s, heartbeat=hb)
+
+
 def watched(fn: Callable[[], Any], timeout_s: Optional[float],
             site: str,
             heartbeat: Optional[Callable[[], Dict[str, Any]]] = None

@@ -177,13 +177,14 @@ class StreamingProfiler:
         from tpuprof.config import (resolve_checkpoint_keep,
                                     resolve_ingest_retries,
                                     resolve_max_quarantined,
+                                    resolve_retry_backoff,
                                     resolve_watchdog_timeout)
         self._quarantine = _guard.Quarantine(
             resolve_max_quarantined(self.config.max_quarantined),
             log_path=self.config.quarantine_log)
         self._batch_guard = _guard.BatchGuard(
             resolve_ingest_retries(self.config.ingest_retries),
-            self.config.retry_backoff_s,
+            resolve_retry_backoff(self.config.retry_backoff_s),
             capture=self._quarantine.enabled)
         self._drain_timeout = resolve_watchdog_timeout(
             self.config.drain_timeout_s, "TPUPROF_DRAIN_TIMEOUT_S")
@@ -335,6 +336,10 @@ class StreamingProfiler:
                 self._quarantine.admit(site=hb.site, error=hb.error,
                                        cursor=self.cursor, rows=hb.rows)
                 continue
+            # the participation kill switch fires OUTSIDE the
+            # quarantine try: an injected host death is a death, not a
+            # poison batch to skip (tpuprof/testing/faults.py)
+            _faults.hit("host_death", key=self.cursor)
             try:
                 _faults.hit("fold", key=self.cursor)
                 self._fold_prepared(hb)

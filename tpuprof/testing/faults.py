@@ -18,6 +18,8 @@ Sites wired today (grep ``faults.hit`` / ``faults.mangle``):
 ``artifact_write``        inside the stats-artifact store's tmp-file write
 ``device_wait``           the watched device drain (``block_until_ready``)
 ``barrier``               the watched multi-host resume barrier
+``host_death``            per-batch fleet-participation kill switch
+                          (collect fold loop + StreamingProfiler fold)
 ========================  ==================================================
 
 Spec grammar (config/env-driven; ``TPUPROF_FAULTS`` +
@@ -45,6 +47,12 @@ Spec grammar (config/env-driven; ``TPUPROF_FAULTS`` +
   :func:`mangle` drops the second half of the payload on the M-th
   call, simulating a torn write that still survived the rename.
 * ``sleep=S`` — delay S seconds on every call (watchdog tests).
+* ``@M`` — host death: raise :class:`HostDeathError` on the M-th call
+  (first attempts only for keyed sites) and never again — the process
+  is expected to stop participating.  Written ``host_death:@k``:
+  deterministic per rank because each process carries its own
+  ``TPUPROF_FAULTS`` env, so "kill THIS host after k batches" is a
+  pure function of the spec the victim was launched with.
 
 ``injected()`` reports how many raises each site actually produced, so
 tests can assert quarantine counts match the injection count exactly.
@@ -58,7 +66,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from tpuprof.errors import TransientError
+from tpuprof.errors import HostDeathError, TransientError
 
 _ENV_SPEC = "TPUPROF_FAULTS"
 _ENV_SEED = "TPUPROF_FAULTS_SEED"
@@ -77,6 +85,13 @@ class _Rule:
         mode = mode.strip()
         if mode == "transient":
             self.kind = "transient"
+        elif mode.startswith("@"):
+            # host death: one fatal, unretryable participation kill at
+            # the M-th call (ISSUE 7 — ``host_death:@k``)
+            self.kind, self.count = "death", 1
+            self.start = int(mode[1:])
+            if self.start < 1:
+                raise ValueError(f"death call number must be >=1: {mode!r}")
         elif mode.startswith("sleep="):
             self.kind = "sleep"
             self.sleep_s = float(mode[len("sleep="):])
@@ -178,6 +193,11 @@ class FaultPlan:
                     raise TransientError(
                         f"injected transient fault at {site!r} "
                         f"(key={key!r}, first attempt)")
+            elif rule.kind == "death":
+                n = first_no if key is not None else call_no
+                if (first or key is None) and n == rule.start:
+                    self._record(site)
+                    raise HostDeathError(site, n)
             elif rule.kind in ("window", "fatal"):
                 n = first_no if key is not None else call_no
                 if first and rule.start <= n < rule.start + rule.count \
