@@ -750,8 +750,6 @@ def measure_rebalance(rows: int, n_frags: int = 6) -> dict:
                                        liveness_timeout_s=5.0)
         while survivor.claim_next("a") is not None:
             pass
-        survivor.contribute("a", {"rows": 0},
-                            sorted(survivor.claimed("a")))
 
         def replay(frags):
             n = sum(rb.num_rows for fi in frags
@@ -759,7 +757,9 @@ def measure_rebalance(rows: int, n_frags: int = 6) -> dict:
             return {"rows": int(n)}
 
         t0 = time.perf_counter()
-        parts = survivor.finish("a", replay, timeout_s=60)
+        parts = survivor.finish("a", {"rows": 0},
+                                sorted(survivor.claimed("a")),
+                                replay, timeout_s=60)
         latency_s = time.perf_counter() - t0
         survivor.close()
         stolen = sum(len(p["fragments"]) for p in parts

@@ -133,14 +133,14 @@ class TestPrimitives:
 
         survivor = _member(tmp_path, "s", n=3)
         assert survivor.claim_next("x") == 2
-        survivor.contribute("x", {"v": 1}, [2])
         scanned = []
 
         def steal_scan(frags):
             scanned.append(list(frags))
             return {"v": 2}
 
-        parts = survivor.finish("x", steal_scan, timeout_s=30)
+        parts = survivor.finish("x", {"v": 1}, [2], steal_scan,
+                                timeout_s=30)
         assert scanned == [[0, 1]]
         # deterministic merge order: (host, seq) — the survivor's own
         # contribution (seq 0) precedes its steal part (seq 1)
@@ -161,9 +161,8 @@ class TestPrimitives:
         assert slow.claim_next("x") == 0
         fast = _member(tmp_path, "fast", n=2)
         assert fast.claim_next("x") == 1
-        fast.contribute("x", {}, [1])
         with pytest.raises(WatchdogTimeout):
-            fast.finish("x", lambda f: {}, timeout_s=0.6)
+            fast.finish("x", {}, [1], lambda f: {}, timeout_s=0.6)
         assert fleetrt._STOLEN.total() == 0
         slow.close(), fast.close()
 
@@ -223,6 +222,126 @@ class TestPrimitives:
         (fleet / "part.a.b.0").write_bytes(b"torn")
         with pytest.raises(CorruptManifestError):
             a.read_parts("a")
+        a.close()
+
+    def test_claim_files_publish_atomically_with_content(self, tmp_path):
+        """Claims are hardlink-published: the file appears WITH its
+        owner already written (an O_EXCL create + write left a window
+        where a racing reader saw an empty claim, judged the owner ''
+        dead, and stole a live host's fresh claim).  No tmp debris
+        survives either path of the race."""
+        a = _member(tmp_path, "a", n=2)
+        assert a.claim_next("x") == 0
+        fleet = tmp_path / "fleet"
+        assert (fleet / "claim.x.0").read_text() == "a"
+        assert not [n for n in os.listdir(fleet)
+                    if n.startswith(".tmp.")]
+        # losing the race leaves no debris and no clobbered content
+        assert fleetrt._excl_create(str(fleet / "claim.x.0"), "b") \
+            is False
+        assert (fleet / "claim.x.0").read_text() == "a"
+        assert not [n for n in os.listdir(fleet)
+                    if n.startswith(".tmp.")]
+        a.close()
+
+    def test_restarted_member_supersedes_predecessor_part(self, tmp_path):
+        """A member that died AFTER contributing and restarts with the
+        same host id re-covers its claims; the predecessor's part must
+        be superseded, not merged alongside — two parts covering the
+        same fragments double-count every row (REVIEW: high)."""
+        a = _member(tmp_path, "a", n=2)
+        assert a.claim_next("x") == 0
+        assert a.claim_next("x") == 1
+        parts = a.finish("x", {"v": 1}, [0, 1], lambda f: {"v": 9},
+                         timeout_s=30)
+        assert [p["fragments"] for p in parts] == [[0, 1]]
+        a.depart()
+        heir = _member(tmp_path, "a", n=2)
+        assert heir.claimed("x") == {0, 1}
+        parts = heir.finish("x", {"v": 2}, sorted(heir.claimed("x")),
+                            lambda f: {"v": 9}, timeout_s=30)
+        # exactly one part covers the fragments, and it is the heir's
+        assert [p["fragments"] for p in parts] == [[0, 1]]
+        assert [p["v"] for p in parts] == [2]
+        # seq stayed monotone across the supersede: a peer's part
+        # cache can never alias the old bytes onto a reused filename
+        assert all(p["seq"] > 0 for p in parts)
+        heir.close()
+
+    def test_fencing_discards_tainted_part_and_rescans(self, tmp_path):
+        """A live member whose heartbeat merely LOOKED stale gets a
+        fragment stolen; when it later contributes, the stolen
+        fragment's rows are inside its monolithic fold — the part is
+        fenced and the surviving fragments re-scan from scratch
+        instead of double-counting (REVIEW: high)."""
+        victim = _member(tmp_path, "v", n=2)
+        assert victim.claim_next("x") == 0
+        assert victim.claim_next("x") == 1
+        thief = _member(tmp_path, "t", n=2)
+        assert thief._steal("x", 0, 1)      # victim judged dead wrongly
+        thief.contribute("x", {"v": "thief"}, [0])
+        rescans = []
+
+        def rescan(frags):
+            rescans.append(list(frags))
+            return {"v": "rescanned"}
+
+        parts = victim.finish("x", {"v": "tainted"}, [0, 1], rescan,
+                              timeout_s=30)
+        assert rescans == [[1]]             # only the surviving fragment
+        assert victim.claimed("x") == {1}   # ownership view fenced too
+        assert sorted(p["v"] for p in parts) == ["rescanned", "thief"]
+        covered = sorted(k for p in parts for k in p["fragments"])
+        assert covered == [0, 1]            # disjoint, complete
+        victim.close(), thief.close()
+
+    def test_adoption_skips_stolen_fragments(self, tmp_path):
+        """A restarted member must NOT adopt claims a survivor stole
+        while it was down: the thief's part covers them already."""
+        a = _member(tmp_path, "a", n=3)
+        assert a.claim_next("x") == 0
+        assert a.claim_next("x") == 1
+        a.mark_done("x", 0)
+        a.depart()
+        thief = _member(tmp_path, "t", n=3)
+        assert thief._steal("x", 0, 1)
+        heir = _member(tmp_path, "a", n=3)
+        assert heir.claimed("x") == {1}     # 0 belongs to the thief now
+        assert heir.done("x") == set()
+        thief.close(), heir.close()
+
+    def test_overlapping_parts_are_a_typed_error(self, tmp_path):
+        """Backstop for every steal/fence/supersede race: if two parts
+        ever cover the same fragment, finish() must raise the typed
+        error instead of silently merging double-counted rows."""
+        a = _member(tmp_path, "a", n=1)
+        b = _member(tmp_path, "b", n=1)
+        a.contribute("x", {"v": 1}, [0])
+        b.contribute("x", {"v": 2}, [0])
+        with pytest.raises(CorruptManifestError, match="covered by both"):
+            a.finish("x", {}, [], lambda f: {}, timeout_s=5)
+        a.close(), b.close()
+
+    def test_finish_polls_reuse_cached_parts(self, tmp_path,
+                                             monkeypatch):
+        """Published parts are immutable and never renamed — each file
+        pays its read + CRC + unpickle exactly once however often the
+        finish barrier polls coverage (REVIEW: O(parts x size) I/O per
+        tick hammered shared storage)."""
+        a = _member(tmp_path, "a", n=1)
+        a.contribute("x", {"v": 1}, [0])
+        calls = []
+        real = fleetrt.read_part_bytes
+
+        def counting(raw, origin="part"):
+            calls.append(origin)
+            return real(raw, origin=origin)
+
+        monkeypatch.setattr(fleetrt, "read_part_bytes", counting)
+        a.read_parts("x")
+        a.read_parts("x")
+        assert a.coverage("x") == {0}
+        assert len(calls) == 1
         a.close()
 
 
@@ -328,6 +447,46 @@ class TestElasticCollect:
         # manifest claims + the checkpoint cursor and finishes
         resumed = html(TPUStatsBackend().collect(ds, c2), c2)
         assert resumed == control       # byte-for-byte
+
+    def test_restart_after_steal_discards_tainted_checkpoint(
+            self, tmp_path):
+        """REVIEW regression: a member dies with a checkpoint on disk;
+        a survivor joins, steals and re-scans ALL its fragments, and
+        completes alone.  When the dead member then restarts with the
+        same host id, the fragments its checkpoint fold covers belong
+        to the survivor's parts — re-contributing the restored fold
+        would double-count them.  The restart must discard the restore
+        (fleet_adopt_fenced), contribute only what it still owns, and
+        its merged stats must still equal a clean run."""
+        ds = _make_ds(tmp_path, seed=11)
+        ctrl = _key_stats(_collect(ds))
+        fleet = str(tmp_path / "fleet")
+        ck = str(tmp_path / "ck")
+
+        def run(host, **kw):
+            return _collect(ds, elastic=True, fleet_dir=fleet,
+                            fleet_host_id=host,
+                            liveness_timeout_s=30.0, **kw)
+
+        faults.configure("host_death:@7", seed=0)
+        with pytest.raises(HostDeathError):
+            run("h0", checkpoint_path=ck, checkpoint_every_batches=3)
+        faults.reset()
+        assert os.path.exists(ck)       # the tainted handoff token
+        # the survivor steals h0's fragments and finishes by itself
+        got1 = _key_stats(run("h1"))
+        assert got1["n"] == ctrl["n"]
+        assert got1["hist_a"] == ctrl["hist_a"]
+        # dead member restarts: its checkpoint covers stolen fragments
+        got2 = _key_stats(run("h0", checkpoint_path=ck,
+                              checkpoint_every_batches=3))
+        assert got2["n"] == ctrl["n"]                       # no double count
+        assert got2["hist_a"] == ctrl["hist_a"]             # exact
+        assert got2["mean_a"] == pytest.approx(ctrl["mean_a"], rel=1e-6)
+        assert got2["std_a"] == pytest.approx(ctrl["std_a"], rel=1e-5)
+        assert got2["distinct_c"] == ctrl["distinct_c"]
+        assert (got2["top_c"], got2["freq_c"]) == \
+            (ctrl["top_c"], ctrl["freq_c"])
 
     def test_checkpoint_carries_fleet_done_manifest(self, tmp_path):
         """The completed-fragment claims are durable: they ride the

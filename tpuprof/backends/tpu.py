@@ -479,10 +479,13 @@ def _part_regs(part):
 
 def _elastic_merge_a(fleet_member, res_a, hostagg, sampler, host_hll,
                      quarantine, steal_scan, timeout_s):
-    """Contribute this member's finalized pass-A part, hold the elastic
-    resume barrier (stealing dead members' fragments via
-    ``steal_scan``), and fold every contribution with the same merge
-    laws the fixed-membership collectives apply
+    """Contribute this member's finalized pass-A part (fenced: a
+    fragment stolen by a peer to whom we merely LOOKED dead taints the
+    monolithic fold, so runtime/fleet re-scans the surviving fragments
+    via ``steal_scan`` instead of double-counting), hold the elastic
+    resume barrier (stealing dead members' fragments the same way),
+    and fold every contribution with the same merge laws the
+    fixed-membership collectives apply
     (runtime/distributed.merge_*_parts).  Returns
     ``(res_a, hostagg, sampler, hll_regs, q_entries, q_mark)`` — the
     merged whole-fleet accumulators, the max-folded effective HLL
@@ -498,9 +501,9 @@ def _elastic_merge_a(fleet_member, res_a, hostagg, sampler, host_hll,
             "sampler": sampler, "host_hll": host_hll,
             "quarantine": list(quarantine.entries),
             "rows": int(hostagg.n_rows)}
-    fleet_member.contribute("a", mine,
-                            sorted(fleet_member.claimed("a")))
-    parts = fleet_member.finish("a", steal_scan, timeout_s=timeout_s)
+    parts = fleet_member.finish("a", mine,
+                                sorted(fleet_member.claimed("a")),
+                                steal_scan, timeout_s=timeout_s)
     regs = _part_regs(parts[0]).copy()
     for part in parts[1:]:
         regs = np.maximum(regs, _part_regs(part))
@@ -520,9 +523,9 @@ def _elastic_merge_b(fleet_member, my_part, steal_scan, timeout_s):
     from tpuprof.runtime.distributed import (merge_corr_parts,
                                              merge_pass_b_parts,
                                              merge_recount_parts)
-    fleet_member.contribute("b", my_part,
-                            sorted(fleet_member.claimed("b")))
-    parts = fleet_member.finish("b", steal_scan, timeout_s=timeout_s)
+    parts = fleet_member.finish("b", my_part,
+                                sorted(fleet_member.claimed("b")),
+                                steal_scan, timeout_s=timeout_s)
     res_bs = [p["res_b"] for p in parts if p.get("res_b") is not None]
     res_b = merge_pass_b_parts(res_bs) if res_bs else None
     counts = merge_recount_parts([p["counts"] for p in parts])
@@ -830,10 +833,45 @@ class TPUStatsBackend:
             # all — are replayed from scratch, because the fold state
             # covering them died with the predecessor.
             ck_done = set(fleet_ck_done or []) if restored else set()
-            for k in sorted(ck_done):
-                fleet_member.mark_done("a", k)
             in_progress = {resume_frag[0]} \
                 if restored and resume_frag is not None else set()
+            if restored:
+                # ownership fencing on the handoff: fragments the
+                # checkpoint's fold covers may have been STOLEN and
+                # re-scanned by survivors while this member was down
+                # (adoption already dropped them from the claimed
+                # view).  The restored fold contains their rows and
+                # cannot subtract them — discard the restore and
+                # replay the still-owned claims from scratch instead
+                # of double-counting the stolen fragments
+                stolen_cover = sorted((ck_done | in_progress)
+                                      - fleet_member.claimed("a"))
+                if stolen_cover:
+                    from tpuprof.utils.trace import logger
+                    logger.warning(
+                        "fleet member %s: fragments %s of the adopted "
+                        "checkpoint were stolen by survivors while "
+                        "this member was down — discarding the "
+                        "restored fold and rescanning the still-owned "
+                        "claims from zero",
+                        fleet_member.host_id, stolen_cover)
+                    log_event("fleet_adopt_fenced",
+                              host=fleet_member.host_id,
+                              stolen=stolen_cover)
+                    restored = False
+                    state, skip, resume_frag = None, 0, None
+                    ck_done, in_progress = set(), set()
+                    quarantine.seed([])
+                    hostagg = HostAgg(plan, config)
+                    sampler = RowSampler(config.quantile_sketch_size,
+                                         plan.n_num, seed=config.seed,
+                                         process_index=pshard[0])
+                    host_hll = khll.HostRegisters(
+                        plan.n_hash, config.hll_precision) \
+                        if use_host_hll else None
+                    resume.last_saved = -1
+            for k in sorted(ck_done):
+                fleet_member.mark_done("a", k)
             fleet_replay = sorted(fleet_member.claimed("a")
                                   - ck_done - in_progress)
             fleet_member.undo_done("a", fleet_replay)

@@ -22,9 +22,29 @@ wire dicts.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional
 
 from tpuprof.obs import events, metrics
+
+
+def _atomic_text_write(path: str, text: str) -> None:
+    """tmp + os.replace so concurrent writers last-writer-win on a
+    COMPLETE file: elastic leader election (min live host on each
+    member's own liveness snapshot) can transiently elect two leaders,
+    and two plain open(path, 'w') writers would interleave into a torn
+    prom dump."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def fleet_prom_path(metrics_path: str) -> str:
@@ -60,8 +80,7 @@ def write_fleet_labeled(metrics_path: Optional[str],
         return None
     path = fleet_prom_path(metrics_path)
     try:
-        with open(path, "w") as fh:
-            fh.write(merged.render_text())
+        _atomic_text_write(path, merged.render_text())
     except OSError:
         return None         # the fleet dump must never fail the profile
     return path

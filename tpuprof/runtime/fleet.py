@@ -17,15 +17,27 @@ already requires for multi-host exactness):
   losers read and validate).  CRC-sealed: a torn manifest surfaces as
   :class:`CorruptManifestError`, never a raw JSON error.
 * ``claim.<phase>.<k>`` — fragment k is being scanned by the host named
-  in the file.  ``O_EXCL`` creation is the arbiter: exactly one winner,
-  no read-modify-write races.  A slow host simply claims fewer
-  fragments; a dead host stops claiming — that is the whole
-  work-stealing scheduler.
+  in the file.  Atomic hardlink publication of a fully-written temp
+  file is the arbiter: exactly one winner, no read-modify-write races,
+  and a reader can NEVER observe an empty claim (an empty owner would
+  read as instantly dead and invite a wrong steal).  A slow host
+  simply claims fewer fragments; a dead host stops claiming — that is
+  the whole work-stealing scheduler.
 * ``done.<phase>.<k>`` — the claimant folded every batch of fragment k.
 * ``steal.<phase>.<k>.<g>`` — generation-g takeover of a dead host's
-  fragment (``O_EXCL`` again arbitrates concurrent stealers; thieves
-  are subject to liveness like anyone else, so a dead thief's loot is
-  re-stealable at generation g+1).
+  fragment (the same atomic-create arbiter decides concurrent
+  stealers; thieves are subject to liveness like anyone else, so a
+  dead thief's loot is re-stealable at generation g+1).  Liveness is
+  a HEURISTIC (clock skew, NFS attribute-cache lag, a long stall can
+  make a live host look dead) — correctness does not rest on it:
+  immediately before contributing, a member re-checks ownership of
+  every fragment its part claims and a fragment stolen from it fences
+  the whole part (the stolen rows are inside the monolithic fold and
+  cannot be subtracted), forcing a from-scratch re-scan of the
+  surviving fragments.  The finish barrier additionally asserts all
+  parts' fragment lists are pairwise disjoint — an overlap is a
+  protocol violation and raises :class:`CorruptManifestError` instead
+  of silently double-counting.
 * ``hb.<host>`` — heartbeat, mtime refreshed by a daemon thread.  Stale
   (``liveness_timeout_s``) or missing ⇒ dead.  An injected
   ``host_death`` deletes the file on the way out (:meth:`depart`) so
@@ -50,7 +62,11 @@ token (backends/tpu.py re-commits the restored leaves with
 ``runtime/mesh.place_state``, so the resumed fold is byte-stable).
 Claims marked done after the adopted checkpoint's last save are
 un-done and replayed: the fold state for them died with the
-predecessor.
+predecessor.  Adoption excludes fragments that were stolen while the
+predecessor was down (the thief owns them now), and the restart's
+first contribution per phase SUPERSEDES any part the predecessor left
+behind — its fragments are a subset of the restart's coverage, and
+merging both would double-count every row the predecessor had folded.
 
 Elastic mode deliberately does NOT join ``jax.distributed``: the
 collective runtime cannot survive membership change, and every
@@ -116,18 +132,28 @@ def _atomic_write(path: str, data: bytes) -> None:
 
 def _excl_create(path: str, content: str) -> bool:
     """Atomically create ``path`` with ``content``; False if it already
-    exists (someone else won).  The O_EXCL open is the fleet's only
-    arbiter — no locks, no read-modify-write."""
+    exists (someone else won).  Hardlinking a fully-written temp file
+    onto the final name is the fleet's only arbiter — no locks, no
+    read-modify-write, and (unlike an O_EXCL open followed by a write)
+    no window where a concurrent reader observes the file EMPTY: an
+    empty claim would read as owned by nobody, i.e. instantly dead,
+    and a live host's fresh claim could be wrongly stolen."""
+    d = os.path.dirname(path) or "."
+    tmp = os.path.join(d, f".tmp.{os.path.basename(path)}.{os.getpid()}")
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(content)
+        fh.flush()
+        os.fsync(fh.fileno())
     try:
-        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.link(tmp, path)
+        return True
     except FileExistsError:
         return False
-    try:
-        os.write(fd, content.encode("utf-8"))
-        os.fsync(fd)
     finally:
-        os.close(fd)
-    return True
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
 
 
 def _read_small(path: str) -> Optional[str]:
@@ -252,6 +278,12 @@ class FleetMember:
         self._done: Dict[str, Set[int]] = {}
         self._scan_cursor: Dict[str, int] = {}
         self._stolen_total = 0
+        # parts are immutable once published and their names are never
+        # reused (monotone per-phase seq), so each file is read + CRC-
+        # checked + unpickled ONCE — the finish barrier polls coverage
+        # every poll_s and would otherwise re-read every part each tick
+        self._part_cache: Dict[str, Dict[str, Any]] = {}
+        self._next_seq: Dict[str, int] = {}
         self._adopted = self._adopt()
         # heartbeat BEFORE any claim: a claim by a host with no
         # heartbeat file would read as instantly dead
@@ -288,19 +320,15 @@ class FleetMember:
         doc = {"n_fragments": self.n_fragments,
                "fingerprint": fingerprint}
         if not os.path.exists(path):
-            tmp = f"{path}.{self.host_id}.new"
+            # hardlink a fully-written temp onto the final name: the
+            # manifest appears ATOMICALLY with its content (an O_EXCL
+            # create + write would let a racing member read a partial
+            # manifest and abort with CorruptManifestError); EEXIST =
+            # lost the race, the loser validates below
+            tmp = self._p(f".tmp.manifest.{self.host_id}")
             _atomic_write(tmp, write_manifest_bytes(doc))
             try:
-                # link-style exclusivity via O_EXCL marker + rename is
-                # overkill: os.replace would clobber a racing winner.
-                # O_EXCL on the final name decides; the loser validates.
-                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                try:
-                    with open(tmp, "rb") as src:
-                        os.write(fd, src.read())
-                    os.fsync(fd)
-                finally:
-                    os.close(fd)
+                os.link(tmp, path)
             except FileExistsError:
                 pass
             finally:
@@ -321,7 +349,10 @@ class FleetMember:
 
     def _adopt(self) -> Set[int]:
         """Claims already held by this host id (a previous incarnation
-        that died or was restarted) — adopted as ours.  Done markers are
+        that died or was restarted) — adopted as ours.  Fragments whose
+        CURRENT owner is someone else are excluded: a survivor stole
+        them while the predecessor was down, its part covers them, and
+        re-contributing them would double-count.  Done markers are
         re-read by the caller against its checkpoint coverage; here we
         only rebuild the ownership view."""
         adopted: Set[int] = set()
@@ -336,6 +367,8 @@ class FleetMember:
                 continue
             bits = name.split(".")
             phase, k = bits[1], int(bits[2])
+            if self._owner(phase, k) != self.host_id:
+                continue        # stolen from the predecessor
             self._claimed.setdefault(phase, set()).add(k)
             adopted.add(k)
             if os.path.exists(self._done_path(phase, k)):
@@ -475,11 +508,34 @@ class FleetMember:
                    fragments: Sequence[int]) -> str:
         """Persist one CRC-sealed contribution part covering
         ``fragments`` (atomic write — a crash mid-contribute leaves no
-        torn part, just an uncovered fragment set for survivors)."""
-        seq = 0
-        while os.path.exists(self._p(
-                f"part.{phase}.{self.host_id}.{seq}")):
-            seq += 1
+        torn part, just an uncovered fragment set for survivors).
+
+        The FIRST contribution of a phase supersedes any part a
+        predecessor incarnation (same host id, restarted) left behind:
+        this incarnation re-covers at least those fragments, so merging
+        both would double-count every row the predecessor folded.  The
+        stale parts are deleted BEFORE the new one is published — a
+        racing reader sees old coverage or new coverage, never both —
+        and seq stays monotone across incarnations so a peer's part
+        cache can never alias old bytes onto a reused name."""
+        prefix = f"part.{phase}.{self.host_id}."
+        if phase not in self._next_seq:
+            try:
+                names = os.listdir(self.dir)
+            except OSError:
+                names = []
+            stale = [n for n in names
+                     if n.startswith(prefix) and ".tmp." not in n
+                     and n[len(prefix):].isdigit()]
+            self._next_seq[phase] = 1 + max(
+                [int(n[len(prefix):]) for n in stale], default=-1)
+            for n in stale:
+                try:
+                    os.remove(self._p(n))
+                except OSError:
+                    pass
+        seq = self._next_seq[phase]
+        self._next_seq[phase] = seq + 1
         envelope = dict(payload)
         envelope["fragments"] = sorted(int(k) for k in fragments)
         envelope["host"] = self.host_id
@@ -491,11 +547,51 @@ class FleetMember:
                     seq=seq, fragments=len(envelope["fragments"]))
         return path
 
+    def _fenced_away(self, phase: str, k: int) -> bool:
+        """True when fragment k's current owner is some OTHER host —
+        it was stolen from us by a peer to whom our heartbeat merely
+        looked stale (clock skew between hosts, NFS attribute-cache
+        lag, a >liveness_timeout_s stall)."""
+        owner = self._owner(phase, k)
+        return owner is not None and owner != self.host_id
+
+    def _contribute_fenced(self, phase: str, payload: Dict[str, Any],
+                           fragments: Sequence[int],
+                           rescan: Callable[[List[int]], Dict[str, Any]]
+                           ) -> List[int]:
+        """Fenced publication: immediately before publishing, re-check
+        ownership of every fragment the part claims to cover.  A
+        fragment stolen from us taints the WHOLE part — its rows are
+        inside the monolithic fold and cannot be subtracted — so the
+        payload is discarded and the surviving fragments are re-scanned
+        from scratch via ``rescan``.  Loops because a steal can land
+        during the re-scan too; terminates because the fragment set
+        strictly shrinks every round.  Returns the fragments actually
+        contributed."""
+        frags = sorted({int(k) for k in fragments})
+        while True:
+            lost = [k for k in frags if self._fenced_away(phase, k)]
+            if not lost:
+                self.contribute(phase, payload, frags)
+                return frags
+            from tpuprof.obs import events
+            events.emit("fleet_fenced", host=self.host_id, phase=phase,
+                        lost=lost)
+            self._claimed.setdefault(phase, set()).difference_update(lost)
+            self._done.setdefault(phase, set()).difference_update(lost)
+            frags = [k for k in frags if k not in set(lost)]
+            if not frags:
+                return []
+            payload = rescan(frags)
+
     def read_parts(self, phase: str) -> List[Dict[str, Any]]:
         """Every contribution part of ``phase``, sorted by (host, seq)
         — the deterministic merge order every survivor agrees on.  A
         torn part raises :class:`CorruptManifestError` (fleet stats
-        must never silently lose a member's rows)."""
+        must never silently lose a member's rows).  Parsed parts are
+        cached by filename: parts are immutable once published and
+        names are never reused, so each file pays its read + CRC +
+        unpickle once no matter how long the finish barrier polls."""
         parts = []
         prefix = f"part.{phase}."
         try:
@@ -505,10 +601,35 @@ class FleetMember:
         for name in names:
             if not name.startswith(prefix) or ".tmp." in name:
                 continue
-            with open(self._p(name), "rb") as fh:
-                parts.append(read_part_bytes(fh.read(), origin=name))
+            cached = self._part_cache.get(name)
+            if cached is None:
+                try:
+                    with open(self._p(name), "rb") as fh:
+                        raw = fh.read()
+                except FileNotFoundError:
+                    continue    # superseded between listdir and open
+                cached = read_part_bytes(raw, origin=name)
+                self._part_cache[name] = cached
+            parts.append(cached)
         parts.sort(key=lambda p: (str(p.get("host")), int(p.get("seq", 0))))
         return parts
+
+    @staticmethod
+    def _check_disjoint(phase: str, parts: List[Dict[str, Any]]) -> None:
+        """Backstop for every steal/fence/supersede race: parts'
+        fragment lists must be pairwise disjoint, or the merge would
+        double-count the overlap's rows — a protocol violation that
+        must surface as a typed error, never as silently wrong stats."""
+        owners: Dict[int, str] = {}
+        for part in parts:
+            label = f"part.{phase}.{part.get('host')}.{part.get('seq')}"
+            for k in part.get("fragments", ()):
+                if k in owners:
+                    raise CorruptManifestError(
+                        f"fleet fragment {k} is covered by both "
+                        f"{owners[k]} and {label} — overlapping "
+                        "contributions would double-count its rows")
+                owners[k] = label
 
     def coverage(self, phase: str) -> Set[int]:
         covered: Set[int] = set()
@@ -516,13 +637,17 @@ class FleetMember:
             covered.update(part.get("fragments", ()))
         return covered
 
-    def finish(self, phase: str,
+    def finish(self, phase: str, payload: Dict[str, Any],
+               fragments: Sequence[int],
                steal_scan: Callable[[List[int]], Dict[str, Any]],
                timeout_s: Optional[float] = None) -> List[Dict[str, Any]]:
-        """The elastic resume barrier: wait until every manifest
+        """The elastic resume barrier: contribute this member's part
+        (fenced — see :meth:`_contribute_fenced` — and superseding a
+        restarted predecessor's parts), then wait until every manifest
         fragment is covered by a contribution, stealing (and re-scanning
         via ``steal_scan``) any fragment whose owner died uncontributed.
-        Returns all parts in deterministic merge order.
+        Returns all parts in deterministic merge order, after asserting
+        their fragment lists are pairwise disjoint.
 
         ``steal_scan(frag_ids)`` must scan the fragments from scratch
         into a FRESH finalized part payload — the dead owner's partial
@@ -530,6 +655,7 @@ class FleetMember:
         exactly what makes the survivor's totals equal a clean run."""
         from tpuprof.runtime.guard import Deadline
         from tpuprof.obs import events
+        self._contribute_fenced(phase, payload, fragments, steal_scan)
         deadline = Deadline(timeout_s, site="fleet_finish",
                             heartbeat=lambda: {
                                 "host": self.host_id, "phase": phase,
@@ -537,10 +663,18 @@ class FleetMember:
                                 "fragments": self.n_fragments})
         all_frags = set(range(self.n_fragments))
         while True:
-            covered = self.coverage(phase)
+            # ONE directory read per tick: coverage and the returned
+            # part list must come from the same snapshot, or a part
+            # superseded between two reads could report coverage that
+            # the merge then silently misses
+            parts = self.read_parts(phase)
+            covered: Set[int] = set()
+            for part in parts:
+                covered.update(part.get("fragments", ()))
             missing = sorted(all_frags - covered)
             if not missing:
-                return self.read_parts(phase)
+                self._check_disjoint(phase, parts)
+                return parts
             deadline.check()
             live = self.live_hosts()
             stolen: List[int] = []
@@ -565,8 +699,8 @@ class FleetMember:
                 _REBALANCES.inc()
                 events.emit("fleet_rebalance", host=self.host_id,
                             phase=phase, stolen=stolen)
-                payload = steal_scan(stolen)
-                self.contribute(phase, payload, stolen)
+                self._contribute_fenced(phase, steal_scan(stolen),
+                                        stolen, steal_scan)
                 continue
             time.sleep(self.poll_s)
 
