@@ -263,6 +263,19 @@ def _measure_rebalance() -> dict:
     return measure_rebalance(1 << 15 if _SMOKE else 1 << 17)
 
 
+def _measure_wide_exact() -> dict:
+    """Exact-distinct overhead at the wide shape (ISSUE 8): the
+    sketch-vs-exact host-path ratio at a small scale, so a tracker
+    regression shows in the headline BENCH line — the `wideexact`
+    scenario (benchmarks/run.py) tracks the full-methodology figures
+    next to the PERF.md table."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.run import measure_wide_exact
+    return measure_wide_exact(1 << 14 if _SMOKE else 1 << 17,
+                              cols=20 if _SMOKE else 200)
+
+
 def _measure_guardrail() -> dict:
     """Clean-path cost of the fault-tolerance plumbing (ISSUE 4): the
     retry-guard wrapper on the serial prepare loop, A/B'd in the same
@@ -292,6 +305,7 @@ def main() -> None:
     with span("prep"):
         host_prep = _measure_host_prep()  # before any device traffic
     guardrail = _measure_guardrail()      # host-only A/B, same fixture
+    wide_exact = _measure_wide_exact()    # exact-distinct host ratio
     artifact = _measure_artifact()        # store + incremental costs
     rebalance = _measure_rebalance()      # elastic scheduler envelope
     render_s = _measure_render()          # host-only, before the device
@@ -375,6 +389,14 @@ def main() -> None:
         # prepare loop + the v5 checkpoint CRC throughput
         "guardrail_overhead_pct": guardrail["guardrail_overhead_pct"],
         "checkpoint_crc_gbps": guardrail["checkpoint_crc_gbps"],
+        # exact-distinct host path at the wide shape (ISSUE 8): the
+        # sketch-vs-exact ratio under the production defaults (auto
+        # budget + partitioned tracker + overlapped spill) and the
+        # spill tier's write volume at the forced-spill budget
+        "exact_distinct_overhead_x":
+            wide_exact["exact_distinct_overhead_x"],
+        "unique_spill_bytes": wide_exact["spill_bytes"],
+        "unique_partitions": wide_exact["unique_partitions"],
         # flight-recorder cost on the prepare leg (ISSUE 5 acceptance:
         # < 0.5%) + HBM in use after the e2e runs (0 = no memory_stats)
         "blackbox_overhead_pct": guardrail["blackbox_overhead_pct"],

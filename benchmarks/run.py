@@ -366,6 +366,19 @@ def measure_prepare(rows: int, batch_rows: int = 1 << 16,
     serial = loop_mode(1)
     parallel = loop_mode(w)
     pipelined = pipeline_mode()
+    # ROADMAP item 3: the multi-core prepare scaling curve has never
+    # been observed (every round so far ran on a 1-core box).  Record
+    # it whenever a capable runner finally executes this harness, and
+    # leave an EXPLICIT marker otherwise — a silent gap would read as
+    # "measured, flat" instead of "never measured".
+    cpus = os.cpu_count() or 1
+    if cpus >= 8:
+        worker_scaling = [
+            {"workers": wk, "rows_per_sec": round(loop_mode(wk), 1)}
+            for wk in (1, 2, 4, 8)]
+    else:
+        worker_scaling = f"skipped: {cpus} core" \
+            + ("" if cpus == 1 else "s")
     return {
         "rows": rows, "cols": table.num_columns,
         "prepare_rows_per_sec": round(parallel, 1),
@@ -374,7 +387,8 @@ def measure_prepare(rows: int, batch_rows: int = 1 << 16,
         "pipelined_rows_per_sec": round(pipelined, 1),
         "speedup": round(parallel / serial, 3),
         "workers": w,
-        "cpus": os.cpu_count() or 1,
+        "cpus": cpus,
+        "worker_scaling": worker_scaling,
     }
 
 
@@ -382,6 +396,135 @@ def run_prepare(scale: float, workdir: str) -> dict:
     rows = max(int(50_000_000 * scale), 100_000)
     out = measure_prepare(rows)
     out["scenario"] = "prepare"
+    return out
+
+
+def measure_wide_exact(rows: int, cols: int = 200,
+                       batch_rows: int = 1 << 16) -> dict:
+    """Exact-distinct cost at the wide shape, host path in isolation
+    (the PERF.md round-5 methodology promoted to a tracked leg —
+    ISSUE 8): near-all-distinct f32 lanes, no device anywhere.
+
+    * sketch leg: ``prepare_batch`` without full hashes — the host cost
+      of the HLL tier (the 1× comparand).
+    * exact leg: ``prepare_batch`` with full hashes + the tracker feed
+      + resolve, under the PRODUCTION defaults (RAM-derived "auto"
+      global budget, partitioned tracker, overlapped spill writes) —
+      ``exact_distinct_overhead_x`` = exact total / sketch.
+    * spill leg: the tracker feed again with the global budget forced
+      to a third of the stream, so the spill path (radix scatter +
+      partitioned runs + overlapped tofile) stays on the clock at
+      every ``--scale`` even when "auto" swallows the whole stream.
+
+    Every stage is best-of-2 on warmed caches."""
+    import tempfile
+
+    import pyarrow as pa
+
+    from benchmarks import scenarios
+    from tpuprof.config import (resolve_spill_workers,
+                                resolve_unique_budget,
+                                resolve_unique_partitions)
+    from tpuprof.ingest.arrow import ArrowIngest, prepare_batch
+    from tpuprof.kernels.unique import UniqueTracker
+
+    rng = np.random.default_rng(0)
+    names = [f"f{i:03d}" for i in range(cols)]
+    xs = scenarios.wide_batch(rng, rows, cols=cols)
+    table = pa.table({nm: xs[:, i] for i, nm in enumerate(names)})
+    batch_rows = min(batch_rows, rows)
+
+    def prep_pass(full):
+        ing = ArrowIngest(table, batch_rows=batch_rows)
+        rbs = [rb for _, _, rb in ing.raw_batches_positioned()]
+
+        def one():
+            return [prepare_batch(rb, ing.plan, batch_rows, 11,
+                                  dict_cache=ing._dict_cache,
+                                  col_stats=ing._col_stats,
+                                  decode_threads=1, full_hashes=full)
+                    for rb in rbs]
+
+        one()                                   # warm
+        best, hbs = float("inf"), None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = one()
+            el = time.perf_counter() - t0
+            if el < best:
+                best, hbs = el, out
+        return best, hbs
+
+    sketch_s, _ = prep_pass(False)
+    prep_exact_s, hbs = prep_pass(True)
+
+    partitions = resolve_unique_partitions(None)
+    workers = resolve_spill_workers(None)
+    auto_budget = resolve_unique_budget("auto")
+
+    def tracker_pass(total_budget):
+        best, result = float("inf"), {}
+        for _ in range(2):
+            with tempfile.TemporaryDirectory() as td:
+                t = UniqueTracker(names, 1 << 22, total_budget,
+                                  spill_dir=os.path.join(td, "sp"),
+                                  count_exact=True,
+                                  partitions=partitions,
+                                  spill_workers=workers)
+                t0 = time.perf_counter()
+                for hb in hbs:
+                    nh = hb.num_hashes or {}
+                    for nm in names:
+                        h, valid = nh[nm]
+                        t.update(nm, h if valid is None else h[valid])
+                t.flush_spills()
+                feed = time.perf_counter() - t0
+                spill_rows = sum(r for runs in t._runs.values()
+                                 for _p, r in runs)
+                t0 = time.perf_counter()
+                counts = t.distinct_counts()
+                t.resolve()
+                resolve_s = time.perf_counter() - t0
+                if feed + resolve_s < best:
+                    best = feed + resolve_s
+                    result = {"tracker_s": feed, "resolve_s": resolve_s,
+                              "spill_bytes": spill_rows * 8,
+                              "distinct_total": int(sum(counts.values()))}
+                t.cleanup()
+        return result
+
+    exact = tracker_pass(auto_budget)
+    spill_budget = min(1 << 25, rows * cols // 3)
+    spilly = tracker_pass(spill_budget)
+
+    exact_total = prep_exact_s + exact["tracker_s"] + exact["resolve_s"]
+    return {
+        "rows": rows, "cols": cols,
+        "sketch_s": round(sketch_s, 3),
+        "prep_exact_s": round(prep_exact_s, 3),
+        "tracker_s": round(exact["tracker_s"], 3),
+        "resolve_s": round(exact["resolve_s"], 3),
+        "exact_total_s": round(exact_total, 3),
+        "exact_distinct_overhead_x": round(exact_total / sketch_s, 2),
+        "unique_budget_rows": int(auto_budget),
+        "unique_partitions": partitions,
+        "unique_spill_workers": workers,
+        "spill_tracker_s": round(spilly["tracker_s"], 3),
+        "spill_resolve_s": round(spilly["resolve_s"], 3),
+        "spill_budget_rows": int(spill_budget),
+        "spill_bytes": int(spilly["spill_bytes"]),
+        "distinct_total": exact["distinct_total"],
+        "rows_per_sec": round(rows / exact_total, 1),
+    }
+
+
+def run_wideexact(scale: float, workdir: str) -> dict:
+    # nominal = the PERF.md wide shape (512k x 200); the floor keeps
+    # the smoke-scale leg representative (the tracked signal is the
+    # overhead RATIO, which is far less scale-sensitive than the rates)
+    rows = max(int(524_288 * scale), 131_072)
+    out = measure_wide_exact(rows)
+    out["scenario"] = "wideexact"
     return out
 
 
@@ -905,6 +1048,11 @@ def run_regression(scale: float, workdir: str,
     _leg("criteo+exact",
          [sys.executable, here, "criteo", "--scale", str(scale),
           "--workdir", workdir, "--exact-distinct"])
+    # exact_distinct overhead at the WIDE shape (ISSUE 8): the 5.6x ->
+    # <=3x claim as a tracked round-over-round number, host path only
+    _leg("wide200+exact",
+         [sys.executable, here, "wideexact", "--scale", str(scale),
+          "--workdir", workdir])
     out_path = os.path.join(workdir, "REGRESSION.json")
     with open(out_path, "w") as fh:
         json.dump({"scale": scale, "results": results}, fh, indent=2)
@@ -921,6 +1069,8 @@ def run_regression(scale: float, workdir: str,
             notes = f"cum:legacy {r['pass_b_cumulative_vs_legacy']}"
         if "incremental_vs_full_speedup" in r:
             notes = f"inc:full {r['incremental_vs_full_speedup']}"
+        if "exact_distinct_overhead_x" in r:
+            notes = f"exact:sketch {r['exact_distinct_overhead_x']}x"
         rate = r.get("rows_per_sec",
                      r.get("prepare_rows_per_sec", float("nan")))
         print(f"| {r['scenario']} | {r.get('rows', '—'):,} | "
@@ -935,7 +1085,7 @@ def main() -> None:
                                              "wide1b", "streaming",
                                              "hostfed", "prepare",
                                              "passb", "faults", "drift",
-                                             "rebalance",
+                                             "rebalance", "wideexact",
                                              "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
@@ -971,7 +1121,8 @@ def main() -> None:
         pass                      # older jaxlibs: warm == cold, still valid
 
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
-              "prepare", "passb", "faults", "drift", "rebalance"]
+              "prepare", "passb", "faults", "drift", "rebalance",
+              "wideexact"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -992,6 +1143,8 @@ def main() -> None:
             result = run_drift(args.scale, args.workdir)
         elif name == "rebalance":
             result = run_rebalance(args.scale, args.workdir)
+        elif name == "wideexact":
+            result = run_wideexact(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

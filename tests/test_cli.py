@@ -143,6 +143,64 @@ def test_multi_host_flags_require_all_three(tmp_path, parquet_path):
                  "--num-processes", "1", "--process-id", "0"]) == 2
 
 
+class TestUniqueBudgetRoundTrip:
+    """The `auto` budget and the round-8 tracker knobs resolve
+    identically from env, CLI and config (ISSUE 8 satellite)."""
+
+    def test_cli_auto_budget_profiles_exactly(self, parquet_path,
+                                              tmp_path):
+        """`--unique-track-total-rows auto` + the partition/worker
+        flags drive a real profile: exact distincts, rc 0."""
+        stats_json = str(tmp_path / "s.json")
+        rc = main(["profile", parquet_path, "-o", str(tmp_path / "r.html"),
+                   "--backend", "tpu", "--batch-rows", "1024",
+                   "--exact-distinct",
+                   "--unique-spill-dir", str(tmp_path / "sp"),
+                   "--unique-track-total-rows", "auto",
+                   "--unique-partitions", "4",
+                   "--unique-spill-workers", "2",
+                   "--stats-json", stats_json, "--no-compile-cache"])
+        assert rc == 0
+        payload = json.load(open(stats_json))
+        for col in ("a", "b", "c"):
+            assert payload["variables"][col]["distinct_approx"] is False
+
+    def test_env_cli_config_resolve_identically(self, monkeypatch):
+        """One number from all three spellings of the same intent."""
+        from tpuprof.cli import build_parser
+        from tpuprof.config import resolve_unique_budget
+
+        via_config = resolve_unique_budget(
+            ProfilerConfig(unique_track_total_rows="auto")
+            .unique_track_total_rows)
+        args = build_parser().parse_args(
+            ["profile", "x.parquet",
+             "--unique-track-total-rows", "auto"])
+        via_cli = resolve_unique_budget(args.unique_track_total_rows)
+        monkeypatch.setenv("TPUPROF_UNIQUE_TRACK_TOTAL_ROWS", "auto")
+        via_env = resolve_unique_budget(None)
+        assert via_config == via_cli == via_env
+        from tpuprof.config import (UNIQUE_BUDGET_CAP_ROWS,
+                                    UNIQUE_BUDGET_DEFAULT_ROWS)
+        assert UNIQUE_BUDGET_DEFAULT_ROWS <= via_env \
+            <= UNIQUE_BUDGET_CAP_ROWS
+        # explicit integers pass through every spelling untouched
+        monkeypatch.setenv("TPUPROF_UNIQUE_TRACK_TOTAL_ROWS", "777")
+        assert resolve_unique_budget(None) == 777
+        args = build_parser().parse_args(
+            ["profile", "x.parquet", "--unique-track-total-rows", "888"])
+        assert resolve_unique_budget(args.unique_track_total_rows) == 888
+
+    def test_cli_rejects_bad_partitions(self, parquet_path, tmp_path,
+                                        capsys):
+        rc = main(["profile", parquet_path,
+                   "-o", str(tmp_path / "r.html"),
+                   "--backend", "tpu", "--unique-partitions", "12",
+                   "--no-compile-cache"])
+        assert rc == 2      # the CLI's config-error convention
+        assert "power of two" in capsys.readouterr().err
+
+
 SNAPSHOT_NUM_FIELDS = sorted(schema.NUM_FIELDS)
 
 

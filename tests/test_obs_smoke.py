@@ -52,6 +52,12 @@ EVENT_SCHEMA = {
                          "timeout_s": ((int, float), True)},
     "ticker_stop_timeout": {"ts": ((int, float), True),
                             "interval": ((int, float), True)},
+    # exact-unique spill (kernels/unique.py, ISSUE 8): one per
+    # spill-run write; `queued` says io-tier overlapped vs synchronous
+    "unique_spill": {"ts": ((int, float), True),
+                     "column": ((str,), True), "rows": ((int,), True),
+                     "bytes": ((int,), True),
+                     "seconds": ((int, float), True)},
     # fleet aggregation (obs/fleet.py): one per publish — collect
     # finish and each multi-host resume barrier
     "fleet_snapshot": {"ts": ((int, float), True),

@@ -481,6 +481,9 @@ class TestSpillLifecycle:
                 prof.update(pd.DataFrame(
                     {"u": [f"id{i:07d}" for i in range(start, start + 512)]}))
             prof._drain(force=True)
+            # overlapped spill writes (round 8) must land before this
+            # test can age the files by hand
+            prof.hostagg.unique.flush_spills()
             paths = [p for runs in prof.hostagg.unique._runs.values()
                      for p, _ in runs]
             assert paths

@@ -1,0 +1,478 @@
+"""Hash-partitioned exact-distinct tracker (ISSUE 8, kernels/unique.py).
+
+The round-8 restructuring — radix scatter by hash top bits, partitioned
+spill-run format (RUN_MAGIC), overlapped spill writes on the shared io
+tier, RAM-derived global budgets — must change COST only, never
+answers: distinct counts, UNIQUE/DUP claims and the demote-on-storage-
+abort behavior are pinned identical at every partition count and every
+spill-worker count, the new run format survives a truncation sweep at
+every byte offset (typed CorruptRunError -> honest demote), pre-round-8
+headerless runs keep loading, and checkpoints reference only durable
+runs.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfilerConfig, schema
+from tpuprof.kernels import unique as kunique
+
+
+def _feed(tracker, vals, chunk=500):
+    for i in range(0, vals.size, chunk):
+        tracker.update("c", vals[i:i + chunk])
+
+
+@pytest.fixture()
+def mixed_vals():
+    rng = np.random.default_rng(7)
+    # heavy duplication within and across batches and spill epochs
+    return rng.integers(0, 3000, 10_000).astype(np.uint64)
+
+
+class TestPartitionParity:
+    """Answers are a function of the data, not of P or the worker
+    count (acceptance: identical at partitions {1, 4, 16} and
+    spill-workers {1, 8})."""
+
+    def test_counts_and_claims_identical_across_grid(self, tmp_path,
+                                                     mixed_vals):
+        truth = len(np.unique(mixed_vals))
+        results = {}
+        for p in (1, 4, 16):
+            for w in (0, 1, 8):
+                t = kunique.UniqueTracker(
+                    ["c"], 400, 1 << 30,
+                    spill_dir=str(tmp_path / f"sp{p}_{w}"),
+                    count_exact=True, partitions=p, spill_workers=w)
+                _feed(t, mixed_vals)
+                results[(p, w)] = (t.distinct_counts()["c"],
+                                   t.resolve()["c"])
+                t.cleanup()
+        assert set(results.values()) == {(truth, kunique.DUP)}
+
+    def test_unique_claim_identical_across_grid(self, tmp_path):
+        rng = np.random.default_rng(3)
+        vals = rng.choice(1 << 60, size=4000,
+                          replace=False).astype(np.uint64)
+        for p in (1, 16):
+            for w in (0, 8):
+                t = kunique.UniqueTracker(
+                    ["c"], 400, 1 << 30,
+                    spill_dir=str(tmp_path / f"u{p}_{w}"),
+                    count_exact=True, partitions=p, spill_workers=w)
+                _feed(t, vals)
+                assert t.resolve()["c"] == kunique.UNIQUE, (p, w)
+                assert t.distinct_counts()["c"] == 4000, (p, w)
+                t.cleanup()
+
+    def test_rejects_non_power_of_two(self, tmp_path):
+        with pytest.raises(ValueError, match="power of two"):
+            kunique.UniqueTracker(["c"], 100, 100, partitions=3)
+
+
+class TestSpillWorkerDeterminism:
+    """Overlapped writes publish runs at SUBMIT time, so the run list,
+    the file contents and every answer are byte-identical at any
+    worker count — the satellite's {1, 2, 8} sweep."""
+
+    def test_run_files_byte_identical(self, tmp_path, mixed_vals):
+        payloads = {}
+        for w in (1, 2, 8):
+            t = kunique.UniqueTracker(
+                ["c"], 400, 1 << 30, spill_dir=str(tmp_path / f"w{w}"),
+                count_exact=True, partitions=4, spill_workers=w)
+            _feed(t, mixed_vals)
+            t.flush_spills()
+            blobs = [open(p, "rb").read() for p, _r in t._runs["c"]]
+            payloads[w] = (len(blobs), [hash(b) for b in blobs],
+                           t.distinct_counts()["c"], t.resolve()["c"])
+            t.cleanup()
+        assert payloads[1] == payloads[2] == payloads[8]
+        assert payloads[1][0] >= 2          # spills actually happened
+
+    def test_getstate_references_only_durable_runs(self, tmp_path,
+                                                   mixed_vals):
+        """A checkpoint taken mid-stream (pickle = the save path) must
+        find every referenced run on disk at its full recorded size —
+        queued writes settle in __getstate__."""
+        t = kunique.UniqueTracker(
+            ["c"], 400, 1 << 30, spill_dir=str(tmp_path / "sp"),
+            count_exact=True, partitions=4, spill_workers=8)
+        _feed(t, mixed_vals)
+        blob = pickle.dumps(t)      # drains; no explicit flush first
+        for path, rows in t._runs["c"]:
+            assert os.path.getsize(path) > rows * 8     # header + rows
+            t._run_layout(path, rows)                   # validates
+        t2 = pickle.loads(blob)
+        assert t2.distinct_counts()["c"] == \
+            len(np.unique(mixed_vals))
+        t.cleanup()
+
+
+class TestSpillFormat:
+    """The partitioned run format (RUN_MAGIC header + per-partition
+    index + sorted payload) and its compatibility floor."""
+
+    def _spilled(self, tmp_path, partitions=4, vals=None):
+        t = kunique.UniqueTracker(
+            ["c"], 16, 1 << 30, spill_dir=str(tmp_path / "sp"),
+            count_exact=True, partitions=partitions)
+        v = vals if vals is not None \
+            else np.arange(64, dtype=np.uint64) * np.uint64(1 << 56)
+        t.update("c", v)            # past the 16-row budget: spills
+        assert t._runs["c"], "fixture failed to spill"
+        return t
+
+    def test_run_carries_magic_and_partition_index(self, tmp_path):
+        t = self._spilled(tmp_path)
+        path, rows = t._runs["c"][0]
+        raw = open(path, "rb").read()
+        assert raw[:8] == kunique.RUN_MAGIC
+        offset, prefix = t._run_layout(path, rows)
+        assert offset == kunique._RUN_HEAD + 8 * 4
+        assert prefix is not None and int(prefix[-1]) == rows
+        # payload is globally sorted (partition id = top bits)
+        payload = np.frombuffer(raw[offset:], dtype=np.uint64)
+        assert payload.size == rows
+        assert (np.diff(payload.astype(object)) > 0).all()
+        t.cleanup()
+
+    def test_legacy_headerless_run_still_loads(self, tmp_path):
+        """Pre-round-8 artifacts reference raw sorted uint64 runs
+        (exactly rows*8 bytes): they must validate, resolve — sliced
+        by searchsorted — and settle cross-epoch duplicates."""
+        t = kunique.UniqueTracker(
+            ["c"], 1 << 20, 1 << 30, spill_dir=str(tmp_path / "sp"),
+            partitions=16)
+        legacy = tmp_path / "sp"
+        legacy.mkdir()
+        run = np.arange(0, 500, dtype=np.uint64)
+        path = str(legacy / "tpuprof-uniq-deadbeef0001-0.u64")
+        run.tofile(path)                            # old format
+        t._runs["c"].append((path, run.size))
+        assert t._run_layout(path, run.size) == (0, None)
+        t.update("c", np.array([250], dtype=np.uint64))  # dup in run
+        assert t.resolve()["c"] == kunique.DUP
+        t.cleanup()
+
+    def test_foreign_partition_count_still_resolves(self, tmp_path):
+        """A run written at P=4 read back by a P=16 tracker (e.g. a
+        config change across a resume) slices by searchsorted instead
+        of the header index — same answers."""
+        t4 = self._spilled(tmp_path, partitions=4)
+        t4.persistent = True
+        blob = pickle.dumps(t4)
+        t16 = pickle.loads(blob)
+        t16._partitions = 16        # simulate the re-configured reader
+        assert t16.distinct_counts()["c"] == 64
+        assert t16.resolve()["c"] == kunique.UNIQUE
+        t4.cleanup()
+
+
+class TestTruncationSweep:
+    """Every possible truncation of a partitioned run is a typed
+    failure (CorruptRunError) that demotes honestly — never a crash,
+    never a wrong exact claim; a DUP already in evidence survives via
+    the existing demote path."""
+
+    def test_truncate_at_every_offset(self, tmp_path):
+        t = self._tracker(tmp_path)
+        path, rows = t._runs["c"][0]
+        data = open(path, "rb").read()
+        t.persistent = True
+        blob = pickle.dumps(t)
+        assert len(data) < 2000     # keeps the full sweep cheap
+        for cut in range(len(data)):
+            with open(path, "wb") as fh:
+                fh.write(data[:cut])
+            t2 = pickle.loads(blob)
+            assert t2.status["c"] == kunique.OVERFLOW, cut
+            assert t2.resolve()["c"] == kunique.OVERFLOW, cut
+        with open(path, "wb") as fh:    # restore for cleanup
+            fh.write(data)
+        t3 = pickle.loads(blob)
+        assert t3.status["c"] == kunique.UNIQUE
+        t.cleanup()
+
+    def test_bitflip_in_index_detected(self, tmp_path):
+        t = self._tracker(tmp_path)
+        path, rows = t._runs["c"][0]
+        data = bytearray(open(path, "rb").read())
+        data[kunique._RUN_HEAD + 3] ^= 0x40     # flip inside the index
+        with open(path, "wb") as fh:
+            fh.write(bytes(data))
+        with pytest.raises(kunique.CorruptRunError):
+            t._run_layout(path, rows)
+        # the read path demotes instead of trusting the torn index
+        t._resolve_memo.clear()
+        assert t.resolve()["c"] == kunique.OVERFLOW
+        t.cleanup()
+
+    def test_truncation_after_restore_demotes_at_resolve(self, tmp_path):
+        """Rot between restore-time validation and the resolve walk
+        (the artifact validated, then the file was truncated) is caught
+        by the walk itself — honest OVERFLOW, stable across calls."""
+        t = self._tracker(tmp_path)
+        path, rows = t._runs["c"][0]
+        data = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(data[: len(data) // 2])
+        t._resolve_memo.clear()
+        assert t.resolve()["c"] == kunique.OVERFLOW
+        assert t.resolve()["c"] == kunique.OVERFLOW
+
+    def test_dup_in_evidence_survives_truncation(self, tmp_path):
+        t = self._tracker(tmp_path)
+        t.status["c"] = kunique.DUP     # e.g. a merged-in peer verdict
+        path, rows = t._runs["c"][0]
+        data = open(path, "rb").read()
+        t.persistent = True
+        blob = pickle.dumps(t)
+        for cut in (0, 7, len(data) // 2, len(data) - 1):
+            with open(path, "wb") as fh:
+                fh.write(data[:cut])
+            t2 = pickle.loads(blob)
+            assert t2.resolve()["c"] == kunique.DUP, cut
+
+    def _tracker(self, tmp_path):
+        t = kunique.UniqueTracker(
+            ["c"], 16, 1 << 30, spill_dir=str(tmp_path / "sp"),
+            count_exact=True, partitions=4)
+        t.update("c", np.arange(64, dtype=np.uint64) * np.uint64(1 << 56))
+        assert t._runs["c"], "fixture failed to spill"
+        return t
+
+
+# share the spilled-tracker fixture helper
+TestSpillFormat._tracker = TestTruncationSweep._tracker
+TestTruncationSweep._spilled = TestSpillFormat._spilled
+
+
+class TestOverlappedSpillFailure:
+    """A failed overlapped write settles through the SAME demote path
+    a synchronous failure takes: the unwritten values return to the
+    live buffer, the best-effort walk runs, and a DUP in evidence
+    survives — byte-identical demote-on-storage-abort at any width."""
+
+    def _broken_dir_tracker(self, tmp_path, workers):
+        spill = tmp_path / f"file_not_dir_{workers}"
+        spill.write_text("")        # makedirs(spill) will fail forever
+        return kunique.UniqueTracker(
+            ["c"], 16, 1 << 30, spill_dir=str(spill),
+            count_exact=True, partitions=4, spill_workers=workers)
+
+    @pytest.mark.parametrize("workers", [0, 2])
+    def test_unwritable_dir_demotes_unique_to_overflow(self, tmp_path,
+                                                       workers):
+        t = self._broken_dir_tracker(tmp_path, workers)
+        t.update("c", np.arange(64, dtype=np.uint64))   # forces spill
+        t.flush_spills()
+        assert t.status["c"] == kunique.OVERFLOW
+        assert t.distinct_counts() == {}
+
+    @pytest.mark.parametrize("workers", [0, 2])
+    def test_unwritable_dir_keeps_dup_in_evidence(self, tmp_path,
+                                                  workers):
+        t = self._broken_dir_tracker(tmp_path, workers)
+        vals = np.arange(64, dtype=np.uint64)
+        t.update("c", np.concatenate([vals[:2], vals]))  # dup buffered
+        t.flush_spills()
+        assert t.status["c"] == kunique.DUP
+
+    def test_failure_discovered_at_checkpoint_boundary(self, tmp_path):
+        """An overlapped failure surfaces no later than the next
+        persist (pickle drains): the artifact carries the demoted —
+        honest — status, never a reference to a run that never hit
+        disk."""
+        t = self._broken_dir_tracker(tmp_path, workers=4)
+        t.update("c", np.arange(64, dtype=np.uint64))
+        blob = pickle.dumps(t)      # drain happens here
+        t2 = pickle.loads(blob)
+        assert t2.status["c"] == kunique.OVERFLOW
+        assert t2._runs["c"] == []
+
+
+class TestPartitionedResume:
+    """Partitioned trackers round-trip through the checkpoint/resume
+    and merge laws byte-identically."""
+
+    def test_streaming_resume_identical_stats(self, tmp_path):
+        """Checkpoint mid-stream with the partitioned/overlapped
+        defaults, 'crash', restore, finish: stats identical to the
+        uninterrupted stream (resume byte-identity satellite)."""
+        import pyarrow as pa
+
+        from tpuprof.runtime.stream import StreamingProfiler
+
+        def batches():
+            rng = np.random.default_rng(5)
+            return [pd.DataFrame(
+                {"d": [f"v{i:05d}" for i in rng.integers(0, 2000, 512)]})
+                for _ in range(8)]
+
+        cfg = ProfilerConfig(batch_rows=512, topk_capacity=64,
+                             unique_track_rows=600,
+                             unique_spill_dir=str(tmp_path / "sp"),
+                             exact_distinct=True,
+                             unique_partitions=8, unique_spill_workers=4)
+        bs = batches()
+        with StreamingProfiler(pa.schema([("d", pa.string())]),
+                               cfg) as prof:
+            for b in bs:
+                prof.update(b)
+            uninterrupted = prof.stats()["variables"]["d"]
+
+        ckpt = str(tmp_path / "s.ckpt")
+        prof2 = StreamingProfiler(pa.schema([("d", pa.string())]), cfg)
+        for b in bs[:5]:
+            prof2.update(b)
+        prof2.checkpoint(ckpt)
+        # "crash": drop without close — the checkpoint references runs
+        del prof2
+        restored = StreamingProfiler.restore(ckpt, cfg)
+        for b in bs[5:]:
+            restored.update(b)
+        resumed = restored.stats()["variables"]["d"]
+        restored.close()
+        assert resumed == uninterrupted
+        assert resumed["distinct_approx"] is False
+
+    def test_merge_across_partition_counts(self, tmp_path):
+        """Peers configured with different partition counts still merge
+        to the exact union (runs are self-describing; live buffers fold
+        through update)."""
+        rng = np.random.default_rng(8)
+        a_vals = rng.integers(0, 2000, 3000).astype(np.uint64)
+        b_vals = rng.integers(1000, 4000, 3000).astype(np.uint64)
+        a = kunique.UniqueTracker(["c"], 400, 1 << 30,
+                                  spill_dir=str(tmp_path / "sa"),
+                                  count_exact=True, partitions=16)
+        b = kunique.UniqueTracker(["c"], 400, 1 << 30,
+                                  spill_dir=str(tmp_path / "sb"),
+                                  count_exact=True, partitions=2,
+                                  spill_workers=2)
+        _feed(a, a_vals)
+        _feed(b, b_vals)
+        a.merge(b)
+        truth = len(np.unique(np.concatenate([a_vals, b_vals])))
+        assert a.distinct_counts()["c"] == truth
+        assert a.resolve()["c"] == kunique.DUP
+        a.cleanup()
+        b.cleanup()
+
+
+class TestEndToEndParity:
+    """Backend-level: the same profile at the two extremes of the
+    (partitions, spill-workers) grid produces identical stats."""
+
+    def test_collect_identical_across_settings(self, tmp_path):
+        import re
+
+        from tpuprof import ProfileReport
+
+        rng = np.random.default_rng(9)
+        n = 3000
+        df = pd.DataFrame({
+            "d": [f"v{i:05d}" for i in rng.integers(0, 1200, n)],
+            "u": [f"id{i:06d}" for i in range(n)],
+            "x": rng.normal(size=n).round(2)})
+
+        def profile(p, w):
+            cfg = ProfilerConfig(
+                backend="tpu", batch_rows=512, topk_capacity=64,
+                unique_track_rows=400,
+                unique_spill_dir=str(tmp_path / f"sp{p}_{w}"),
+                exact_distinct=True,
+                unique_partitions=p, unique_spill_workers=w)
+            r = ProfileReport(df, config=cfg)
+            # the footer's perf line is wall-clock (rows/s + phase
+            # seconds) and differs between ANY two runs of the same
+            # code — mask it; every other byte must match
+            html = re.sub(r"[\d,]+ rows/s[^\n<]*", "PERF", r.html)
+            return r.to_json_dict(), html
+
+        base_json, base_html = profile(1, 1)
+        wide_json, wide_html = profile(16, 8)
+        assert base_json == wide_json
+        assert "PERF" in base_html          # the mask actually bit
+        assert base_html == wide_html       # the acceptance bar: bytes
+        vd = base_json["variables"]["d"]
+        assert vd["distinct_count"] == df["d"].nunique()
+        assert vd["distinct_approx"] is False
+        assert base_json["variables"]["u"]["type"] == str(schema.UNIQUE)
+
+
+class TestBudgetResolution:
+    """resolve_unique_budget: explicit / env / 'auto' (RAM-derived,
+    floored and capped) — the satellite's env/CLI/config round trip."""
+
+    def test_explicit_int_wins(self, monkeypatch):
+        from tpuprof.config import resolve_unique_budget
+        monkeypatch.setenv("TPUPROF_UNIQUE_TRACK_TOTAL_ROWS", "999")
+        assert resolve_unique_budget(1 << 20) == 1 << 20
+
+    def test_default_unchanged(self, monkeypatch):
+        from tpuprof.config import (UNIQUE_BUDGET_DEFAULT_ROWS,
+                                    resolve_unique_budget)
+        monkeypatch.delenv("TPUPROF_UNIQUE_TRACK_TOTAL_ROWS",
+                           raising=False)
+        assert resolve_unique_budget(None) == UNIQUE_BUDGET_DEFAULT_ROWS \
+            == 1 << 25
+
+    def test_env_int_and_auto(self, monkeypatch):
+        from tpuprof.config import resolve_unique_budget
+        monkeypatch.setenv("TPUPROF_UNIQUE_TRACK_TOTAL_ROWS", "123456")
+        assert resolve_unique_budget(None) == 123456
+        monkeypatch.setenv("TPUPROF_UNIQUE_TRACK_TOTAL_ROWS", "auto")
+        v = resolve_unique_budget(None)
+        assert (1 << 25) <= v <= (1 << 28)
+
+    def test_auto_floor_and_cap(self):
+        from tpuprof.config import resolve_unique_budget
+        # a tiny box floors at the historical default (never tracks
+        # LESS than the fixed default did) ...
+        assert resolve_unique_budget(
+            "auto", available_bytes=1 << 20) == 1 << 25
+        # ... and a huge box caps at 2 GB of buffers
+        assert resolve_unique_budget(
+            "auto", available_bytes=1 << 40) == 1 << 28
+        # in between: a quarter of available RAM at 8 B/row
+        assert resolve_unique_budget(
+            "auto", available_bytes=4 << 30) == (4 << 30) // 4 // 8
+
+    def test_config_accepts_auto_and_rejects_junk(self, tmp_path):
+        cfg = ProfilerConfig(unique_track_total_rows="auto",
+                             exact_distinct=True,
+                             unique_spill_dir=str(tmp_path))
+        assert cfg.unique_track_total_rows == "auto"
+        with pytest.raises(ValueError, match="unique_track_total_rows"):
+            ProfilerConfig(unique_track_total_rows="lots")
+
+    def test_disabled_budget_message_names_auto(self, tmp_path):
+        """The validation message must teach the remedy (satellite: it
+        used to name only the two row knobs)."""
+        with pytest.raises(ValueError, match="auto"):
+            ProfilerConfig(exact_distinct=True,
+                           unique_spill_dir=str(tmp_path),
+                           unique_track_total_rows=0)
+
+    def test_partitions_and_workers_resolution(self, monkeypatch):
+        from tpuprof.config import (resolve_spill_workers,
+                                    resolve_unique_partitions)
+        monkeypatch.delenv("TPUPROF_UNIQUE_PARTITIONS", raising=False)
+        monkeypatch.delenv("TPUPROF_UNIQUE_SPILL_WORKERS", raising=False)
+        assert resolve_unique_partitions(None) == 16
+        assert resolve_spill_workers(None) == 2
+        monkeypatch.setenv("TPUPROF_UNIQUE_PARTITIONS", "4")
+        monkeypatch.setenv("TPUPROF_UNIQUE_SPILL_WORKERS", "0")
+        assert resolve_unique_partitions(None) == 4
+        assert resolve_spill_workers(None) == 0
+        with pytest.raises(ValueError, match="power of two"):
+            resolve_unique_partitions(6)
+        with pytest.raises(ValueError, match="power of two"):
+            ProfilerConfig(unique_partitions=12)

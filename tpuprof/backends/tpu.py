@@ -85,14 +85,26 @@ class HostAgg:
         # so the reference's countDistinct exactness holds with no HLL
         # estimate anywhere, not just for string/categorical columns
         # opaque nested columns have no hash stream — nothing to track
+        from tpuprof.config import (resolve_spill_workers,
+                                    resolve_unique_budget,
+                                    resolve_unique_partitions)
         self.unique = UniqueTracker(
             (s.name for s in (plan.specs if config.exact_distinct
                               else plan.by_role("cat"))
              if not s.opaque),
-            config.unique_track_rows, config.unique_track_total_rows,
+            config.unique_track_rows,
+            # int / "auto" (RAM-derived) / None (env, else the
+            # historical 1<<25) — resolved once, here, so the tracker
+            # and every budget check agree on one number
+            resolve_unique_budget(
+                getattr(config, "unique_track_total_rows", None)),
             spill_dir=config.unique_spill_dir,
             count_exact=config.exact_distinct,
-            own_spill_dir=getattr(config, "spill_dir_auto", False))
+            own_spill_dir=getattr(config, "spill_dir_auto", False),
+            partitions=resolve_unique_partitions(
+                getattr(config, "unique_partitions", None)),
+            spill_workers=resolve_spill_workers(
+                getattr(config, "unique_spill_workers", None)))
         # num/date columns whose exact counting expects full hashes on
         # every batch (coverage gap => honest deactivation)
         self._numdate_tracked = [s.name for s in plan.specs
@@ -187,8 +199,18 @@ class HostAgg:
                 self.unique.deactivate(name)
                 continue
             h, valid = pair
-            h, valid = h[: hb.nrows], valid[: hb.nrows]
-            self.unique.update(name, h[valid], hash_kind=self._numkind)
+            if valid is None:
+                # prepare_batch pre-masked the stream on the prep pool
+                # (ingest/arrow.py): the array is owned and valid-only —
+                # the fold thread hands it to the tracker with no mask
+                # pass and no copy (the all-valid wide-numeric case);
+                # never re-slice: rows below nrows mean nulls were
+                # already dropped
+                hv = h
+            else:
+                h, valid = h[: hb.nrows], valid[: hb.nrows]
+                hv = h if valid.all() else h[valid]
+            self.unique.update(name, hv, hash_kind=self._numkind)
 
     def memorysize(self, name: str) -> float:
         """Arrow buffer bytes for one column (NaN if never observed)."""

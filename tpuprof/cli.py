@@ -117,6 +117,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-column RAM budget (rows) for exact "
                         "UNIQUE/distinct tracking before spilling "
                         "(default: 4M rows = ~32 MB/column)")
+    p.add_argument("--unique-track-total-rows", default=None,
+                   metavar="N|auto",
+                   help="global RAM budget (rows across all columns) "
+                        "for exact tracking; 'auto' derives it from "
+                        "available RAM (quarter of MemAvailable at "
+                        "8 B/row, capped at 2 GB) — the measured "
+                        "RAM/speed lever for wide exact-distinct "
+                        "shapes (default: "
+                        "TPUPROF_UNIQUE_TRACK_TOTAL_ROWS, else 32M "
+                        "rows = ~256 MB)")
+    p.add_argument("--unique-partitions", type=int, default=None,
+                   metavar="P",
+                   help="hash partitions of the exact tracker (power "
+                        "of two in [1, 256]; results identical at "
+                        "every count — this sizes sort/resolve working "
+                        "sets, default: TPUPROF_UNIQUE_PARTITIONS, "
+                        "else 16)")
+    p.add_argument("--unique-spill-workers", type=int, default=None,
+                   metavar="W",
+                   help="unique-spill run writes in flight on the "
+                        "shared io pool while the scan keeps folding "
+                        "(0 = synchronous writes; byte-identical "
+                        "output at any width; default: "
+                        "TPUPROF_UNIQUE_SPILL_WORKERS, else 2)")
     p.add_argument("--exact-distinct", action="store_true",
                    help="count distincts exactly for every column at any "
                         "size (needs --unique-spill-dir; 8 bytes per "
@@ -383,6 +407,9 @@ def cmd_profile(args: argparse.Namespace) -> int:
             exact_distinct=args.exact_distinct, parity=args.parity,
             **({"unique_track_rows": args.unique_track_rows}
                if args.unique_track_rows is not None else {}),
+            unique_track_total_rows=args.unique_track_total_rows,
+            unique_partitions=args.unique_partitions,
+            unique_spill_workers=args.unique_spill_workers,
             checkpoint_path=args.checkpoint,
             checkpoint_every_batches=args.checkpoint_every,
             checkpoint_keep=args.checkpoint_keep,

@@ -58,6 +58,92 @@ def _env_float(var: str) -> Optional[float]:
     return float(env) if env not in (None, "") else None
 
 
+def _available_ram_bytes() -> int:
+    """Best-effort available host RAM: /proc/meminfo MemAvailable (what
+    the kernel would actually hand out without swapping), else the
+    sysconf physical-page estimate, else a conservative 2 GB."""
+    try:
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_AVPHYS_PAGES")
+    except (AttributeError, ValueError, OSError):
+        return 2 << 30
+
+
+# resolve_unique_budget's "auto" sizing: a quarter of available RAM at
+# 8 B/row, floored at the historical fixed default (auto must never
+# track LESS than the default did) and capped at 2 GB of buffers (the
+# tracker is one tenant of the host, not the whole of it)
+UNIQUE_BUDGET_DEFAULT_ROWS = 1 << 25
+UNIQUE_BUDGET_RAM_SHARE = 0.25
+UNIQUE_BUDGET_CAP_ROWS = 1 << 28
+
+
+def resolve_unique_budget(value=None, available_bytes: Optional[int] = None
+                          ) -> int:
+    """Global exact-unique tracking budget (rows across all columns —
+    kernels/unique.py): an explicit int wins; ``"auto"`` derives from
+    available RAM (``UNIQUE_BUDGET_RAM_SHARE`` of MemAvailable at
+    8 B/row, floor ``UNIQUE_BUDGET_DEFAULT_ROWS``, cap
+    ``UNIQUE_BUDGET_CAP_ROWS``); ``None`` = the
+    ``TPUPROF_UNIQUE_TRACK_TOTAL_ROWS`` env (an int or ``auto``), else
+    the historical ``1 << 25`` — defaults stay byte-identical.  Round-5
+    measurement behind "auto": raising this budget 32M→128M rows alone
+    cut the wide-shape exact-distinct e2e 5.2 s→3.4 s by eliminating
+    spill churn (PERF.md)."""
+    if value is None:
+        env = os.environ.get("TPUPROF_UNIQUE_TRACK_TOTAL_ROWS")
+        value = env if env not in (None, "") else UNIQUE_BUDGET_DEFAULT_ROWS
+    if isinstance(value, str):
+        v = value.strip().lower()
+        if v != "auto":
+            return int(v)
+        avail = available_bytes if available_bytes is not None \
+            else _available_ram_bytes()
+        rows = int(avail * UNIQUE_BUDGET_RAM_SHARE) // 8
+        return max(UNIQUE_BUDGET_DEFAULT_ROWS,
+                   min(rows, UNIQUE_BUDGET_CAP_ROWS))
+    return int(value)
+
+
+def resolve_unique_partitions(value: Optional[int] = None) -> int:
+    """Hash-partition count of the exact-unique tracker (the radix
+    scatter's fan-out — kernels/unique.py): an explicit config value
+    wins; else ``TPUPROF_UNIQUE_PARTITIONS``; else 16.  Must be a power
+    of two in [1, 256] (the partition id is the hash's top bits).
+    Results are identical at every count — this selects sort/resolve
+    working-set size, not answers; 1 restores the unpartitioned
+    (pre-round-8) layout."""
+    if value is None:
+        env = _env_int("TPUPROF_UNIQUE_PARTITIONS")
+        value = env if env is not None else 16
+    p = int(value)
+    if p < 1 or p > 256 or (p & (p - 1)):
+        raise ValueError(
+            f"unique_partitions={value!r} — use a power of two in "
+            "[1, 256] (the partition id is the hash's top bits)")
+    return p
+
+
+def resolve_spill_workers(value: Optional[int] = None) -> int:
+    """Overlapped unique-spill writes: how many run-file ``tofile``
+    writes may be in flight on the shared io tier (ingest/prep.py)
+    while the scan keeps folding.  An explicit config value wins; else
+    ``TPUPROF_UNIQUE_SPILL_WORKERS``; else 2 — spill writes wait on
+    disk, not the GIL, so the overlap helps even on one core.  0 writes
+    synchronously on the fold thread (the pre-round-8 behavior);
+    results are byte-identical at any width."""
+    if value is not None:
+        return max(int(value), 0)
+    env = _env_int("TPUPROF_UNIQUE_SPILL_WORKERS")
+    return max(env, 0) if env is not None else 2
+
+
 def resolve_ingest_retries(value: Optional[int] = None) -> int:
     """Retry budget for transient per-batch prep failures (ROBUSTNESS.md):
     an explicit config value wins; else ``TPUPROF_INGEST_RETRIES``; else
@@ -288,8 +374,44 @@ class ProfilerConfig:
                                             # HLL estimate (~32 MB/column held
                                             # only while a column stays
                                             # duplicate-free).  0 disables.
-    unique_track_total_rows: int = 1 << 25  # global cap across all columns
-                                            # (~256 MB worst case)
+    unique_track_total_rows: Optional[object] = None
+                                            # global cap across all
+                                            # columns, in rows (8 B
+                                            # each).  None = auto:
+                                            # TPUPROF_UNIQUE_TRACK_
+                                            # TOTAL_ROWS env (int or
+                                            # "auto"), else 1 << 25
+                                            # (~256 MB worst case — the
+                                            # historical default).
+                                            # "auto" derives the budget
+                                            # from available RAM
+                                            # (resolve_unique_budget:
+                                            # quarter of MemAvailable,
+                                            # floor = the default, cap
+                                            # 2 GB) — the measured
+                                            # RAM/speed lever for wide
+                                            # exact-distinct shapes
+                                            # (PERF.md round 8)
+    unique_partitions: Optional[int] = None  # hash partitions of the
+                                             # exact tracker (radix
+                                             # scatter by top bits —
+                                             # kernels/unique.py).
+                                             # Power of two in [1,
+                                             # 256]; results identical
+                                             # at every count.  None =
+                                             # auto: TPUPROF_UNIQUE_
+                                             # PARTITIONS env, else 16
+    unique_spill_workers: Optional[int] = None  # spill-run writes in
+                                                # flight on the shared
+                                                # io tier while the
+                                                # scan keeps folding
+                                                # (0 = synchronous on
+                                                # the fold thread).
+                                                # None = auto: TPUPROF_
+                                                # UNIQUE_SPILL_WORKERS
+                                                # env, else 2.  Byte-
+                                                # identical at any
+                                                # width
     unique_spill_dir: Optional[str] = None  # when set, columns exceeding
                                             # the budgets spill sorted
                                             # hash runs here (8 B/row)
@@ -633,12 +755,35 @@ class ProfilerConfig:
                 "exact counting stores 8 bytes per distinct value per "
                 "column, which must be able to spill past the RAM "
                 "budget")
-        if self.exact_distinct and (self.unique_track_rows <= 0
-                                    or self.unique_track_total_rows <= 0):
+        if isinstance(self.unique_track_total_rows, str):
+            v = self.unique_track_total_rows.strip().lower()
+            if v != "auto":
+                try:
+                    int(v)
+                except ValueError:
+                    raise ValueError(
+                        "unique_track_total_rows must be an int, "
+                        "'auto' (derive the budget from available "
+                        "RAM), or None (env/default resolution) — got "
+                        f"{self.unique_track_total_rows!r}") from None
+        if self.unique_partitions is not None:
+            resolve_unique_partitions(self.unique_partitions)  # raises
+        if self.unique_spill_workers is not None \
+                and self.unique_spill_workers < 0:
+            raise ValueError("unique_spill_workers must be >= 0 "
+                             "(0 = synchronous spill writes; or None)")
+        if self.exact_distinct and (
+                self.unique_track_rows <= 0
+                or resolve_unique_budget(self.unique_track_total_rows)
+                <= 0):
             raise ValueError(
                 "exact_distinct conflicts with a disabled tracking "
                 "budget (unique_track_rows/unique_track_total_rows "
-                "<= 0): exact counting needs the in-memory tier")
+                "<= 0): exact counting needs the in-memory tier.  Set "
+                "the row knobs positive, or "
+                "unique_track_total_rows='auto' (CLI: "
+                "--unique-track-total-rows auto) to size the global "
+                "budget from available RAM")
         if not 0.0 < self.corr_reject <= 1.0:
             raise ValueError("corr_reject must be in (0, 1]")
         if not 2 <= self.spearman_grid <= 4096:

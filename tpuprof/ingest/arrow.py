@@ -167,10 +167,13 @@ class HostBatch:
     # way have NO cat_codes entry for the batch.
     cat_hashed: Optional[Dict[str, Tuple]] = None
     # full 64-bit hashes of numeric/date lanes, name -> (hashes u64,
-    # valid bool), produced only when the batch was prepared with
+    # valid), produced only when the batch was prepared with
     # full_hashes=True (config.exact_distinct): the HLL plane packs
     # hashes down to 16 bits, so exact distinct counting of num/date
-    # columns needs the unpacked stream retained
+    # columns needs the unpacked stream retained.  valid=None means the
+    # hash array was already compacted to valid rows on the prep pool
+    # (owned, exact length — consumers feed it to the tracker as-is);
+    # a bool mask is the pre-round-8 form consumers still accept
     num_hashes: Optional[Dict[str, Tuple[np.ndarray, np.ndarray]]] = None
     # per-batch null counts of opaque nested columns (config.nested=
     # "opaque"): the ONLY statistic prepared for them — no decode
@@ -298,13 +301,25 @@ def _fill_num_rows(arr: pa.Array, spec: "ColumnSpec", x: np.ndarray,
         _NUM_PATHS.inc(path="slow")
     if hashes:
         keys = _num_keys(vals)
-        hll_packed[lo:hi, spec.hash_lane] = _packed_obs(
-            keys, valid, hll_precision)
         if nh is not None:
             # exact distinct counting needs the unpacked 64-bit stream
-            # (the HLL plane keeps only 16 packed bits)
-            nh[0][lo:hi] = _hash64(keys)
+            # (the HLL plane keeps only 16 packed bits).  The fused
+            # keep variant hashes ONCE, writing the full stream
+            # straight into the preallocated plane slice and returning
+            # the packed observations — the separate _hash64 pass plus
+            # its 8-byte/row copy was ~40% of the full-hash prep delta
+            # at the wide shape (PERF.md round 8)
+            from tpuprof import native
+            packed = native.hash_pack_keep_u64(
+                keys, valid, hll_precision, nh[0][lo:hi])
+            if packed is None:          # no native: two-pass fallback
+                packed = _packed_obs(keys, valid, hll_precision)
+                nh[0][lo:hi] = _hash64(keys)
+            hll_packed[lo:hi, spec.hash_lane] = packed
             nh[1][lo:hi] = valid
+        else:
+            hll_packed[lo:hi, spec.hash_lane] = _packed_obs(
+                keys, valid, hll_precision)
     return valid
 
 
@@ -633,6 +648,19 @@ def prepare_batch(batch: pa.RecordBatch, plan: ColumnPlan,
         else:
             tasks.append(lambda i=i, spec=spec: decode_column(i, spec))
     prep.run_tasks(tasks, workers)
+
+    if num_hashes:
+        # tracker-feed compaction on the PREP side (this runs on the
+        # batch pool under prefetch_prepared, overlapped with device
+        # folds), not on the ordered fold thread: hand the exact-unique
+        # tracker an OWNED, valid-only hash array.  All-valid lanes —
+        # the wide-numeric common case — pass the filled plane itself,
+        # so the fold thread appends with zero copies and zero mask
+        # passes (kernels/unique.py owns the array from here on; the
+        # None sentinel in the valid slot means "already masked").
+        for cname, (harr, hvalid) in list(num_hashes.items()):
+            num_hashes[cname] = (
+                harr if hvalid.all() else harr[hvalid], None)
 
     if _t0 is not None:
         _ROWS_INGESTED.inc(n)

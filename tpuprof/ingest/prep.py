@@ -1,6 +1,6 @@
 """Shared thread pools for parallel host-side batch prep.
 
-Two tiers, two pools, no nesting:
+Three tiers, three pools, no nesting:
 
 * the **column pool** runs the intra-batch leaf tasks of
   ``prepare_batch`` — per-column decode/hash/pack, and per-row-chunk
@@ -11,12 +11,20 @@ Two tiers, two pools, no nesting:
   cross-batch pipelines (``prefetch_prepared``, the streaming drain).
   Batch tasks DO fan out — onto the column pool, never onto their own —
   so the two tiers form a DAG and cannot wait on themselves.
+* the **io pool** runs background disk writes — today the exact-unique
+  tracker's spill-run ``tofile`` (kernels/unique.py), ~800 MB at the
+  wide exact-distinct shape — so they hide under the device scan and
+  the next batch's prepare instead of stalling the fold thread.  IO
+  tasks are leaves (they never submit work) and wait on disk, not the
+  GIL, so the tier helps even on a one-core host.  Callers bound their
+  own in-flight window and settle futures in order, mirroring the
+  ordered batch pipeline.
 
-Both pools are process-wide and lazily built: spawning threads per batch
+All pools are process-wide and lazily built: spawning threads per batch
 costs more than the work they'd overlap at small shapes, and the hot
-paths (Arrow decode, numpy casts/copies, the native xxh64 hash+pack)
-all release the GIL, so one shared pool keeps the host's cores busy
-without thread thrash.
+paths (Arrow decode, numpy casts/copies, the native xxh64 hash+pack,
+``ndarray.tofile``) all release the GIL, so shared pools keep the
+host's cores busy without thread thrash.
 """
 
 from __future__ import annotations
@@ -38,17 +46,23 @@ _PREP_TASKS = metrics.counter(
 _BATCH_TASKS = metrics.counter(
     "tpuprof_prep_batch_tasks_total",
     "whole-batch prepares run through the ordered cross-batch pipeline")
+_IO_TASKS = metrics.counter(
+    "tpuprof_prep_io_tasks_total",
+    "background disk-write tasks (unique-spill runs) run on the io tier")
 _COL_POOL: Optional[ThreadPoolExecutor] = None
 _COL_WORKERS = 0
 _BATCH_POOL: Optional[ThreadPoolExecutor] = None
 _BATCH_WORKERS = 0
+_IO_POOL: Optional[ThreadPoolExecutor] = None
+_IO_WORKERS = 0
 
 
 def _shared(kind: str, workers: int) -> ThreadPoolExecutor:
     """The shared pool of one tier, grown (never shrunk) to ``workers``.
     A replaced pool drains its queued tasks before dying — futures from
     it stay valid, so a grow mid-pipeline loses nothing."""
-    global _COL_POOL, _COL_WORKERS, _BATCH_POOL, _BATCH_WORKERS
+    global _COL_POOL, _COL_WORKERS, _BATCH_POOL, _BATCH_WORKERS, \
+        _IO_POOL, _IO_WORKERS
     with _LOCK:
         if kind == "col":
             if _COL_POOL is None or _COL_WORKERS < workers:
@@ -56,11 +70,32 @@ def _shared(kind: str, workers: int) -> ThreadPoolExecutor:
                     max_workers=workers, thread_name_prefix="tpuprof-col")
                 _COL_WORKERS = workers
             return _COL_POOL
+        if kind == "io":
+            if _IO_POOL is None or _IO_WORKERS < workers:
+                _IO_POOL = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="tpuprof-io")
+                _IO_WORKERS = workers
+            return _IO_POOL
         if _BATCH_POOL is None or _BATCH_WORKERS < workers:
             _BATCH_POOL = ThreadPoolExecutor(
                 max_workers=workers, thread_name_prefix="tpuprof-batch")
             _BATCH_WORKERS = workers
         return _BATCH_POOL
+
+
+def submit_io(fn: Callable[[], object], workers: int):
+    """Queue one background disk-write leaf task on the io tier and
+    return its Future.  The caller owns completion policy: bound the
+    in-flight window, settle futures oldest-first (in-order completion,
+    like ``ordered_map``), and translate a raised OSError into its own
+    failure semantics — the pool never swallows one."""
+
+    def _counted():
+        out = fn()
+        _IO_TASKS.inc(worker=threading.current_thread().name)
+        return out
+
+    return _shared("io", max(int(workers), 1)).submit(_counted)
 
 
 def run_tasks(tasks: Sequence[Callable[[], None]], workers: int) -> None:

@@ -44,14 +44,29 @@ merge then finds cross-host duplicates by the same law as cross-epoch
 ones, so exact UNIQUE/DUP survives multi-host at any n.  A peer whose
 spill disk is NOT visible here arrives already demoted to OVERFLOW (the
 honest bound when runs are unreachable).
+
+Round 8 (hash partitioning + overlapped spill — ISSUE 8): the tracker
+routes every hash to one of P partitions by its TOP bits, so each
+sort/dedup/spill/resolve operates on a cache-sized partition and
+partitions never cross-merge at resolve (P independent unions replace
+the global k-way hash-range walk).  Spill runs carry a partition-index
+header (RUN_MAGIC) and their writes overlap the scan on the shared io
+tier (ingest/prep.py) — distinct counts, UNIQUE/DUP claims and the
+demote-on-storage-abort behavior are byte-identical at every partition
+and worker count; pre-round-8 headerless runs keep loading.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterable, List, Optional, Tuple
+import struct
+import zlib
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from tpuprof.obs import events as _events
+from tpuprof.obs import metrics as _metrics
 
 UNIQUE = "unique"       # no duplicate among all rows seen so far (exact)
 DUP = "dup"             # at least one duplicate seen (exact)
@@ -60,6 +75,40 @@ OVERFLOW = "overflow"   # gave up within budget — distinct is approximate
 # resolve() merges spilled runs in hash-range slices of at most this
 # many rows (128 MB of uint64) — RAM stays bounded at any total n
 RESOLVE_SLICE_ROWS = 1 << 24
+
+# Partitioned spill-run format (round 8): an 8-byte magic, the writer's
+# partition count P and a CRC32 of the partition index (uint32 each),
+# then P uint64 per-partition row counts, then the payload — each
+# partition's sorted dup-free uint64 values in ascending partition
+# order.  The partition id is the hash's TOP bits, so the payload is
+# ALSO one globally-sorted array: a reader with a different partition
+# count (or a pre-round-8 headerless run, recognized by its exact
+# rows*8 size) slices it by searchsorted instead of the index.  Any
+# truncation or bit-flip fails the size/CRC checks as CorruptRunError.
+RUN_MAGIC = b"TPUQRUN2"
+_RUN_HEAD = len(RUN_MAGIC) + 8          # magic + <II (P, crc32(index))
+
+_SPILL_BYTES = _metrics.counter(
+    "tpuprof_unique_spill_bytes_total",
+    "bytes of sorted hash runs written by the exact-unique tracker")
+_SPILL_SECONDS = _metrics.histogram(
+    "tpuprof_unique_spill_seconds",
+    "wall time per spill-run write (header + tofile), wherever it ran")
+_PARTITIONS_G = _metrics.gauge(
+    "tpuprof_unique_partitions",
+    "hash partitions the exact-unique tracker scatters into")
+_SPILL_PENDING_G = _metrics.gauge(
+    "tpuprof_unique_spill_pending",
+    "spill writes queued on the io tier, not yet durable")
+
+
+class CorruptRunError(ValueError):
+    """A spill-run file failed integrity validation: truncated header
+    or payload, partition-index CRC mismatch, or a row count that
+    disagrees with the tracker's record.  Never escapes the tracker —
+    every read path treats it exactly like a vanished run: the column
+    demotes to the honest OVERFLOW (a DUP already in evidence
+    survives), so a torn run can cost exactness but never correctness."""
 
 # cleanup() reclaims OTHER tokens' spill files only past this age: a
 # crashed chain's post-checkpoint orphans (which no artifact references)
@@ -95,10 +144,29 @@ class UniqueTracker:
                  total_budget_rows: int,
                  spill_dir: Optional[str] = None,
                  count_exact: bool = False,
-                 own_spill_dir: bool = False):
+                 own_spill_dir: bool = False,
+                 partitions: int = 1,
+                 spill_workers: int = 0):
         self.budget = int(budget_rows)
         self.total_budget = int(total_budget_rows)
         self.spill_dir = spill_dir
+        p = int(partitions)
+        if p < 1 or (p & (p - 1)):
+            raise ValueError(
+                f"partitions must be a power of two >= 1, got {partitions}")
+        # every sort/dedup/spill/resolve operates per partition (the
+        # hash's top log2(P) bits), so working sets stay cache-sized
+        # and partitions never cross-merge — results are identical at
+        # every count (a value's partition is a function of the value)
+        self._partitions = p
+        _PARTITIONS_G.set(p)
+        # spill writes in flight on the shared io tier (ingest/prep.py);
+        # 0 = write synchronously on the caller's thread.  Queued runs
+        # publish into _runs at SUBMIT time (deterministic order at any
+        # width); every read/persist path drains first (_drain_spills)
+        self._spill_workers = max(int(spill_workers), 0)
+        self._spill_pending: List[Tuple] = []   # (future, name, path,
+        self._draining = False                  #  rows, parts)
         # True when the DIRECTORY was auto-derived for this profile
         # (config.parity), not user-chosen: cleanup may remove it, not
         # just the run files — a user's (possibly shared) dir is never
@@ -182,6 +250,7 @@ class UniqueTracker:
         The lazy tier settles claims only at resolve, so an abort pays
         one best-effort walk over what is buffered/spilled: a duplicate
         found there is final regardless of the lost future coverage."""
+        self._drain_spills()    # settle queued runs before walking them
         if status == OVERFLOW and self._counting.get(name, False) \
                 and self.status.get(name) == UNIQUE \
                 and (self._chunks.get(name) or self._runs.get(name)):
@@ -286,69 +355,234 @@ class UniqueTracker:
         self._next_compact[name] = self.budget
         return bool(self.spill_dir and self._spill(name, merged=u))
 
-    def _compact_buffer(self, name: str) -> Optional[np.ndarray]:
-        """np.unique the live buffer into ONE sorted dup-free chunk,
-        maintaining the _rows/_live/_clean/_next_compact bookkeeping —
-        the single home for this bookkeeping (compaction, the canonical
-        memo key, and spill staging all route through here)."""
+    def _pshift(self) -> np.uint64:
+        """Right-shift that maps a hash to its partition id (top bits)."""
+        return np.uint64(64 - (self._partitions.bit_length() - 1))
+
+    def _partition_unique(self, h: np.ndarray) -> List[np.ndarray]:
+        """Radix-scatter ``h`` by its top bits, then np.unique each
+        partition — the canonical compacted form: a list of sorted
+        dup-free arrays in ascending partition order, whose
+        concatenation is globally sorted (the partition id IS the
+        hash's top bits).
+
+        Implementation note (measured, PERF.md round 8): because the
+        partition id is the value's TOP bits, sorting the values IS the
+        radix scatter — the sort's leading comparisons group by
+        partition — and one in-place sort + dedup + boundary split beat
+        an explicit pid-argsort scatter followed by per-partition sorts
+        at every buffer size this box could hold (the explicit scatter
+        re-pays its gather).  The partition structure materializes as
+        zero-copy views of the sorted buffer."""
+        if h.size == 0:
+            return [h]
+        # h is the caller's freshly-concatenated (owned, private)
+        # buffer: sort in place — np.unique would sort a COPY
+        h.sort()
+        keep = np.empty(h.size, dtype=bool)
+        keep[0] = True
+        np.not_equal(h[1:], h[:-1], out=keep[1:])
+        u = h if bool(keep.all()) else h[keep]
+        return self._split_sorted(u)
+
+    def _split_sorted(self, u: np.ndarray) -> List[np.ndarray]:
+        """Partition views of an already globally-sorted dup-free array
+        (partition boundaries are just searchsorted probes)."""
+        P = self._partitions
+        if P == 1 or u.size == 0:
+            return [u]
+        step = (1 << 64) // P
+        cuts = np.searchsorted(
+            u, np.arange(1, P, dtype=np.uint64) * np.uint64(step))
+        return [part for part in np.split(u, cuts) if part.size]
+
+    def _compact_buffer(self, name: str) -> Optional[List[np.ndarray]]:
+        """Dedup the live buffer into the canonical partitioned form
+        (sorted dup-free arrays, ascending partition order — see
+        ``_partition_unique``), maintaining the _rows/_live/_clean/
+        _next_compact bookkeeping — the single home for this
+        bookkeeping (compaction, the canonical memo key, and spill
+        staging all route through here)."""
         chunks = self._chunks.get(name) or []
         if not chunks:
             return None
-        if len(chunks) == 1 and name in self._clean:
-            return chunks[0]
-        u = np.unique(np.concatenate(chunks))
-        self._live -= self._rows[name] - int(u.size)
-        self._rows[name] = int(u.size)
-        self._chunks[name] = [u]
-        self._next_compact[name] = int(u.size) + \
+        if name in self._clean:
+            return chunks
+        parts = self._partition_unique(np.concatenate(chunks))
+        size = sum(int(p.size) for p in parts)
+        self._live -= self._rows[name] - size
+        self._rows[name] = size
+        self._chunks[name] = parts
+        self._next_compact[name] = size + \
             max(self.budget // 2, 1)
         self._clean.add(name)
-        return u
+        return parts
 
     def _spill(self, name: str,
-               merged: Optional[np.ndarray] = None) -> bool:
+               merged: Optional[Sequence[np.ndarray]] = None) -> bool:
         """Write the column's consolidated in-memory chunks to a disk
-        run (sorted, internally dup-free — np.unique also dedups the
-        lazy tier's raw buffers) and free the memory; tracking continues
-        in a fresh epoch.  ``merged`` skips the re-dedup when the caller
-        just computed it (_compact_or_spill)."""
+        run (the partitioned v2 format — see RUN_MAGIC) and free the
+        memory; tracking continues in a fresh epoch.  ``merged`` skips
+        the re-dedup when the caller just computed the canonical parts
+        (_compact_or_spill).  With ``spill_workers > 0`` the write is
+        queued on the shared io tier (ingest/prep.py) and the scan
+        keeps folding — the run publishes into ``_runs`` at submit time
+        (deterministic order at any width) and every read/persist path
+        drains the queue first; a failed overlapped write is settled at
+        drain exactly like a synchronous failure (the unwritten values
+        return to the live buffer, then the column demotes through the
+        same best-effort walk)."""
         if merged is None:
-            merged = np.unique(np.concatenate(self._chunks[name]))
+            merged = self._partition_unique(
+                np.concatenate(self._chunks[name]))
+        elif isinstance(merged, np.ndarray):
+            merged = self._split_sorted(merged)
+        rows = sum(int(p.size) for p in merged)
         path = os.path.join(
             self.spill_dir,
             f"tpuprof-uniq-{self._spill_token}-{self._spill_seq}.u64")
         self._spill_seq += 1
-        try:
-            # two attempts: a concurrent profile sharing the dir (e.g.
-            # the fixed parity dir) may rmdir it between our makedirs
-            # and tofile — recreating once makes that race harmless
-            for attempt in (0, 1):
-                os.makedirs(self.spill_dir, exist_ok=True)
-                try:
-                    merged.tofile(path)
-                    break
-                except OSError:
-                    if attempt:
-                        raise
-        except OSError as exc:
-            # the user explicitly asked for exactness — a full/unwritable
-            # spill disk must not demote silently; also reap the partial
-            # file so the spill dir stays clean
-            import logging
-            logging.getLogger("tpuprof").warning(
-                "unique spill to %s failed (%s): column %r falls back "
-                "to the HLL distinct estimate", path, exc, name)
+        if self._spill_workers > 0:
+            # bounded, in-order completion like the two-tier preparer:
+            # settle the OLDEST write once the window fills, so RAM
+            # holds at most spill_workers freed-but-unwritten buffers
+            while len(self._spill_pending) >= self._spill_workers:
+                self._settle_spill(self._spill_pending.pop(0))
+                _SPILL_PENDING_G.set(len(self._spill_pending))
+            if not (self.status.get(name) == UNIQUE
+                    or self._counting.get(name, False)):
+                # a settled failure just demoted THIS column — nothing
+                # left to spill (its buffers were walked and freed)
+                return True
+            from tpuprof.ingest.prep import submit_io
+            parts = list(merged)
+            fut = submit_io(lambda: self._write_run(path, parts, name),
+                            self._spill_workers)
+            self._spill_pending.append((fut, name, path, rows, parts))
+            _SPILL_PENDING_G.set(len(self._spill_pending))
+        else:
             try:
-                os.remove(path)
-            except OSError:
-                pass
-            return False
-        self._runs[name].append((path, int(merged.size)))
+                self._write_run(path, merged, name)
+            except OSError as exc:
+                self._spill_write_failed(name, path, exc)
+                return False
+        self._runs[name].append((path, rows))
         self._owned.append(path)
         self._live -= self._rows[name]
         self._rows[name] = 0
         self._chunks[name] = []
+        self._clean.discard(name)
         return True
+
+    def _write_run(self, path: str, parts: Sequence[np.ndarray],
+                   name: str) -> None:
+        """Serialize one partitioned run: header (magic, P, index CRC),
+        per-partition row counts, then each partition's sorted values.
+        Runs on the io tier for overlapped spills; OSError propagates
+        to the caller/settler, which owns the demote semantics."""
+        import time
+        t0 = time.perf_counter()
+        shift = self._pshift()
+        counts = np.zeros(self._partitions, dtype="<u8")
+        for part in parts:
+            if part.size:
+                counts[int(part[0] >> shift)
+                       if self._partitions > 1 else 0] = part.size
+        index = counts.tobytes()
+        header = RUN_MAGIC + struct.pack(
+            "<II", self._partitions, zlib.crc32(index)) + index
+        # two attempts: a concurrent profile sharing the dir (e.g. the
+        # fixed parity dir) may rmdir it between our makedirs and the
+        # write — recreating once makes that race harmless
+        for attempt in (0, 1):
+            os.makedirs(self.spill_dir, exist_ok=True)
+            try:
+                with open(path, "wb") as fh:
+                    fh.write(header)
+                    for part in parts:
+                        np.ascontiguousarray(part).tofile(fh)
+                break
+            except OSError:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                if attempt:
+                    raise
+        rows = int(counts.sum())
+        nbytes = len(header) + rows * 8
+        seconds = time.perf_counter() - t0
+        _SPILL_BYTES.inc(nbytes)
+        _SPILL_SECONDS.observe(seconds)
+        _events.emit("unique_spill", column=name, rows=rows,
+                     bytes=nbytes, seconds=round(seconds, 6),
+                     queued=self._spill_workers > 0)
+
+    def _spill_write_failed(self, name: str, path: str,
+                            exc: BaseException) -> None:
+        """Shared failure report for sync and overlapped spill writes:
+        the user explicitly asked for exactness — a full/unwritable
+        spill disk must not demote silently; also reap the partial
+        file so the spill dir stays clean."""
+        import logging
+        logging.getLogger("tpuprof").warning(
+            "unique spill to %s failed (%s): column %r falls back "
+            "to the HLL distinct estimate", path, exc, name)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def _settle_spill(self, entry: Tuple) -> None:
+        """Wait for one queued spill write.  Success drops the buffer
+        references (the run on disk now carries the values); failure
+        re-files the unwritten values into the live buffer and demotes
+        through the SAME path a synchronous spill failure takes, so the
+        demote-on-storage-abort contract (a DUP in evidence survives;
+        anything else degrades to the honest OVERFLOW) is identical at
+        any worker count."""
+        fut, name, path, rows, parts = entry
+        try:
+            fut.result()
+            return
+        except OSError as exc:
+            self._spill_write_failed(name, path, exc)
+        self._runs[name] = [r for r in self._runs[name] if r[0] != path]
+        if path in self._owned:
+            self._owned.remove(path)
+        self._retired = [p for p in self._retired if p != path]
+        if self.status.get(name) == UNIQUE or self._counting.get(name):
+            # restore the unwritten values so the best-effort claim
+            # walk below sees exactly what the sync path would have
+            self._chunks[name].extend(np.asarray(p) for p in parts)
+            self._clean.discard(name)
+            self._rows[name] += rows
+            self._live += rows
+            self._overflow_warn(name)
+            self._demote(name, OVERFLOW)
+
+    def _drain_spills(self) -> None:
+        """Block until every queued spill write settled (oldest first).
+        Re-entrant-safe: a settle's demote walk re-enters through
+        _resolve_spilled, which must not re-order the queue."""
+        if self._draining or not self._spill_pending:
+            return
+        self._draining = True
+        try:
+            while self._spill_pending:
+                self._settle_spill(self._spill_pending.pop(0))
+        finally:
+            self._draining = False
+            _SPILL_PENDING_G.set(0)
+
+    def flush_spills(self) -> None:
+        """Public drain: block until every queued spill run is durably
+        on disk (failed writes demote their columns exactly as a
+        synchronous failure would).  Checkpoint/artifact writers call
+        this so a saved artifact never references an unwritten run —
+        pickling does it implicitly (__getstate__), this makes the
+        ordering explicit."""
+        self._drain_spills()
 
     def update(self, name: str, hashes: np.ndarray,
                hash_kind: str = "") -> None:
@@ -421,6 +655,7 @@ class UniqueTracker:
         if not sh.size:
             return
         self._chunks[name].append(sh)
+        self._clean.discard(name)       # no longer the canonical form
         self._rows[name] += sh.size
         self._live += sh.size
         if self._rows[name] > self.budget or self._live > self.total_budget:
@@ -455,6 +690,7 @@ class UniqueTracker:
         however large the column.  Non-destructive (streaming snapshots
         may call it repeatedly); per-column results are memoized on the
         (runs, live-rows) state."""
+        self._drain_spills()    # settle statuses before reporting them
         self.touch_runs()       # liveness signal: keep runs sweep-safe
         out = {}
         for name, st in self.status.items():
@@ -485,6 +721,7 @@ class UniqueTracker:
         runs and the np.unique of the lazy tier's raw live buffers, via
         the hash-range k-way merge.  Non-destructive and memoized
         alongside the claim."""
+        self._drain_spills()    # settle statuses before reporting them
         self.touch_runs()       # liveness signal: keep runs sweep-safe
         out: Dict[str, int] = {}
         for name, counting in self._counting.items():
@@ -514,27 +751,148 @@ class UniqueTracker:
         return (tuple(self._runs[name]), self._rows[name],
                 self._fed.get(name, 0))
 
+    def _run_layout(self, path: str, rows: int
+                    ) -> Tuple[int, Optional[np.ndarray]]:
+        """Validate a run file and return ``(payload byte offset,
+        per-partition prefix offsets or None)``.  Offsets come straight
+        from the v2 header when the writer's partition count matches
+        this tracker's; a foreign count — or a pre-round-8 headerless
+        run, recognized by its exact ``rows * 8`` size — returns None
+        and the reader slices the (globally sorted) payload by
+        searchsorted instead.  Any truncation, bit-flip or row-count
+        disagreement raises :class:`CorruptRunError`; a vanished file
+        raises OSError.  Both are handled identically by every caller
+        (honest demote)."""
+        size = os.path.getsize(path)
+        with open(path, "rb") as fh:
+            head = fh.read(_RUN_HEAD)
+            if head[:len(RUN_MAGIC)] != RUN_MAGIC:
+                # no magic: either a pre-round-8 headerless run (whose
+                # only validation was — and remains — its exact size)
+                # or corruption.  The magic test runs FIRST: a v2 run
+                # truncated to exactly rows*8 bytes must never pass as
+                # legacy (a legacy run starting with the magic bytes
+                # has probability 2^-64 — the same collision contract
+                # the hashes themselves carry).
+                if size == rows * 8:
+                    return 0, None
+                raise CorruptRunError(
+                    f"spill run {path!r}: unrecognized layout "
+                    f"({size} bytes for {rows} recorded rows)")
+            if len(head) < _RUN_HEAD:
+                raise CorruptRunError(
+                    f"spill run {path!r}: truncated header")
+            run_p, crc = struct.unpack("<II", head[len(RUN_MAGIC):])
+            if not 1 <= run_p <= 1 << 16:
+                raise CorruptRunError(
+                    f"spill run {path!r}: implausible partition "
+                    f"count {run_p}")
+            index = fh.read(8 * run_p)
+        if len(index) != 8 * run_p or zlib.crc32(index) != crc:
+            raise CorruptRunError(
+                f"spill run {path!r}: partition index corrupt "
+                "(truncated or CRC mismatch)")
+        counts = np.frombuffer(index, dtype="<u8")
+        offset = _RUN_HEAD + 8 * run_p
+        if int(counts.sum()) != rows or size != offset + rows * 8:
+            raise CorruptRunError(
+                f"spill run {path!r}: payload truncated or row count "
+                f"mismatch ({size} bytes, {rows} recorded rows)")
+        if run_p != self._partitions:
+            return offset, None             # readable, slice by search
+        prefix = np.zeros(run_p + 1, dtype=np.int64)
+        prefix[1:] = np.cumsum(counts)
+        return offset, prefix
+
+    @staticmethod
+    def _union_ranged(parts: List[np.ndarray], lo: int, hi: int,
+                      n_sub: int, count: bool) -> Tuple[bool, int]:
+        """Distinct count + duplicate detection across sorted dup-free
+        arrays restricted to hashes in ``[lo, hi]``, in ``n_sub``
+        bounded sub-ranges (RAM <= RESOLVE_SLICE_ROWS rows however
+        large the column).  Returns (dup_found, distinct); when a dup
+        settles the claim and no count is wanted, remaining sub-ranges
+        are skipped (the count half of the return is then partial and
+        the caller discards it — same contract the round-5 walk had)."""
+        dup = False
+        distinct = 0
+        step = (hi - lo + 1) // n_sub
+        for k in range(n_sub):
+            slo = np.uint64(lo + k * step)
+            shi = np.uint64(lo + (k + 1) * step - 1) \
+                if k + 1 < n_sub else np.uint64(hi)
+            sub = []
+            for a in parts:
+                i = int(np.searchsorted(a, slo, side="left"))
+                j = int(np.searchsorted(a, shi, side="right"))
+                if j > i:
+                    sub.append(np.asarray(a[i:j]))
+            if len(sub) < 2:
+                distinct += sub[0].size if sub else 0
+                continue            # one source can't cross-duplicate
+            s = np.sort(np.concatenate(sub))
+            if s.size > 1:
+                news = int((s[1:] != s[:-1]).sum()) + 1
+            else:
+                news = int(s.size)
+            if news != s.size:
+                dup = True
+                if not count:
+                    return dup, distinct    # claim settled
+            distinct += news
+        return dup, distinct
+
     def _resolve_spilled(self, name: str, count: bool = False
                          ) -> Tuple[str, Optional[int]]:
+        self._drain_spills()    # a queued run is not yet readable
+        if self._counting.get(name, False) and not self._runs[name] \
+                and name not in self._clean and self._chunks[name]:
+            # Count-only fast path — the wide-shape common case once
+            # the RAM-derived budget swallows the whole stream: no runs
+            # to merge, so the union is one in-place sort + adjacent-
+            # diff count over the raw buffer.  Skips canonicalization
+            # (its dedup extract pays an extra copy the count never
+            # needs) and the partition walk (one source per partition
+            # has nothing to cross-merge).  Memo key: fed is monotone,
+            # so any new data invalidates; a later compaction changes
+            # _rows and merely re-walks to the same answer.
+            key = ((), self._rows[name], self._fed.get(name, 0))
+            memo = self._resolve_memo.get(name)
+            if memo is not None and memo[0] == key:
+                return memo[1], memo[2]
+            s = np.concatenate(self._chunks[name])
+            s.sort()
+            if s.size > 1:
+                distinct = int((s[1:] != s[:-1]).sum()) + 1
+            else:
+                distinct = int(s.size)
+            status = UNIQUE if distinct == s.size else DUP
+            self._resolve_memo[name] = (key, status, distinct)
+            return status, distinct
         key = self._canonical_key(name)
         memo = self._resolve_memo.get(name)
         if memo is not None and memo[0] == key \
                 and not (count and memo[2] is None
                          and memo[1] != OVERFLOW):
             return memo[1], memo[2]
-        arrays: List[np.ndarray] = []
+        # every source is one sorted dup-free array: a memmap'd run
+        # payload (with direct per-partition offsets when its header's
+        # partition count matches ours) or a live canonical part
+        sources: List[Tuple[np.ndarray, Optional[np.ndarray]]] = []
         for path, rows in self._runs[name]:
             try:
-                arrays.append(np.memmap(path, dtype=np.uint64, mode="r",
-                                        shape=(rows,)))
+                offset, prefix = self._run_layout(path, rows)
+                mm = np.memmap(path, dtype=np.uint64, mode="r",
+                               offset=offset, shape=(rows,))
             except (OSError, ValueError):
-                # a run vanished (tmp cleaner, resume on another box):
-                # the exact claim is gone — honest fallback.  Demote
-                # fully: the lazy tier's raw buffers must not survive
-                # into the probed paths, whose invariants (sorted,
-                # dup-free chunks) they violate (counting is flipped
-                # off FIRST so _demote skips its best-effort walk —
-                # a partial union would settle false DUPs)
+                # a run vanished or rotted (tmp cleaner, resume on
+                # another box, torn write): the exact claim is gone —
+                # honest fallback.  Demote fully: the lazy tier's raw
+                # buffers must not survive into the probed paths, whose
+                # invariants (sorted, dup-free chunks) they violate
+                # (counting is flipped off FIRST so _demote skips its
+                # best-effort walk — a partial union would settle
+                # false DUPs)
                 self._counting[name] = False
                 self._resolve_memo[name] = (key, OVERFLOW, None)
                 # detach the SURVIVING runs before demoting: a restored
@@ -545,44 +903,54 @@ class UniqueTracker:
                 self._runs[name] = []
                 self._demote(name, OVERFLOW)
                 return OVERFLOW, None
+            sources.append((mm, prefix))
         if self._chunks[name]:
-            # counting columns arrive pre-compacted to one sorted
-            # dup-free chunk (_canonical_key); probed-path chunk lists
-            # are sorted and mutually dup-free, so unique == the old
-            # sort-concatenate
-            if len(self._chunks[name]) == 1 and name in self._clean:
-                arrays.append(self._chunks[name][0])
+            if name in self._clean:
+                # canonical partitioned parts (counting columns arrive
+                # here pre-compacted by _canonical_key)
+                for c in self._chunks[name]:
+                    sources.append((c, None))
             else:
-                arrays.append(np.unique(np.concatenate(
-                    self._chunks[name])))
-        total = sum(a.size for a in arrays)
-        n_slices = max(1, -(-total // RESOLVE_SLICE_ROWS))
-        step = (1 << 64) // n_slices
+                # probed-path chunk lists are sorted and mutually
+                # dup-free, so unique == the old sort-concatenate
+                sources.append((np.unique(np.concatenate(
+                    self._chunks[name])), None))
+        # the partition walk: P independent unions — partitions never
+        # cross-merge (a value's partition is a function of the value),
+        # each union runs over a cache-sized slice, and run slices come
+        # straight off the header index (no global k-way hash walk).
+        # Oversized partitions (a column far past RESOLVE_SLICE_ROWS)
+        # fall back to bounded sub-ranges within the partition.
         status = UNIQUE
         distinct = 0
-        for k in range(n_slices):
-            lo = np.uint64(k * step)
-            hi = np.uint64((k + 1) * step - 1) if k + 1 < n_slices \
-                else np.uint64((1 << 64) - 1)
+        P = self._partitions
+        step = (1 << 64) // P
+        for p in range(P):
+            lo = p * step
+            hi = (p + 1) * step - 1 if p + 1 < P else (1 << 64) - 1
             parts = []
-            for a in arrays:
-                i = int(np.searchsorted(a, lo, side="left"))
-                j = int(np.searchsorted(a, hi, side="right"))
+            total = 0
+            for arr, prefix in sources:
+                if prefix is not None:
+                    i, j = int(prefix[p]), int(prefix[p + 1])
+                else:
+                    i = int(np.searchsorted(arr, np.uint64(lo),
+                                            side="left"))
+                    j = int(np.searchsorted(arr, np.uint64(hi),
+                                            side="right"))
                 if j > i:
-                    parts.append(np.asarray(a[i:j]))
+                    parts.append(arr[i:j])
+                    total += j - i
             if len(parts) < 2:
-                distinct += parts[0].size if parts else 0
+                distinct += int(parts[0].size) if parts else 0
                 continue            # one source can't cross-duplicate
-            s = np.sort(np.concatenate(parts))
-            if s.size > 1:
-                news = int((s[1:] != s[:-1]).sum()) + 1
-            else:
-                news = s.size
-            if news != s.size:
+            n_sub = max(1, -(-total // RESOLVE_SLICE_ROWS))
+            dup, news = self._union_ranged(parts, lo, hi, n_sub, count)
+            distinct += news
+            if dup:
                 status = DUP
                 if not count:
                     break           # claim settled; count not wanted
-            distinct += news
         self._resolve_memo[name] = (
             key, status, distinct if count or status == UNIQUE else None)
         # a clean full walk also yields the count for free when every
@@ -597,6 +965,7 @@ class UniqueTracker:
         tokens' abandoned litter (crashed chains' post-checkpoint
         orphans).  Young files under other tokens are never touched:
         they may belong to a still-live concurrent writer."""
+        self._drain_spills()        # queued writes land, then delete
         self.persistent = False     # nothing references the runs now —
         # _drop_runs may delete physically instead of retiring
         for name in list(self._runs):
@@ -656,12 +1025,18 @@ class UniqueTracker:
             pass
 
     def __getstate__(self) -> Dict[str, object]:
+        # an artifact must reference only DURABLE runs: block until
+        # every overlapped spill write landed (a failed write demotes
+        # its column here, exactly as a synchronous failure would)
+        self._drain_spills()
         state = dict(self.__dict__)
         state["_resolve_memo"] = {}
         state["_owned"] = []
         # retired paths belong to the WRITER's save/reap cycle, not the
         # artifact: a restored process must neither delete nor track them
         state["_retired"] = []
+        state["_spill_pending"] = []
+        state["_draining"] = False
         return state
 
     def __setstate__(self, state) -> None:
@@ -683,6 +1058,12 @@ class UniqueTracker:
             self._counting = {n: False for n in self.status}
         if not hasattr(self, "_next_compact"):
             self._next_compact = {}
+        if not hasattr(self, "_partitions"):    # pre-round-8 artifacts
+            self._partitions = 1
+        if not hasattr(self, "_spill_workers"):
+            self._spill_workers = 0
+        self._spill_pending = []
+        self._draining = False
         # restored buffers are conservatively dirty (re-unique once)
         self._clean = set()
         if not hasattr(self, "_fed"):
@@ -699,8 +1080,14 @@ class UniqueTracker:
         for name, runs in list(self._runs.items()):
             for path, rows in runs:
                 try:
-                    ok = os.path.getsize(path) == rows * 8
-                except OSError:
+                    # full layout validation, both formats: a legacy
+                    # run must match its exact size, a partitioned run
+                    # its header + index CRC + payload length — any
+                    # truncation/bit-flip is caught HERE, before a
+                    # resume trusts the file (CorruptRunError)
+                    self._run_layout(path, rows)
+                    ok = True
+                except (OSError, CorruptRunError):
                     ok = False
                 if not ok:
                     # checkpoint artifacts reference spill files by path;
@@ -757,8 +1144,9 @@ class UniqueTracker:
 
     def _end_counting(self, name: str) -> None:
         """Flip a column out of lazy counting, restoring the probed
-        paths' chunk invariant (the walk leaves the buffer as one
-        sorted dup-free chunk).  The claim is settled from EVERYTHING
+        paths' chunk invariant (the walk leaves the buffer in the
+        canonical partitioned form — sorted, mutually dup-free chunks,
+        exactly what the probe loop expects).  The claim is settled from EVERYTHING
         counted so far — dup evidence may survive only in _fed
         (compactions collapse buffered dups, spills collapse run dups),
         so checking the live buffer alone would forget real duplicates
@@ -768,6 +1156,11 @@ class UniqueTracker:
         dup = False
         if self.status.get(name) == UNIQUE:
             try:
+                # canonicalize FIRST: the probed paths this column is
+                # about to rejoin require sorted mutually-dup-free
+                # chunks, and the count-only fast path deliberately
+                # leaves raw buffers in place
+                self._compact_buffer(name)
                 _st, cnt = self._resolve_spilled(name, count=True)
                 dup = cnt is not None and cnt < self._fed.get(name, cnt)
             except Exception:
@@ -802,6 +1195,10 @@ class UniqueTracker:
                                             st, counts.get(name))
 
     def merge(self, other: "UniqueTracker") -> None:
+        # adopt only DURABLE runs: both sides settle their spill queues
+        # (an unpickled peer arrives drained by __getstate__ already)
+        self._drain_spills()
+        other._drain_spills()
         for name, ost in other.status.items():
             if name not in self.status:
                 continue

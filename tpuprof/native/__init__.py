@@ -55,6 +55,9 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.tpuprof_hash_pack_u64.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_size_t, ctypes.c_int]
+    lib.tpuprof_hash_pack_keep_u64.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int]
     lib.tpuprof_pack_gather.argtypes = [
         ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int]
@@ -151,6 +154,32 @@ def hash_pack_u64(keys: np.ndarray, valid: Optional[np.ndarray],
         vptr = valid.ctypes.data
     lib.tpuprof_hash_pack_u64(keys.ctypes.data, vptr, out.ctypes.data,
                               keys.size, precision)
+    return out
+
+
+def hash_pack_keep_u64(keys: np.ndarray, valid: Optional[np.ndarray],
+                       precision: int,
+                       h64_out: np.ndarray) -> Optional[np.ndarray]:
+    """Fused splitmix64 + HLL pack that ALSO writes the full 64-bit
+    hash stream into ``h64_out`` (a contiguous uint64 array slice —
+    the exact-distinct tracker feed): one C pass replaces
+    ``hash_pack_u64`` + ``hash_u64_array`` + the 8-byte/row copy.
+    Bit-identical to both; None if native is unavailable."""
+    _check_pack_precision(precision)
+    lib = _load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    assert h64_out.dtype == np.uint64 and h64_out.size == keys.size \
+        and h64_out.flags.c_contiguous
+    out = np.empty(keys.shape, dtype=np.uint16)
+    vptr = 0
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, dtype=np.uint8)
+        vptr = valid.ctypes.data
+    lib.tpuprof_hash_pack_keep_u64(keys.ctypes.data, vptr,
+                                   out.ctypes.data, h64_out.ctypes.data,
+                                   keys.size, precision)
     return out
 
 

@@ -170,6 +170,24 @@ void tpuprof_hash_pack_u64(const uint64_t* keys, const uint8_t* valid,
   }
 }
 
+// Fused hash+pack that ALSO keeps the full 64-bit hash (exact-distinct
+// mode, config.full_hashes): one pass produces the packed HLL
+// observation AND writes the unpacked splitmix hash straight into the
+// caller's preallocated stream (h64, typically a slice of the
+// HostBatch num_hashes plane) — replacing the separate
+// tpuprof_hash_u64 pass plus an 8-byte/row Python-side copy.
+// Bit-identical to tpuprof_hash_pack_u64 / tpuprof_hash_u64 by
+// construction: same splitmix, same pack_one.
+void tpuprof_hash_pack_keep_u64(const uint64_t* keys,
+                                const uint8_t* valid, uint16_t* out,
+                                uint64_t* h64, size_t n, int precision) {
+  for (size_t i = 0; i < n; ++i) {
+    const uint64_t h = splitmix(keys[i]);
+    h64[i] = h;
+    out[i] = (valid && !valid[i]) ? 0 : pack_one(h, precision);
+  }
+}
+
 // Fused gather+pack for dictionary-encoded columns: observations come
 // from the per-dictionary-value hashes (dict_hashes, length n_dict)
 // gathered through int64 codes; invalid rows (code < 0 / out of range /

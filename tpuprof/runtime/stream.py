@@ -494,6 +494,11 @@ class StreamingProfiler:
         """Persist (device state, host aggregators, cursor) atomically.
         Buffered rows fold first — the artifact must cover every row the
         caller handed to ``update`` (the buffer itself is not saved)."""
+        # overlapped unique-spill writes settle BEFORE the artifact
+        # serializes: a checkpoint must reference only durable runs
+        # (pickling drains too — kernels/unique.__getstate__ — this
+        # makes the ordering explicit at the save boundary)
+        self.hostagg.unique.flush_spills()
         payload = self.export_payload()
         ckpt.save(path, payload["state"], payload["host_blob"],
                   payload["cursor"], meta=payload["meta"],
