@@ -276,6 +276,21 @@ def _measure_wide_exact() -> dict:
                               cols=20 if _SMOKE else 200)
 
 
+def _measure_serve() -> dict:
+    """Profile-as-a-service envelope (ISSUE 9): cold-vs-warm ratio and
+    repeat-fingerprint compile-cache hit rate of one ProfileScheduler
+    at smoke scale — the `serve` scenario (benchmarks/run.py) tracks
+    the full methodology; these keys put a warm-start regression in
+    the headline BENCH line."""
+    import sys
+    import tempfile
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.run import measure_serve
+    with tempfile.TemporaryDirectory() as td:
+        return measure_serve(1 << 13 if _SMOKE else 1 << 14, td,
+                             warm_jobs=2, concurrent=2)
+
+
 def _measure_guardrail() -> dict:
     """Clean-path cost of the fault-tolerance plumbing (ISSUE 4): the
     retry-guard wrapper on the serial prepare loop, A/B'd in the same
@@ -308,6 +323,7 @@ def main() -> None:
     wide_exact = _measure_wide_exact()    # exact-distinct host ratio
     artifact = _measure_artifact()        # store + incremental costs
     rebalance = _measure_rebalance()      # elastic scheduler envelope
+    serve = _measure_serve()              # warm-mesh daemon envelope
     render_s = _measure_render()          # host-only, before the device
 
     devices = jax.devices()[:1]           # single-chip measurement
@@ -414,6 +430,14 @@ def main() -> None:
         # dead-member detect+steal+replay latency
         "steal_overhead_pct": rebalance["steal_overhead_pct"],
         "rebalance_latency_s": rebalance["rebalance_latency_s"],
+        # profile-as-a-service (ISSUE 9): the `tpuprof serve` daemon's
+        # amortization — first-job (compile) vs repeat-fingerprint
+        # latency through one warm mesh, and the keyed runner cache's
+        # repeat-job hit rate (must be 1.0)
+        "serve_cold_s": serve["serve_cold_s"],
+        "serve_warm_p50_s": serve["serve_warm_p50_s"],
+        "serve_cold_vs_warm_ratio": serve["serve_cold_vs_warm_ratio"],
+        "serve_cache_hit_rate": serve["serve_cache_hit_rate"],
         "device_mem_in_use_bytes": int(device_mem_in_use),
         # per-stage breakdown (obs spans; NEW keys only — existing keys
         # above keep their names so BENCH_r* comparisons stay valid)

@@ -201,6 +201,65 @@ class TestUniqueBudgetRoundTrip:
         assert "power of two" in capsys.readouterr().err
 
 
+class TestServeConfigRoundTrip:
+    """The `serve_*` knobs resolve identically from env, CLI and config
+    (ISSUE 9 satellite — the resolve_* round-trip pattern)."""
+
+    KNOBS = (
+        # (config field, CLI flag, env var, resolver name, default)
+        ("serve_workers", "--serve-workers", "TPUPROF_SERVE_WORKERS",
+         "resolve_serve_workers", 2),
+        ("serve_queue_depth", "--serve-queue-depth",
+         "TPUPROF_SERVE_QUEUE_DEPTH", "resolve_serve_queue_depth", 32),
+        ("serve_tenant_quota", "--serve-tenant-quota",
+         "TPUPROF_SERVE_TENANT_QUOTA", "resolve_serve_tenant_quota", 0),
+    )
+
+    def test_env_cli_config_resolve_identically(self, monkeypatch):
+        import tpuprof.config as cfg_mod
+        from tpuprof.cli import build_parser
+        for field, flag, env, resolver_name, _default in self.KNOBS:
+            resolver = getattr(cfg_mod, resolver_name)
+            via_config = resolver(
+                getattr(ProfilerConfig(**{field: 3}), field))
+            args = build_parser().parse_args(["serve", "spool", flag, "3"])
+            via_cli = resolver(getattr(args, field))
+            monkeypatch.setenv(env, "3")
+            via_env = resolver(None)
+            assert via_config == via_cli == via_env == 3, field
+            # explicit value beats the env twin
+            assert resolver(7) == 7, field
+            monkeypatch.delenv(env)
+
+    def test_defaults_and_env_fallback(self, monkeypatch):
+        import tpuprof.config as cfg_mod
+        for field, _flag, env, resolver_name, default in self.KNOBS:
+            resolver = getattr(cfg_mod, resolver_name)
+            monkeypatch.delenv(env, raising=False)
+            assert resolver(None) == default, field
+            monkeypatch.setenv(env, "9")
+            assert resolver(None) == 9, field
+            monkeypatch.delenv(env)
+
+    def test_config_validation_rejects_bad_values(self):
+        with pytest.raises(ValueError, match="serve_workers"):
+            ProfilerConfig(serve_workers=0)
+        with pytest.raises(ValueError, match="serve_queue_depth"):
+            ProfilerConfig(serve_queue_depth=0)
+        with pytest.raises(ValueError, match="serve_tenant_quota"):
+            ProfilerConfig(serve_tenant_quota=-1)
+        # 0 quota means UNLIMITED and is legal (the default)
+        assert ProfilerConfig(serve_tenant_quota=0).serve_tenant_quota == 0
+
+    def test_cli_parser_defaults_leave_resolution_open(self):
+        from tpuprof.cli import build_parser
+        args = build_parser().parse_args(["serve", "spool"])
+        assert args.serve_workers is None
+        assert args.serve_queue_depth is None
+        assert args.serve_tenant_quota is None
+        assert args.once is False
+
+
 SNAPSHOT_NUM_FIELDS = sorted(schema.NUM_FIELDS)
 
 

@@ -65,6 +65,19 @@ EVENT_SCHEMA = {
                        "hosts": ((int,), True),
                        "quarantined_by_host": ((list,), True),
                        "snapshot": ((dict,), True)},
+    # profile-as-a-service (tpuprof/serve, ISSUE 9): one per terminal
+    # job (done|failed|rejected) — the daemon's per-request audit line
+    "serve_job": {"ts": ((int, float), True), "id": ((str,), True),
+                  "tenant": ((str,), True), "status": ((str,), True),
+                  "seconds": ((int, float), True),
+                  "queue_seconds": ((int, float, type(None)), False),
+                  "cache_hit": ((bool, type(None)), False),
+                  "error": ((str, type(None)), False)},
+    # periodic daemon liveness (scheduler.heartbeat())
+    "serve_heartbeat": {"ts": ((int, float), True),
+                        "requests": ((int,), True),
+                        "done": ((int,), True),
+                        "queued": ((int,), True)},
 }
 
 

@@ -72,16 +72,67 @@ def test_load_baseline_prefers_explicit_then_committed_then_workdir(
     assert _load_baseline(None, str(empty)) == (None, {})
 
 
-def test_print_deltas_flags_pass_b_regression(capsys):
+def _pin_history(monkeypatch, payloads):
+    """Pin the committed-REGRESSION_r* glob (and reads) to a synthetic
+    history so the repo's real snapshots cannot leak into the test."""
+    import glob as _glob
+    import tempfile
+    real_glob = _glob.glob
+    paths = []
+    for i, payload in enumerate(payloads):
+        fh = tempfile.NamedTemporaryFile(
+            "w", suffix=f"_r{i:02d}.json", delete=False)
+        json.dump(payload, fh)
+        fh.close()
+        paths.append(fh.name)
+    monkeypatch.setattr(
+        _glob, "glob",
+        lambda pat, *a, **k: (list(paths) if "REGRESSION_r*" in pat
+                              else real_glob(pat, *a, **k)))
+
+
+def test_print_deltas_flags_pass_b_regression(capsys, monkeypatch):
+    _pin_history(monkeypatch, [])      # no history: every leg gets ±25%
     baseline = {r["scenario"]: r for r in _payload(1000.0)["results"]}
     # pass_b drops 40% -> flagged; taxi moves +10% -> printed, unflagged
     results = _payload(600.0, taxi_rate=110000.0)["results"]
     _print_deltas(results, "REGRESSION_r05.json", baseline)
     out = capsys.readouterr().out
-    assert "passb: 1,000 → 600 rows/s (-40.0%)" in out
+    assert "passb: 1,000 → 600 rows/s (-40.0% vs ±25% band)" in out
     assert "REGRESSION?" in out
     assert "taxi" in out and "+10.0%" in out
     assert out.count("REGRESSION?") == 1       # taxi NOT flagged
+
+
+def test_print_deltas_respects_historical_swing_bands(capsys,
+                                                      monkeypatch):
+    """A leg that historically swings ±40% at fixed code (passb's
+    documented weather, REGRESSION_r11's -38% false alarm) must flag
+    only OUTSIDE its own band — while a stable leg still trips at the
+    generic 25% (ISSUE 9 satellite)."""
+    from benchmarks.run import _historical_bands
+    # history: passb 1000 -> 600 (-40%) -> 1000 (+67%); taxi flat
+    _pin_history(monkeypatch, [_payload(1000.0), _payload(600.0),
+                               _payload(1000.0)])
+    bands = _historical_bands()
+    assert bands["passb"] >= 66.0 * 1.25 - 1    # biggest swing, padded
+    assert bands["taxi"] == 25.0                # flat history: the floor
+    baseline = {r["scenario"]: r for r in _payload(1000.0)["results"]}
+    # passb -40% sits INSIDE its band now; taxi -40% still flags
+    _print_deltas(_payload(600.0, taxi_rate=60000.0)["results"],
+                  "prev", baseline)
+    out = capsys.readouterr().out
+    assert out.count("REGRESSION?") == 1
+    taxi_line = [l for l in out.splitlines() if "taxi" in l][0]
+    assert "REGRESSION?" in taxi_line
+    # ... but a drop past even the wide band still flags passb
+    _print_deltas(_payload(50.0)["results"], "prev", baseline)
+    assert "passb" in capsys.readouterr().out.replace("\n", " ")
+    _pin_history(monkeypatch, [_payload(1000.0), _payload(600.0)])
+    _print_deltas(_payload(50.0)["results"], "prev", baseline)
+    out = capsys.readouterr().out
+    passb_line = [l for l in out.splitlines() if "passb" in l][0]
+    assert "REGRESSION?" in passb_line          # -95% > any band
 
 
 def test_print_deltas_handles_missing_and_failed(capsys):

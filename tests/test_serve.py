@@ -1,0 +1,555 @@
+"""Profile-as-a-service (tpuprof/serve — ISSUE 9): the keyed
+compiled-program cache (+ the PR-6 compile-cache crash fix), the job
+state machine and multi-tenant admission queue, scheduler end-to-end
+byte-identity vs the one-shot CLI path, the spool-directory daemon and
+`tpuprof serve`/`tpuprof submit` CLI, and the idempotent daemon-safe
+signal handlers with SIGUSR1 queue snapshots."""
+
+import json
+import os
+import re
+import signal
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof import ProfileReport, ProfilerConfig
+from tpuprof.cli import main
+from tpuprof.serve import cache as scache
+from tpuprof.serve.jobs import (DONE, FAILED, QUEUED, REJECTED, RUNNING,
+                                Job, JobQueue, QueueFull,
+                                TenantQuotaExceeded)
+from tpuprof.serve.scheduler import ProfileScheduler
+
+
+@pytest.fixture
+def parquet_path(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 3000
+    df = pd.DataFrame({
+        "a": rng.normal(10, 2, n),
+        "b": rng.exponential(1.0, n),
+        "c": rng.choice(["x", "y", "z"], n),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+    return path
+
+
+def _strip_perf(html: str) -> str:
+    # the report footer carries wall-clock (rows/s + per-phase seconds);
+    # byte-identity claims are modulo that one line — the same idiom the
+    # round-8 report-equality tests pinned
+    return re.sub(r"[\d,]+ rows/s[^\n<]*", "PERF", html)
+
+
+# ---------------------------------------------------------------------------
+# keyed runner cache (serve/cache.py)
+# ---------------------------------------------------------------------------
+
+class TestRunnerCache:
+    def test_same_key_reuses_runner_object(self):
+        cache = scache.RunnerCache(capacity=4)
+        cfg = ProfilerConfig(batch_rows=1024)
+        r1 = cache.get(cfg, 3, 1)
+        r2 = cache.get(ProfilerConfig(batch_rows=1024), 3, 1)
+        assert r1 is r2
+        st = cache.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        assert st["hit_rate"] == 0.5
+
+    def test_shape_or_program_knobs_miss(self):
+        cache = scache.RunnerCache(capacity=8)
+        cfg = ProfilerConfig(batch_rows=1024)
+        base = cache.get(cfg, 3, 1)
+        assert cache.get(cfg, 4, 1) is not base          # n_num
+        assert cache.get(cfg, 3, 2) is not base          # n_hash
+        assert cache.get(ProfilerConfig(batch_rows=2048), 3, 1) \
+            is not base                                  # rows
+        assert cache.get(ProfilerConfig(batch_rows=1024, bins=7), 3, 1) \
+            is not base                                  # program shape
+        assert cache.stats()["misses"] == 5
+
+    def test_non_program_fields_still_hit(self, tmp_path):
+        """Paths, budgets and telemetry knobs are NOT part of any
+        compiled program — two jobs differing only there must share a
+        runner, or the warm mesh never warms."""
+        cache = scache.RunnerCache(capacity=4)
+        a = cache.get(ProfilerConfig(batch_rows=1024), 3, 1)
+        b = cache.get(ProfilerConfig(
+            batch_rows=1024, checkpoint_path=str(tmp_path / "c"),
+            metrics_interval=5.0, unique_track_rows=123,
+            artifact_path=str(tmp_path / "a.json")), 3, 1)
+        assert a is b
+
+    def test_lru_eviction(self):
+        cache = scache.RunnerCache(capacity=2)
+        cfg = ProfilerConfig(batch_rows=1024)
+        r1 = cache.get(cfg, 3, 0)
+        cache.get(cfg, 4, 0)
+        cache.get(cfg, 5, 0)           # evicts the (3, 0) runner
+        assert cache.get(cfg, 3, 0) is not r1
+        assert cache.stats()["runners"] == 2
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("TPUPROF_RUNNER_CACHE", "0")
+        cfg = ProfilerConfig(batch_rows=1024)
+        assert not scache.cache_enabled()
+        r1 = scache.acquire_runner(cfg, 3, 1)
+        r2 = scache.acquire_runner(cfg, 3, 1)
+        assert r1 is not r2            # the pre-serve build-per-call
+        monkeypatch.delenv("TPUPROF_RUNNER_CACHE")
+        assert scache.cache_enabled()
+
+    def test_pass_b_kernel_env_resolves_into_key(self, monkeypatch):
+        cfg = ProfilerConfig(batch_rows=1024)
+        k1 = scache.runner_key(cfg, 3, 0)
+        monkeypatch.setenv("TPUPROF_PASS_B_KERNEL", "legacy")
+        k2 = scache.runner_key(cfg, 3, 0)
+        assert k1 != k2                # env flip => different programs
+
+
+class TestCompileCacheGate:
+    """The PR-6 drift-leg fix: repeated MeshRunner builds with the
+    persistent compilation cache enabled intermittently abort jaxlib —
+    the first cache-enabled build in a process keeps the cache, every
+    later build gates it off (serve/cache._note_build_with_cache)."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_gate(self, monkeypatch):
+        monkeypatch.setattr(scache, "_cached_builds", [0])
+        monkeypatch.setattr(scache, "_gate_warned", [False])
+        yield
+        from tpuprof.backends.tpu import disable_compile_cache
+        disable_compile_cache()
+
+    def test_second_build_gates_the_cache(self, tmp_path):
+        import jax
+
+        from tpuprof.backends.tpu import _enable_compile_cache
+        cache_dir = str(tmp_path / "xla")
+        _enable_compile_cache(cache_dir)
+        cache = scache.RunnerCache(capacity=4)
+        cfg = ProfilerConfig(batch_rows=1024)
+        cache.get(cfg, 3, 0)           # first build: cache stays on
+        assert getattr(jax.config, "jax_compilation_cache_dir", None) \
+            == cache_dir
+        cache.get(cfg, 3, 0)           # HIT: no build, no gating
+        assert getattr(jax.config, "jax_compilation_cache_dir", None) \
+            == cache_dir
+        cache.get(cfg, 4, 0)           # second BUILD: gated off
+        assert getattr(jax.config, "jax_compilation_cache_dir", None) \
+            is None
+
+    def test_opt_out_env_keeps_cache_across_builds(self, tmp_path,
+                                                   monkeypatch):
+        import jax
+
+        from tpuprof.backends.tpu import _enable_compile_cache
+        monkeypatch.setenv("TPUPROF_COMPILE_CACHE_REBUILDS", "1")
+        cache_dir = str(tmp_path / "xla")
+        _enable_compile_cache(cache_dir)
+        cache = scache.RunnerCache(capacity=4)
+        cfg = ProfilerConfig(batch_rows=1024)
+        cache.get(cfg, 3, 0)
+        cache.get(cfg, 4, 0)
+        assert getattr(jax.config, "jax_compilation_cache_dir", None) \
+            == cache_dir
+
+
+# ---------------------------------------------------------------------------
+# job state machine + admission queue (serve/jobs.py)
+# ---------------------------------------------------------------------------
+
+class TestJobStateMachine:
+    def test_legal_lifecycle(self):
+        job = Job(source="x.parquet", output="x.html", tenant="t1")
+        assert job.state == QUEUED and job.seconds is None
+        job.to(RUNNING)
+        assert job.queue_seconds is not None
+        job.to(DONE)
+        assert job.seconds is not None
+        wire = job.to_wire()
+        assert wire["status"] == DONE and wire["tenant"] == "t1"
+
+    def test_illegal_transitions_raise(self):
+        job = Job(source="x")
+        with pytest.raises(ValueError, match="illegal transition"):
+            job.to(DONE)               # done without ever running
+        job.to(RUNNING)
+        with pytest.raises(ValueError, match="illegal transition"):
+            job.to(QUEUED)             # no going back
+        job.to(FAILED, error="boom", exit_code=5)
+        with pytest.raises(ValueError, match="illegal transition"):
+            job.to(RUNNING)            # terminal states are terminal
+        assert job.to_wire()["exit_code"] == 5
+
+    def test_job_ids_unique_and_sortable(self):
+        ids = [Job(source="x").id for _ in range(50)]
+        assert len(set(ids)) == 50
+
+
+class TestJobQueue:
+    def test_depth_bound_rejects(self):
+        q = JobQueue(depth=2)
+        q.admit(Job(source="a"))
+        q.admit(Job(source="b"))
+        with pytest.raises(QueueFull, match="serve-queue-depth"):
+            q.admit(Job(source="c"))
+        assert q.next(timeout=0.1).source == "a"     # FIFO
+        q.admit(Job(source="c"))                     # space again
+
+    def test_tenant_quota_covers_queued_and_running(self):
+        q = JobQueue(depth=8, tenant_quota=2)
+        j1, j2 = Job(source="a", tenant="t"), Job(source="b", tenant="t")
+        q.admit(j1)
+        q.admit(j2)
+        with pytest.raises(TenantQuotaExceeded, match="'t'"):
+            q.admit(Job(source="c", tenant="t"))
+        q.admit(Job(source="c", tenant="other"))     # other tenants fine
+        popped = q.next(timeout=0.1)
+        assert popped is j1
+        # popped-but-running still counts against the quota ...
+        with pytest.raises(TenantQuotaExceeded):
+            q.admit(Job(source="d", tenant="t"))
+        q.release(j1)                                # ... until released
+        q.admit(Job(source="d", tenant="t"))
+
+    def test_close_wakes_waiters(self):
+        q = JobQueue(depth=2)
+        out = []
+        t = threading.Thread(target=lambda: out.append(q.next(timeout=30)))
+        t.start()
+        time.sleep(0.05)
+        q.close()
+        t.join(timeout=5)
+        assert not t.is_alive() and out == [None]
+        from tpuprof.serve.jobs import QueueClosed
+        with pytest.raises(QueueClosed):
+            q.admit(Job(source="x"))
+
+
+# ---------------------------------------------------------------------------
+# scheduler end-to-end (serve/scheduler.py)
+# ---------------------------------------------------------------------------
+
+class TestSchedulerEndToEnd:
+    def test_concurrent_mixed_shape_jobs_match_one_shot_cli(
+            self, parquet_path, tmp_path):
+        """The acceptance lane: N=4 concurrent mixed-shape jobs through
+        ONE warm mesh — stats byte-identical to the same profiles run
+        via the one-shot path, HTML identical modulo the wall-clock
+        footer line."""
+        cfg_full = {"batch_rows": 1024}
+        cfg_proj = {"batch_rows": 1024, "columns": ("a", "b")}
+        with ProfileScheduler(workers=2) as sched:
+            jobs = []
+            for k in range(4):
+                kw = cfg_full if k % 2 == 0 else cfg_proj
+                jobs.append(sched.submit(
+                    source=parquet_path,
+                    output=str(tmp_path / f"serve_{k}.html"),
+                    stats_json=str(tmp_path / f"serve_{k}.json"),
+                    tenant=f"tenant{k % 2}", config_kwargs=dict(kw)))
+            for job in jobs:
+                sched.wait(job, timeout=600)
+            assert [j.state for j in jobs] == [DONE] * 4
+            st = sched.stats()
+            assert st["done"] == 4 and st["failed"] == 0
+            assert st["p50_s"] <= st["p99_s"]
+        for k, kw in ((0, cfg_full), (1, cfg_proj)):
+            report = ProfileReport(
+                parquet_path,
+                config=ProfilerConfig(backend="tpu", **kw))
+            one_html = str(tmp_path / f"oneshot_{k}.html")
+            report.to_file(one_html)
+            served = open(str(tmp_path / f"serve_{k}.html")).read()
+            assert _strip_perf(served) == \
+                _strip_perf(open(one_html).read())
+            served_stats = json.load(
+                open(str(tmp_path / f"serve_{k}.json")))
+            assert served_stats == report.to_json_dict()
+
+    def test_repeat_fingerprint_jobs_hit_the_cache(self, parquet_path,
+                                                   tmp_path):
+        with ProfileScheduler(workers=1) as sched:
+            j1 = sched.submit(source=parquet_path,
+                              output=str(tmp_path / "r1.html"),
+                              config_kwargs={"batch_rows": 1024})
+            sched.wait(j1, timeout=600)
+            t0 = time.perf_counter()
+            j2 = sched.submit(source=parquet_path,
+                              output=str(tmp_path / "r2.html"),
+                              config_kwargs={"batch_rows": 1024})
+            sched.wait(j2, timeout=600)
+            warm = time.perf_counter() - t0
+            assert j2.state == DONE
+            # the acceptance bar: repeat-fingerprint jobs probe HOT
+            assert j2.cache_hit is True
+            # and the warm path must be fast in absolute terms too —
+            # generous bound (cold start is tens of seconds of compile)
+            assert warm < 30
+
+    def test_invalid_config_rejects_at_admission(self, parquet_path):
+        with ProfileScheduler(workers=1) as sched:
+            j = sched.submit(source=parquet_path,
+                             config_kwargs={"bogus_option": 1})
+            assert j.state == REJECTED
+            assert "unknown config options" in j.error
+            j2 = sched.submit(source=parquet_path,
+                              config_kwargs={"backend": "cpu"})
+            assert j2.state == REJECTED and "tpu engine" in j2.error
+            j3 = sched.submit(source=parquet_path,
+                              config_kwargs={"bins": 0})
+            assert j3.state == REJECTED       # config validation spoke
+            assert sched.stats()["rejected"] == 3
+
+    def test_failed_job_does_not_kill_the_daemon(self, parquet_path,
+                                                 tmp_path):
+        with ProfileScheduler(workers=1) as sched:
+            bad = sched.submit(source=str(tmp_path / "missing.parquet"),
+                               config_kwargs={"batch_rows": 1024})
+            sched.wait(bad, timeout=600)
+            assert bad.state == FAILED and bad.error
+            good = sched.submit(source=parquet_path,
+                                output=str(tmp_path / "ok.html"),
+                                config_kwargs={"batch_rows": 1024})
+            sched.wait(good, timeout=600)
+            assert good.state == DONE
+            assert os.path.exists(str(tmp_path / "ok.html"))
+
+    def test_snapshot_and_heartbeat_shapes(self, parquet_path, tmp_path):
+        with ProfileScheduler(workers=1) as sched:
+            j = sched.submit(source=parquet_path,
+                             output=str(tmp_path / "s.html"),
+                             config_kwargs={"batch_rows": 1024})
+            sched.wait(j, timeout=600)
+            snap = sched.snapshot()
+            assert snap["queued"] == 0
+            assert snap["counts"]["done"] == 1
+            assert any(w["id"] == j.id for w in snap["recent"])
+            hb = sched.heartbeat()
+            assert hb["requests"] == 1 and hb["done"] == 1
+
+
+# ---------------------------------------------------------------------------
+# spool daemon + CLI (serve/server.py, cli.py)
+# ---------------------------------------------------------------------------
+
+class TestServeDaemon:
+    def test_spool_round_trip_once(self, parquet_path, tmp_path):
+        from tpuprof.serve import ServeDaemon, read_result, write_job
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "r.html")
+        jid = write_job(spool, parquet_path, output=out,
+                        config_kwargs={"batch_rows": 1024})
+        daemon = ServeDaemon(spool, workers=1, poll_interval=0.05)
+        try:
+            daemon.run(once=True)
+        finally:
+            daemon.close()
+        result = read_result(spool, jid)
+        assert result["status"] == "done"
+        assert result["schema"] == "tpuprof-serve-result-v1"
+        assert result["rows"] == 3000 and result["cols"] == 3
+        assert os.path.exists(out)
+        # the request file was consumed — a daemon restart re-runs
+        # nothing that already answered
+        assert os.listdir(os.path.join(spool, "jobs")) == []
+
+    def test_corrupt_job_file_answers_rejected(self, tmp_path):
+        from tpuprof.serve import ServeDaemon, read_result
+        spool = str(tmp_path / "spool")
+        os.makedirs(os.path.join(spool, "jobs"), exist_ok=True)
+        with open(os.path.join(spool, "jobs", "garbage.json"), "w") as fh:
+            fh.write("{not json")
+        daemon = ServeDaemon(spool, workers=1, poll_interval=0.05)
+        try:
+            daemon.run(once=True)
+        finally:
+            daemon.close()
+        result = read_result(spool, "garbage")
+        assert result["status"] == "rejected"
+        assert "unreadable job file" in result["error"]
+
+    @pytest.mark.smoke
+    def test_cli_submit_then_serve_once(self, parquet_path, tmp_path,
+                                        capsys):
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "r.html")
+        stats_json = str(tmp_path / "s.json")
+        rc = main(["submit", spool, parquet_path, "-o", out,
+                   "--batch-rows", "1024", "--stats-json", stats_json,
+                   "--no-wait"])
+        assert rc == 0
+        jid = capsys.readouterr().out.strip()
+        assert jid
+        rc = main(["serve", spool, "--once", "--serve-workers", "1",
+                   "--no-compile-cache"])
+        assert rc == 0
+        err = capsys.readouterr().err
+        assert "served 1 jobs" in err and "1 done" in err
+        from tpuprof.serve import read_result
+        assert read_result(spool, jid)["status"] == "done"
+        payload = json.load(open(stats_json))
+        assert payload["schema"] == "tpuprof-stats-v1"
+        assert payload["table"]["n"] == 3000
+
+    @pytest.mark.smoke
+    def test_cli_submit_wait_against_live_daemon(self, parquet_path,
+                                                 tmp_path, capsys):
+        from tpuprof.serve import ServeDaemon
+        spool = str(tmp_path / "spool")
+        daemon = ServeDaemon(spool, workers=1, poll_interval=0.05)
+        t = threading.Thread(target=daemon.run, daemon=True)
+        t.start()
+        try:
+            rc = main(["submit", spool, parquet_path,
+                       "-o", str(tmp_path / "r.html"),
+                       "--batch-rows", "1024", "--timeout", "600"])
+            assert rc == 0
+            assert "rows" in capsys.readouterr().err
+            # a rejected job speaks the CLI bad-request convention
+            rc = main(["submit", spool, parquet_path,
+                       "--config-json", '{"bogus": 1}',
+                       "--timeout", "600"])
+            assert rc == 2
+            assert "rejected" in capsys.readouterr().err
+        finally:
+            daemon.stop_event.set()
+            t.join(timeout=10)
+            daemon.close()
+
+    def test_submit_bad_config_json_is_local_error(self, parquet_path,
+                                                   tmp_path, capsys):
+        rc = main(["submit", str(tmp_path / "spool"), parquet_path,
+                   "--config-json", "{broken"])
+        assert rc == 2
+        assert "--config-json" in capsys.readouterr().err
+
+    @pytest.mark.smoke
+    def test_daemon_process_drains_on_sigterm(self, parquet_path,
+                                              tmp_path):
+        """The daemon lifecycle as a real process: serve, answer one
+        job, then SIGTERM drains (results + .prom flushed) and exits 0
+        — NOT the flight recorder's die-by-signal disposition, which
+        is for crashed profiles, not routine daemon stops."""
+        import subprocess
+        import sys as _sys
+
+        from tpuprof.serve import wait_result, write_job
+        spool = str(tmp_path / "spool")
+        metrics = str(tmp_path / "m.jsonl")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "tpuprof", "serve", spool,
+             "--serve-workers", "1", "--no-compile-cache",
+             "--metrics-json", metrics],
+            env=env, cwd=repo, stderr=subprocess.PIPE, text=True)
+        try:
+            jid = write_job(spool, parquet_path,
+                            output=str(tmp_path / "r.html"),
+                            config_kwargs={"batch_rows": 1024})
+            result = wait_result(spool, jid, timeout=420)
+            assert result["status"] == "done"
+            proc.terminate()                 # SIGTERM
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == 0          # graceful, not -SIGTERM
+        stderr = proc.stderr.read()
+        assert "served 1 jobs" in stderr and "1 done" in stderr
+        prom = open(metrics + ".prom").read()
+        assert 'tpuprof_serve_requests_total{status="done"} 1' in prom
+        assert "tpuprof_serve_compile_cache_misses_total" in prom
+
+
+# ---------------------------------------------------------------------------
+# signal handlers: idempotent install + SIGUSR1 queue snapshot
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not hasattr(signal, "SIGUSR1"),
+                    reason="platform without SIGUSR1")
+class TestDaemonSignals:
+    def test_install_is_idempotent(self):
+        from tpuprof.obs import blackbox
+        prev_usr1 = signal.getsignal(signal.SIGUSR1)
+        prev_term = signal.getsignal(signal.SIGTERM)
+        try:
+            assert blackbox.install_signal_handlers()
+            h_term = signal.getsignal(signal.SIGTERM)
+            h_usr1 = signal.getsignal(signal.SIGUSR1)
+            # a daemon re-invoking install (per job, per reload) must
+            # keep the SAME handler objects — no closure stacking
+            assert blackbox.install_signal_handlers()
+            assert signal.getsignal(signal.SIGTERM) is h_term
+            assert signal.getsignal(signal.SIGUSR1) is h_usr1
+        finally:
+            signal.signal(signal.SIGUSR1, prev_usr1)
+            signal.signal(signal.SIGTERM, prev_term)
+
+    def test_sigusr1_dump_includes_queue_snapshot(self, parquet_path,
+                                                  tmp_path, monkeypatch):
+        from tpuprof.obs import blackbox
+        monkeypatch.setenv("TPUPROF_POSTMORTEM_DIR", str(tmp_path))
+        prev_usr1 = signal.getsignal(signal.SIGUSR1)
+        prev_term = signal.getsignal(signal.SIGTERM)
+        try:
+            with ProfileScheduler(workers=1) as sched:
+                j = sched.submit(source=parquet_path,
+                                 output=str(tmp_path / "r.html"),
+                                 config_kwargs={"batch_rows": 1024})
+                sched.wait(j, timeout=600)
+                assert blackbox.install_signal_handlers()
+                os.kill(os.getpid(), signal.SIGUSR1)
+                out = tmp_path / \
+                    f"tpuprof-postmortem-{os.getpid()}.json"
+                assert out.exists()
+                bundle = json.load(open(out))
+                # the satellite's contract: a SIGUSR1 postmortem from a
+                # serve process carries the LIVE job-queue snapshot
+                queue = bundle["context"]["serve_queue"]
+                assert queue["queued"] == 0
+                assert queue["counts"]["done"] == 1
+                assert any(w["id"] == j.id for w in queue["recent"])
+        finally:
+            signal.signal(signal.SIGUSR1, prev_usr1)
+            signal.signal(signal.SIGTERM, prev_term)
+
+    def test_provider_unregistered_after_shutdown(self, tmp_path,
+                                                  monkeypatch):
+        from tpuprof.obs import blackbox
+        sched = ProfileScheduler(workers=1)
+        provider = sched._context_provider
+        assert provider in blackbox._providers
+        sched.shutdown()
+        assert provider not in blackbox._providers
+        # and a dump after shutdown carries no stale serve context
+        monkeypatch.setenv("TPUPROF_POSTMORTEM_DIR", str(tmp_path))
+        out = blackbox.dump_postmortem(reason="test")
+        if out:                         # recorder may be env-disabled
+            assert "serve_queue" not in json.load(open(out))["context"]
+
+    def test_broken_provider_never_breaks_the_dump(self, tmp_path,
+                                                   monkeypatch):
+        from tpuprof.obs import blackbox
+
+        def boom():
+            raise RuntimeError("provider exploded")
+
+        blackbox.register_context_provider(boom)
+        try:
+            monkeypatch.setenv("TPUPROF_POSTMORTEM_DIR", str(tmp_path))
+            out = blackbox.dump_postmortem(reason="test")
+            assert out is not None      # the dump itself survived
+            bundle = json.load(open(out))
+            assert "context_provider_error" in bundle["context"]
+        finally:
+            blackbox.unregister_context_provider(boom)

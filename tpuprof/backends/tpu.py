@@ -701,8 +701,16 @@ class TPUStatsBackend:
             # would demand identical inputs and dispatch counts on every
             # process, which striped ingest cannot provide)
             devices = jax.local_devices()
-        runner = MeshRunner(config, plan.n_num, plan.n_hash,
-                            devices=devices)
+        # runner construction is a cache lookup (tpuprof/serve/cache.py):
+        # a repeat-fingerprint profile in this process reuses the SAME
+        # runner object, whose jit wrappers already hold their compiled
+        # executables — the warm-mesh half of `tpuprof serve`, and the
+        # fix for the PR-6 drift-leg jaxlib aborts (repeated rebuilds
+        # with the persistent compile cache on).  TPUPROF_RUNNER_CACHE=0
+        # restores a fresh build per collect.
+        from tpuprof.serve.cache import acquire_runner
+        runner = acquire_runner(config, plan.n_num, plan.n_hash,
+                                devices=devices)
         # host batches are padded to the runner's device-divisible row
         # count (chunks are <= batch_rows <= runner.rows by construction)
         pad = runner.rows

@@ -1,7 +1,11 @@
-"""Command-line interface: ``tpuprof profile data.parquet -o report.html``
-and ``tpuprof diff A.json B.json -o drift.html`` (SURVEY.md §7.1 stage 7;
-the reference has no CLI — notebook-only — so these are capabilities the
-TPU framework adds for batch/cluster/fleet use)."""
+"""Command-line interface: ``tpuprof profile data.parquet -o report.html``,
+``tpuprof diff A.json B.json -o drift.html``, and the profile-as-a-service
+pair — ``tpuprof serve SPOOL`` (resident daemon holding the warm mesh +
+compiled-program cache) / ``tpuprof submit SPOOL source -o out.html``
+(SURVEY.md §7.1 stage 7; the reference has no CLI — notebook-only — so
+these are capabilities the TPU framework adds for batch/cluster/fleet
+use).  Job lifecycle itself lives in tpuprof/serve — the CLI is one
+client of that scheduler, not its owner."""
 
 from __future__ import annotations
 
@@ -254,6 +258,85 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-compile-cache", action="store_true",
         help="disable the persistent compilation cache")
 
+    s = sub.add_parser(
+        "serve", help="resident profile daemon: hold the mesh + the "
+                      "compiled-program cache warm and answer `tpuprof "
+                      "submit` jobs from a spool directory in "
+                      "sub-seconds instead of a 20-40s cold start each")
+    s.add_argument("spool", help="spool directory (jobs/ + results/); "
+                                 "clients on this host — or shared "
+                                 "storage — drop requests here")
+    s.add_argument("--serve-workers", type=int, default=None, metavar="N",
+                   help="concurrent jobs on the one warm mesh (host "
+                        "prep of job B overlaps job A's device folds; "
+                        "default: TPUPROF_SERVE_WORKERS, else 2)")
+    s.add_argument("--serve-queue-depth", type=int, default=None,
+                   metavar="N",
+                   help="admission bound: jobs queued beyond the "
+                        "running set before submits REJECT (default: "
+                        "TPUPROF_SERVE_QUEUE_DEPTH, else 32)")
+    s.add_argument("--serve-tenant-quota", type=int, default=None,
+                   metavar="N",
+                   help="per-tenant queued+running cap; 0 = unlimited "
+                        "(default: TPUPROF_SERVE_TENANT_QUOTA, else 0)")
+    s.add_argument("--once", action="store_true",
+                   help="answer the spool's current jobs, then exit "
+                        "(CI / cron mode; default: serve forever)")
+    s.add_argument("--poll-interval", type=float, default=0.2,
+                   metavar="SEC", help="spool scan cadence")
+    s.add_argument("--metrics-json", metavar="PATH",
+                   help="stream serve + pipeline JSONL events here and "
+                        "dump PATH.prom on exit (OBSERVABILITY.md "
+                        "'Profile-as-a-service')")
+    s.add_argument("--metrics-interval", type=float, default=0.0,
+                   metavar="SEC",
+                   help="with --metrics-json: periodic snapshot cadence")
+    s.add_argument("--progress", action="store_true",
+                   help="one-line pipeline/queue status to stderr every "
+                        "few seconds")
+    serve_cache = s.add_mutually_exclusive_group()
+    serve_cache.add_argument(
+        "--compile-cache", metavar="DIR", default=None,
+        help="persistent XLA cache for the daemon's FIRST program "
+             "build, so a restarted daemon re-warms from disk "
+             "(default: ~/.cache/tpuprof/xla; later builds are gated "
+             "per-process — see serve/cache.py)")
+    serve_cache.add_argument("--no-compile-cache", action="store_true",
+                             help="disable the persistent cache")
+
+    u = sub.add_parser(
+        "submit", help="hand one profile job to a running `tpuprof "
+                       "serve` daemon and (by default) wait for its "
+                       "result")
+    u.add_argument("spool", help="the daemon's spool directory")
+    u.add_argument("source", help="Parquet file/directory path")
+    u.add_argument("-o", "--output", default=None,
+                   help="output HTML path (default: none — submit "
+                        "--stats-json or --artifact instead for "
+                        "machine consumers)")
+    u.add_argument("--tenant", default="default",
+                   help="quota bucket this job bills against")
+    u.add_argument("--bins", type=int, default=None)
+    u.add_argument("--batch-rows", type=int, default=None)
+    u.add_argument("--columns", metavar="A,B,C",
+                   help="profile only these columns (the profile "
+                        "subcommand's idiom)")
+    u.add_argument("--single-pass", action="store_true",
+                   help="one scan only (sketch-derived histograms)")
+    u.add_argument("--stats-json", metavar="PATH",
+                   help="dump the tpuprof-stats-v1 JSON here")
+    u.add_argument("--artifact", metavar="PATH",
+                   help="persist a CRC-sealed stats artifact here")
+    u.add_argument("--config-json", metavar="JSON|@FILE",
+                   help="extra ProfilerConfig kwargs as inline JSON or "
+                        "@path-to-file — the escape hatch for options "
+                        "without a submit flag (unknown keys REJECT)")
+    u.add_argument("--no-wait", action="store_true",
+                   help="enqueue and print the job id without waiting")
+    u.add_argument("--timeout", type=float, default=None, metavar="SEC",
+                   help="give up waiting after SEC (the job keeps "
+                        "running server-side)")
+
     d = sub.add_parser(
         "diff", help="compare two stats artifacts and report per-column "
                      "drift (PSI/KS from stored histograms, distinct/"
@@ -314,6 +397,153 @@ def cmd_diff(args: argparse.Namespace) -> int:
     return 0
 
 
+def _resolve_cache_dir(args: argparse.Namespace):
+    """Shared by ``profile`` and ``serve``: --no-compile-cache actively
+    disables, an explicit --compile-cache wins, else the XDG default."""
+    if args.no_compile_cache:
+        # actively clear: a prior in-process run (or wrapper) may have
+        # pointed jax at a directory, and "disabled" must mean no writes
+        from tpuprof.backends.tpu import disable_compile_cache
+        disable_compile_cache()
+        return None
+    if args.compile_cache:
+        return args.compile_cache
+    import os
+    # `or` (not a .get default): the XDG spec treats an EMPTY
+    # XDG_CACHE_HOME as unset, and '' would yield a cwd-relative dir
+    return os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.expanduser("~/.cache"),
+        "tpuprof", "xla")
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    from tpuprof import obs
+    from tpuprof.obs import blackbox
+    from tpuprof.serve import ServeDaemon
+
+    # idempotent by contract (ISSUE 9 satellite): a daemon re-invoking
+    # install per job/config reload wraps the handlers exactly once,
+    # and SIGUSR1 postmortems carry the live job-queue snapshot via the
+    # scheduler's dump-time context provider
+    blackbox.install_signal_handlers()
+    cache_dir = _resolve_cache_dir(args)
+    if cache_dir:
+        from tpuprof.backends.tpu import _enable_compile_cache
+        _enable_compile_cache(cache_dir)
+    ticker = None
+    if args.metrics_json or args.progress:
+        obs.configure(enabled=True, jsonl_path=args.metrics_json)
+        interval = args.metrics_interval or (5.0 if args.progress else 0.0)
+        if interval > 0:
+            from tpuprof.obs.progress import Ticker
+            ticker = Ticker(interval, progress=args.progress,
+                            snapshots=bool(args.metrics_json)).start()
+    daemon = ServeDaemon(args.spool, poll_interval=args.poll_interval,
+                         workers=args.serve_workers,
+                         queue_depth=args.serve_queue_depth,
+                         tenant_quota=args.serve_tenant_quota)
+    sched = daemon.scheduler
+    # a daemon drains on SIGTERM (finish running jobs, flush results +
+    # the .prom dump, exit 0) — overriding the flight recorder's
+    # dump-and-die-by-signal disposition, which is right for a crashed
+    # PROFILE but turns a routine daemon stop into a signal death with
+    # a postmortem.  SIGUSR1 keeps the recorder's dump-and-continue
+    # (now carrying the live queue snapshot).
+    import signal as _signal
+
+    def _graceful(signum, frame):
+        blackbox.record("signal", name="SIGTERM", action="drain")
+        daemon.stop_event.set()
+
+    try:
+        _signal.signal(_signal.SIGTERM, _graceful)
+    except (ValueError, OSError):
+        pass                    # non-main thread: rely on stop_event
+    print(f"tpuprof: serving {args.spool} — {sched.workers} workers, "
+          f"queue depth {sched._queue.depth}, tenant quota "
+          f"{sched._queue.tenant_quota or 'unlimited'}"
+          + (" (once)" if args.once else ""), file=sys.stderr)
+    try:
+        daemon.run(once=args.once)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        daemon.close()
+        if ticker is not None:
+            ticker.stop()
+        if args.metrics_json:
+            obs.finalize(reason="serve")
+            with open(args.metrics_json + ".prom", "w") as fh:
+                fh.write(obs.registry().render_text())
+    st = sched.stats()
+    print(f"tpuprof: served {st['requests']} jobs "
+          f"({st['done']} done, {st['failed']} failed, "
+          f"{st['rejected']} rejected) · p50 {st['p50_s']}s "
+          f"p99 {st['p99_s']}s · compile cache "
+          f"{st['cache']['hits']}/{st['cache']['hits'] + st['cache']['misses']} hits",
+          file=sys.stderr)
+    return 0
+
+
+def cmd_submit(args: argparse.Namespace) -> int:
+    from tpuprof.serve import wait_result, write_job
+
+    config = {}
+    if args.bins is not None:
+        config["bins"] = args.bins
+    if args.batch_rows is not None:
+        config["batch_rows"] = args.batch_rows
+    if args.columns is not None:
+        cols = tuple(c.strip() for c in args.columns.split(",")
+                     if c.strip())
+        config["columns"] = cols
+    if args.single_pass:
+        config["exact_passes"] = False
+    if args.config_json:
+        raw = args.config_json
+        try:
+            if raw.startswith("@"):
+                with open(raw[1:]) as fh:
+                    extra = json.load(fh)
+            else:
+                extra = json.loads(raw)
+            if not isinstance(extra, dict):
+                raise ValueError("must be a JSON object")
+        except (OSError, ValueError) as exc:
+            print(f"tpuprof: error: --config-json: {exc}",
+                  file=sys.stderr)
+            return 2
+        config.update(extra)
+    job_id = write_job(args.spool, args.source, output=args.output,
+                       tenant=args.tenant, stats_json=args.stats_json,
+                       artifact=args.artifact, config_kwargs=config)
+    if args.no_wait:
+        print(job_id)
+        return 0
+    try:
+        result = wait_result(args.spool, job_id, timeout=args.timeout)
+    except TimeoutError as exc:
+        print(f"tpuprof: error: {exc}", file=sys.stderr)
+        return 4                    # the watchdog-shaped failure
+    status = result.get("status")
+    if status == "done":
+        rows = result.get("rows")
+        rows_s = f"{rows:,}" if isinstance(rows, int) else "?"
+        print(f"tpuprof: job {job_id}: {rows_s} rows "
+              f"x {result.get('cols', '?')} cols -> "
+              f"{result.get('output') or args.stats_json or '(no output)'}"
+              f" in {result.get('seconds', 0)}s "
+              f"(queued {result.get('queue_seconds', 0)}s)",
+              file=sys.stderr)
+        return 0
+    print(f"tpuprof: error: job {job_id} {status}: "
+          f"{result.get('error', 'unknown')}", file=sys.stderr)
+    if status == "rejected":
+        return 2                    # the CLI's bad-request convention
+    return int(result.get("exit_code") or 1)
+
+
 def cmd_profile(args: argparse.Namespace) -> int:
     from tpuprof import ProfileReport, ProfilerConfig
     from tpuprof.errors import (CorruptCheckpointError,
@@ -367,22 +597,7 @@ def cmd_profile(args: argparse.Namespace) -> int:
         from tpuprof.runtime.distributed import initialize
         initialize(args.coordinator, args.num_processes, args.process_id)
 
-    if args.no_compile_cache:
-        cache_dir = None
-        # actively clear: a prior in-process run (or wrapper) may have
-        # pointed jax at a directory, and "disabled" must mean no writes
-        from tpuprof.backends.tpu import disable_compile_cache
-        disable_compile_cache()
-    elif args.compile_cache:
-        cache_dir = args.compile_cache
-    else:
-        import os
-        # `or` (not a .get default): the XDG spec treats an EMPTY
-        # XDG_CACHE_HOME as unset, and '' would yield a cwd-relative dir
-        cache_dir = os.path.join(
-            os.environ.get("XDG_CACHE_HOME")
-            or os.path.expanduser("~/.cache"),
-            "tpuprof", "xla")
+    cache_dir = _resolve_cache_dir(args)
 
     columns = None
     if args.columns is not None:
@@ -529,6 +744,10 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "profile":
         return cmd_profile(args)
+    if args.command == "serve":
+        return cmd_serve(args)
+    if args.command == "submit":
+        return cmd_submit(args)
     if args.command == "diff":
         return cmd_diff(args)
     raise AssertionError(args.command)

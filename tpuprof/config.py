@@ -244,6 +244,37 @@ def resolve_liveness_timeout(value: Optional[float] = None) -> float:
     return env if env and env > 0 else 10.0
 
 
+def resolve_serve_workers(value: Optional[int] = None) -> int:
+    """`tpuprof serve` worker threads — concurrent jobs on the one warm
+    mesh (host prep of job B overlaps job A's device folds): an explicit
+    config value wins; else ``TPUPROF_SERVE_WORKERS``; else 2."""
+    if value is not None:
+        return max(int(value), 1)
+    env = _env_int("TPUPROF_SERVE_WORKERS")
+    return max(env, 1) if env is not None else 2
+
+
+def resolve_serve_queue_depth(value: Optional[int] = None) -> int:
+    """Serve admission-queue bound (jobs waiting beyond the running
+    set): explicit config value, else ``TPUPROF_SERVE_QUEUE_DEPTH``,
+    else 32.  A submit past the bound REJECTS immediately — sub-second
+    feedback beats a silently unbounded backlog."""
+    if value is not None:
+        return max(int(value), 1)
+    env = _env_int("TPUPROF_SERVE_QUEUE_DEPTH")
+    return max(env, 1) if env is not None else 32
+
+
+def resolve_serve_tenant_quota(value: Optional[int] = None) -> int:
+    """Per-tenant live-job quota (queued + running): explicit config
+    value, else ``TPUPROF_SERVE_TENANT_QUOTA``, else 0 = unlimited —
+    single-tenant deployments should not have to configure anything."""
+    if value is not None:
+        return max(int(value), 0)
+    env = _env_int("TPUPROF_SERVE_TENANT_QUOTA")
+    return max(env, 0) if env is not None else 0
+
+
 PASS_B_KERNELS = ("cumulative", "legacy")
 
 
@@ -568,6 +599,22 @@ class ProfilerConfig:
                                                 # None = auto:
                                                 # TPUPROF_LIVENESS_
                                                 # TIMEOUT_S env, else 10
+    serve_workers: Optional[int] = None     # `tpuprof serve`: concurrent
+                                            # jobs on the one warm mesh.
+                                            # None = auto:
+                                            # TPUPROF_SERVE_WORKERS env,
+                                            # else 2
+    serve_queue_depth: Optional[int] = None  # serve admission bound
+                                             # (queued beyond running);
+                                             # past it a submit REJECTS.
+                                             # None = auto: TPUPROF_
+                                             # SERVE_QUEUE_DEPTH env,
+                                             # else 32
+    serve_tenant_quota: Optional[int] = None  # per-tenant queued+running
+                                              # cap (0 = unlimited).
+                                              # None = auto: TPUPROF_
+                                              # SERVE_TENANT_QUOTA env,
+                                              # else 0
     prepare_workers: Optional[int] = None   # cross-batch host-prep
                                             # pipeline width (decode/hash/
                                             # pack of DIFFERENT batches in
@@ -712,6 +759,16 @@ class ProfilerConfig:
             v = getattr(self, fname)
             if v is not None and v <= 0:
                 raise ValueError(f"{fname} must be > 0 (or None = off)")
+        if self.serve_workers is not None and self.serve_workers < 1:
+            raise ValueError("serve_workers must be >= 1 (or None)")
+        if self.serve_queue_depth is not None \
+                and self.serve_queue_depth < 1:
+            raise ValueError("serve_queue_depth must be >= 1 (or None)")
+        if self.serve_tenant_quota is not None \
+                and self.serve_tenant_quota < 0:
+            raise ValueError(
+                "serve_tenant_quota must be >= 0 (0 = unlimited; or "
+                "None)")
         if self.metrics_interval < 0:
             raise ValueError("metrics_interval must be >= 0")
         if self.metrics_max_bytes is not None \

@@ -83,15 +83,29 @@ class BlackBox:
             return list(self._ring)
 
     def snapshot(self) -> Dict[str, Any]:
+        # dump-time context providers run OUTSIDE the lock (a provider
+        # may take its own locks — the serve queue snapshot does) and
+        # inside try/except: a postmortem must never crash on the
+        # context it is trying to attach
+        provided: Dict[str, Any] = {}
+        for provider in list(_providers):
+            try:
+                extra = provider()
+                if isinstance(extra, dict):
+                    provided.update(extra)
+            except Exception:
+                provided["context_provider_error"] = repr(provider)
         with self._lock:
             entries = list(self._ring)
-            return {
-                "capacity": self.capacity,
-                "recorded": self._seq,
-                "dropped": max(self._seq - len(entries), 0),
-                "context": dict(self._context),
-                "entries": entries,
-            }
+            context = dict(self._context)
+        context.update(provided)
+        return {
+            "capacity": self.capacity,
+            "recorded": self._seq,
+            "dropped": max(self._seq - len(entries), 0),
+            "context": context,
+            "entries": entries,
+        }
 
     def clear(self) -> None:
         with self._lock:
@@ -164,15 +178,48 @@ def dump_postmortem(error: Optional[BaseException] = None,
                      reason=reason)
 
 
+_providers: List[Any] = []      # dump-time context callables
+
+
+def register_context_provider(fn) -> None:
+    """Attach a callable returning a dict merged into every postmortem's
+    context card AT DUMP TIME (so the snapshot is current, not a stale
+    periodic copy) — e.g. the serve scheduler's live job-queue view.
+    Providers must be quick and are exception-isolated."""
+    if fn not in _providers:
+        _providers.append(fn)
+
+
+def unregister_context_provider(fn) -> None:
+    try:
+        _providers.remove(fn)
+    except ValueError:
+        pass
+
+
+_installed = {"term": None, "usr1": None}   # our live handler objects
+
+
 def install_signal_handlers() -> bool:
     """CLI entry hook: dump the ring on SIGTERM (then die with the
     default disposition, so wrappers still see a signal death) and on
     SIGUSR1 (dump and keep running — live inspection of a wedged
     process).  Returns False when disabled or not installable (non-main
-    thread, platform without the signals)."""
+    thread, platform without the signals).
+
+    Idempotent and daemon-safe: a long-lived `tpuprof serve` process
+    (or a wrapper calling per request) installs the handlers exactly
+    ONCE — a repeat call that finds OUR handler still registered
+    returns True without re-wrapping, so closures never stack and
+    ``signal.getsignal`` stays stable for embedders.  If an embedding
+    host replaced the dispositions since, the call installs afresh
+    (the check is against the live registration, not a sticky flag)."""
     if not _box.enabled:
         return False
     import signal as _signal
+    if _installed["term"] is not None \
+            and _signal.getsignal(_signal.SIGTERM) is _installed["term"]:
+        return True
 
     def _usr1(signum, frame):
         _box.record("signal", name="SIGUSR1")
@@ -193,4 +240,6 @@ def install_signal_handlers() -> bool:
     except (ValueError, OSError):
         # not the main thread, or an embedding host owns the handlers
         return False
+    _installed["term"] = _term
+    _installed["usr1"] = _usr1
     return True

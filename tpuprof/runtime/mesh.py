@@ -47,6 +47,22 @@ from tpuprof.kernels import corr, fused, histogram, hll, moments
 
 Pytree = Any
 
+import threading
+
+# ONE process-wide enqueue lock, shared by every MeshRunner: the mesh
+# programs are collectives over all devices, and two host threads
+# (concurrent `tpuprof serve` jobs) enqueueing different programs can
+# interleave per-device stream order — device 0 sees [A, B], device 1
+# sees [B, A] — deadlocking XLA's cross-device rendezvous (observed
+# intermittently on the 8-fake-device CPU mesh driving concurrent
+# serve jobs).  Holding this lock across each ENQUEUE (never across a
+# blocking fetch/wait) keeps every device's program order identical,
+# which is all the rendezvous needs; host-side prep keeps overlapping
+# freely.  Single-threaded profiles pay one uncontended lock per
+# dispatch (~100 ns against ~ms programs).  RLock: dispatch helpers
+# nest (step_b -> put_replicated, step_a -> put_batch).
+_DISPATCH_LOCK = threading.RLock()
+
 
 class DeviceBatch(NamedTuple):
     """A host batch explicitly placed on the mesh.
@@ -165,10 +181,11 @@ class MeshRunner:
         for wide categorical tables it is a large share of the transfer
         volume."""
         xt, rv, ht = self._host_views(hb, with_hll)
-        return DeviceBatch(
-            jax.device_put(xt, self._sh_cols_rows),
-            jax.device_put(rv, self._sh_rows),
-            jax.device_put(ht, self._sh_cols_rows))
+        with _DISPATCH_LOCK:
+            return DeviceBatch(
+                jax.device_put(xt, self._sh_cols_rows),
+                jax.device_put(rv, self._sh_rows),
+                jax.device_put(ht, self._sh_cols_rows))
 
     def stage_batches(self, hbs, with_hll: bool = True) -> "StackedBatch":
         """Ship several HostBatches as ONE stacked placement so they can be
@@ -176,20 +193,25 @@ class MeshRunner:
         because per-program dispatch latency (~15ms through a tunneled
         device) would otherwise dominate the fused step's compute."""
         views = [self._host_views(hb, with_hll) for hb in hbs]
-        return StackedBatch(
-            jax.device_put(np.stack([v[0] for v in views]),
-                           NamedSharding(self.mesh, P(None, None, "data"))),
-            jax.device_put(np.stack([v[1] for v in views]),
-                           NamedSharding(self.mesh, P(None, "data"))),
-            jax.device_put(np.stack([v[2] for v in views]),
-                           NamedSharding(self.mesh, P(None, None, "data"))),
-            len(hbs))
+        with _DISPATCH_LOCK:
+            return StackedBatch(
+                jax.device_put(
+                    np.stack([v[0] for v in views]),
+                    NamedSharding(self.mesh, P(None, None, "data"))),
+                jax.device_put(
+                    np.stack([v[1] for v in views]),
+                    NamedSharding(self.mesh, P(None, "data"))),
+                jax.device_put(
+                    np.stack([v[2] for v in views]),
+                    NamedSharding(self.mesh, P(None, None, "data"))),
+                len(hbs))
 
     def scan_a(self, state: Pytree, sb: "StackedBatch") -> Pytree:
         """Fold ``sb.n_batches`` staged batches in one compiled dispatch."""
-        return fused.observe_dispatch(
-            "scan_a", self._scan_a(state, sb.xts, sb.row_valids, sb.hllts),
-            batches=sb.n_batches)
+        with _DISPATCH_LOCK:
+            out = self._scan_a(state, sb.xts, sb.row_valids, sb.hllts)
+        return fused.observe_dispatch("scan_a", out,
+                                      batches=sb.n_batches)
 
     def put_replicated(self, arr, dtype=None):
         """Place a small constant (e.g. histogram lo/hi/mean) once, so the
@@ -199,7 +221,8 @@ class MeshRunner:
             return arr
         a = np.asarray(arr, dtype=dtype) if dtype is not None \
             else np.asarray(arr)
-        return jax.device_put(a, self._sh_rep)
+        with _DISPATCH_LOCK:
+            return jax.device_put(a, self._sh_rep)
 
     # -- state ------------------------------------------------------------
 
@@ -229,11 +252,14 @@ class MeshRunner:
                 "corr": co,
                 "hll": hll.init(self.n_hash, self.precision),
             }
-        return jax.vmap(one_device)(jnp.arange(self.n_dev))
+        with _DISPATCH_LOCK:
+            return jax.vmap(one_device)(jnp.arange(self.n_dev))
 
     def init_pass_b(self) -> Pytree:
-        return jax.vmap(lambda _: histogram.init(self.n_num, self.bins))(
-            jnp.arange(self.n_dev))
+        with _DISPATCH_LOCK:
+            return jax.vmap(
+                lambda _: histogram.init(self.n_num, self.bins))(
+                jnp.arange(self.n_dev))
 
     def place_state(self, state: Pytree) -> Pytree:
         """Commit host-numpy state leaves onto the mesh with the step
@@ -247,8 +273,9 @@ class MeshRunner:
         # P("data") shards axis 0 and leaves trailing axes whole — the
         # same per-leaf layout the shard_map out_specs produce
         sh = NamedSharding(self.mesh, P("data"))
-        return jax.tree.map(
-            lambda a: jax.device_put(np.asarray(a), sh), state)
+        with _DISPATCH_LOCK:
+            return jax.tree.map(
+                lambda a: jax.device_put(np.asarray(a), sh), state)
 
     # -- compiled programs -------------------------------------------------
 
@@ -484,32 +511,36 @@ class MeshRunner:
 
         ``step_idx`` is accepted for caller convenience (cursor-style
         loops); the update itself is deterministic and order-free."""
-        db = self._as_device(hb)
-        return fused.observe_dispatch(
-            "step_a", self._step_a(state, db.xt, db.row_valid, db.hllt))
+        with _DISPATCH_LOCK:
+            db = self._as_device(hb)
+            out = self._step_a(state, db.xt, db.row_valid, db.hllt)
+        return fused.observe_dispatch("step_a", out)
 
     def step_b(self, state: Pytree, hb, lo, hi, mean) -> Pytree:
-        db = self._as_device(hb)
-        return fused.observe_dispatch(
-            "step_b",
-            self._step_b(state, db.xt, db.row_valid,
-                         self.put_replicated(lo, dtype=jnp.float32),
-                         self.put_replicated(hi, dtype=jnp.float32),
-                         self.put_replicated(mean, dtype=jnp.float32)),
-            kernel=self.pass_b_kernel)
+        with _DISPATCH_LOCK:
+            db = self._as_device(hb)
+            out = self._step_b(
+                state, db.xt, db.row_valid,
+                self.put_replicated(lo, dtype=jnp.float32),
+                self.put_replicated(hi, dtype=jnp.float32),
+                self.put_replicated(mean, dtype=jnp.float32))
+        return fused.observe_dispatch("step_b", out,
+                                      kernel=self.pass_b_kernel)
 
     def scan_b(self, state: Pytree, sb: "StackedBatch", lo, hi,
                mean) -> Pytree:
         """Fold ``sb.n_batches`` staged batches into the pass-B state in
         one compiled dispatch (stage with ``with_hll=False`` — pass B
         never reads the packed plane)."""
-        return fused.observe_dispatch(
-            "scan_b",
-            self._scan_b(state, sb.xts, sb.row_valids,
-                         self.put_replicated(lo, dtype=jnp.float32),
-                         self.put_replicated(hi, dtype=jnp.float32),
-                         self.put_replicated(mean, dtype=jnp.float32)),
-            batches=sb.n_batches, kernel=self.pass_b_kernel)
+        with _DISPATCH_LOCK:
+            out = self._scan_b(
+                state, sb.xts, sb.row_valids,
+                self.put_replicated(lo, dtype=jnp.float32),
+                self.put_replicated(hi, dtype=jnp.float32),
+                self.put_replicated(mean, dtype=jnp.float32))
+        return fused.observe_dispatch("scan_b", out,
+                                      batches=sb.n_batches,
+                                      kernel=self.pass_b_kernel)
 
     def init_spearman(self) -> Pytree:
         def one_device(_):
@@ -521,27 +552,30 @@ class MeshRunner:
                                        dtype=jnp.float32)
                 co["set"] = jnp.ones((), dtype=jnp.int32)
             return co
-        return jax.vmap(one_device)(jnp.arange(self.n_dev))
+        with _DISPATCH_LOCK:
+            return jax.vmap(one_device)(jnp.arange(self.n_dev))
 
     def step_spearman(self, state: Pytree, hb, sorted_sample,
                       kept) -> Pytree:
-        db = self._as_device(hb)
-        return self._step_spear(
-            state, db.xt, db.row_valid,
-            self.put_replicated(sorted_sample, dtype=jnp.float32),
-            self.put_replicated(kept, dtype=jnp.int32))
+        with _DISPATCH_LOCK:
+            db = self._as_device(hb)
+            return self._step_spear(
+                state, db.xt, db.row_valid,
+                self.put_replicated(sorted_sample, dtype=jnp.float32),
+                self.put_replicated(kept, dtype=jnp.int32))
 
     def step_spearman_grid(self, state: Pytree, hb, grid) -> Pytree:
         """Pallas-tier Spearman step: ``grid`` is the (n_num, G) host CDF
         grid (RowSampler.cdf_grid).  Narrow widths run one program; wide
         widths dispatch rank transform and rank Gram separately."""
-        db = self._as_device(hb)
-        grid_d = self.put_replicated(grid, dtype=jnp.float32)
-        if self.n_num <= fused.MAX_FUSED_COLS:
-            return self._step_spear_grid(state, db.xt, db.row_valid,
-                                         grid_d)
-        ranks = self._rank_grid(db.xt, db.row_valid, grid_d)
-        return self._step_spear_wide(state, ranks, db.row_valid)
+        with _DISPATCH_LOCK:
+            db = self._as_device(hb)
+            grid_d = self.put_replicated(grid, dtype=jnp.float32)
+            if self.n_num <= fused.MAX_FUSED_COLS:
+                return self._step_spear_grid(state, db.xt, db.row_valid,
+                                             grid_d)
+            ranks = self._rank_grid(db.xt, db.row_valid, grid_d)
+            return self._step_spear_wide(state, ranks, db.row_valid)
 
     def scan_spearman_grid(self, state: Pytree, sb: "StackedBatch",
                            grid) -> Pytree:
@@ -550,19 +584,23 @@ class MeshRunner:
         keeps its two-program-per-batch structure (two pallas calls in
         one module trip scoped-VMEM accounting — PERF.md) but re-reads
         the already-staged device slices, so no host data re-ships."""
-        grid_d = self.put_replicated(grid, dtype=jnp.float32)
-        if self.n_num <= fused.MAX_FUSED_COLS:
-            return self._scan_spear_grid(state, sb.xts, sb.row_valids,
-                                         grid_d)
-        for i in range(sb.n_batches):
-            ranks = self._rank_grid(sb.xts[i], sb.row_valids[i], grid_d)
-            state = self._step_spear_wide(state, ranks, sb.row_valids[i])
-        return state
+        with _DISPATCH_LOCK:
+            grid_d = self.put_replicated(grid, dtype=jnp.float32)
+            if self.n_num <= fused.MAX_FUSED_COLS:
+                return self._scan_spear_grid(state, sb.xts,
+                                             sb.row_valids, grid_d)
+            for i in range(sb.n_batches):
+                ranks = self._rank_grid(sb.xts[i], sb.row_valids[i],
+                                        grid_d)
+                state = self._step_spear_wide(state, ranks,
+                                              sb.row_valids[i])
+            return state
 
     def slice_staged(self, sb: "StackedBatch", i: int) -> DeviceBatch:
         """One staged batch as a DeviceBatch view (device-side slice — a
         per-batch program can consume staged data without re-transfer)."""
-        return DeviceBatch(sb.xts[i], sb.row_valids[i], sb.hllts[i])
+        with _DISPATCH_LOCK:
+            return DeviceBatch(sb.xts[i], sb.row_valids[i], sb.hllts[i])
 
     def wait_ready(self, tree: Pytree, timeout_s=None,
                    heartbeat=None) -> Pytree:
@@ -588,8 +626,10 @@ class MeshRunner:
         return out
 
     def finalize_spearman(self, state: Pytree):
-        return jax.device_get(
-            jax.tree.map(lambda a: a[0], self._merge_spear(state)))
+        with _DISPATCH_LOCK:        # enqueue (merge + slices) only; the
+            sliced = jax.tree.map(  # blocking fetch happens unlocked
+                lambda a: a[0], self._merge_spear(state))
+        return jax.device_get(sliced)
 
     def finalize_a(self, state: Pytree) -> Dict[str, Any]:
         """Collective merge on-device, then pull ONE replica to host."""
@@ -658,9 +698,12 @@ class MeshRunner:
             cached = self._gather_cache[key]
         fn, treedef, spec = cached
         if fn is None:      # non-32-bit dtype somewhere: per-leaf path
-            return jax.device_get(
-                jax.tree.map(lambda a: a[0], merge_fn(state)))
-        buf = np.asarray(jax.device_get(fn(state)))
+            with _DISPATCH_LOCK:
+                sliced = jax.tree.map(lambda a: a[0], merge_fn(state))
+            return jax.device_get(sliced)
+        with _DISPATCH_LOCK:        # enqueue the packed merge program;
+            out = fn(state)         # fetch below blocks unlocked
+        buf = np.asarray(jax.device_get(out))
         leaves, pos = [], 0
         for shape, dtype in spec:
             n_elems = int(np.prod(shape, dtype=np.int64)) if shape else 1
@@ -694,4 +737,5 @@ class MeshRunner:
                         mean.astype(jnp.float32))
             self._bounds_b = jax.jit(
                 f, out_shardings=(self._sh_rep,) * 3)
-        return self._bounds_b(state)
+        with _DISPATCH_LOCK:
+            return self._bounds_b(state)

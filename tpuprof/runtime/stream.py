@@ -32,7 +32,6 @@ from tpuprof.obs import metrics as _obs_metrics
 from tpuprof.obs.progress import RateEMA, fmt_rate
 from tpuprof.runtime import checkpoint as ckpt
 from tpuprof.runtime import guard as _guard
-from tpuprof.runtime.mesh import MeshRunner
 from tpuprof.testing import faults as _faults
 from tpuprof.utils.trace import log_event
 
@@ -129,8 +128,13 @@ class StreamingProfiler:
         self.arrow_schema = arrow_schema
         self.plan = ColumnPlan.from_schema(arrow_schema,
                                            nested=self.config.nested)
-        self.runner = MeshRunner(self.config, self.plan.n_num,
-                                 self.plan.n_hash, devices=devices)
+        # shared keyed runner cache (tpuprof/serve/cache.py): repeated
+        # profilers over one schema in one process — incremental
+        # resumes, serve jobs, bench loops — reuse one compiled runner
+        # instead of re-paying first-dispatch compiles per instance
+        from tpuprof.serve.cache import acquire_runner
+        self.runner = acquire_runner(self.config, self.plan.n_num,
+                                     self.plan.n_hash, devices=devices)
         from tpuprof.backends.tpu import HostAgg
         self.hostagg = HostAgg(self.plan, self.config)
         self.sampler = RowSampler(self.config.quantile_sketch_size,

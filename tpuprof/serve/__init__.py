@@ -1,0 +1,32 @@
+"""Profile-as-a-service (`tpuprof serve`) — ROADMAP item 1.
+
+One resident process per host holds the device mesh and a keyed
+compiled-program cache, so the 20-40 s process-startup + JIT cold start
+is paid once and every warm profile answers in sub-seconds:
+
+* serve/cache.py      keyed MeshRunner cache (config fingerprint fields
+                      + shape signature) + the per-process persistent-
+                      compile-cache gate
+* serve/jobs.py       job state machine + bounded multi-tenant queue
+* serve/scheduler.py  worker pool, SLO metrics, job lifecycle
+* serve/server.py     spool-directory daemon + submit client transport
+
+The CLI (`tpuprof serve` / `tpuprof submit`) is one client of this
+package; embed :class:`ProfileScheduler` directly for in-process use
+(the serve bench does).
+"""
+
+from tpuprof.serve.cache import (RunnerCache, acquire_runner, cache_stats,
+                                 process_cache, runner_key)
+from tpuprof.serve.jobs import (Job, JobQueue, QueueClosed, QueueFull,
+                                TenantQuotaExceeded)
+from tpuprof.serve.scheduler import ProfileScheduler
+from tpuprof.serve.server import (ServeDaemon, read_result, wait_result,
+                                  write_job)
+
+__all__ = [
+    "Job", "JobQueue", "ProfileScheduler", "QueueClosed", "QueueFull",
+    "RunnerCache", "ServeDaemon", "TenantQuotaExceeded",
+    "acquire_runner", "cache_stats", "process_cache", "read_result",
+    "runner_key", "wait_result", "write_job",
+]

@@ -1,0 +1,222 @@
+"""Keyed compiled-program cache — the warm-mesh half of `tpuprof serve`.
+
+Every profile today builds a fresh :class:`~tpuprof.runtime.mesh.MeshRunner`
+whose jit wrappers are new objects, so the in-memory XLA executable cache
+never carries across runs: each fresh build re-pays the ~20-40 s compile
+on first dispatch (PERF.md, ROADMAP item 1).  This module makes runner
+construction a cache lookup instead: runners are keyed on exactly the
+fields the compiled programs depend on — the config's program-relevant
+knobs plus the shape signature ``(n_num, n_hash)`` and the device set —
+so a repeat-fingerprint job reuses the SAME runner object, whose jit
+wrappers already hold their compiled executables.  Reuse is result-safe:
+the cached wrappers resolve to the same executables a fresh build's
+first calls would compile, so outputs are byte-identical (the same
+determinism place_state's byte-stability guarantee rests on).
+
+The cache is process-wide and default-ON (``TPUPROF_RUNNER_CACHE=0``
+restores a build per call; an integer sets the LRU capacity, default 8).
+One-shot CLI profiles see no difference — one build either way; the
+`tpuprof serve` daemon and any in-process re-profile loop (benchmarks,
+notebooks, incremental resume) get sub-second warm starts.
+
+Per-process persistent-compile-cache gate (the PR-6 `drift`-leg fix):
+this box's jaxlib intermittently aborts (abseil mutex / segv) when the
+persistent compilation cache stays enabled across repeated MeshRunner
+builds in one process.  Runner reuse removes most rebuilds; for the
+rest (genuinely new shapes in a long-lived process) the gate lets the
+FIRST cache-enabled build keep the persistent cache — that is the
+cold-start the disk cache exists to amortize across process restarts —
+and disables it before every later build.  ``TPUPROF_COMPILE_CACHE_
+REBUILDS=1`` opts back into the old always-on behavior.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from tpuprof.obs import metrics as _obs_metrics
+
+_CACHE_HITS = _obs_metrics.counter(
+    "tpuprof_serve_compile_cache_hits_total",
+    "profile runs that reused a cached MeshRunner (compiled programs "
+    "warm — no recompile)")
+_CACHE_MISSES = _obs_metrics.counter(
+    "tpuprof_serve_compile_cache_misses_total",
+    "profile runs that had to build (and later compile) a fresh "
+    "MeshRunner")
+
+_ENV = "TPUPROF_RUNNER_CACHE"
+DEFAULT_CAPACITY = 8
+
+
+def _env_capacity() -> int:
+    """``TPUPROF_RUNNER_CACHE``: unset/empty -> default capacity;
+    ``0``/``false``/``no`` -> caching off (a build per call, the
+    pre-serve behavior); any other integer -> that LRU capacity."""
+    raw = os.environ.get(_ENV)
+    if raw in (None, ""):
+        return DEFAULT_CAPACITY
+    if raw.strip().lower() in ("0", "false", "no"):
+        return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+def runner_key(config, n_num: int, n_hash: int,
+               devices: Optional[Sequence] = None) -> Tuple:
+    """The cache key: every config field MeshRunner's compiled programs
+    read — nothing more (so a job differing only in paths/telemetry/
+    budgets still hits) and nothing less (so two keys never share a
+    runner whose programs would differ).  Env-resolved knobs
+    (``pass_b_kernel``) are resolved NOW: the key must capture what a
+    build at this moment would produce, not the raw field."""
+    import jax
+
+    from tpuprof.config import resolve_pass_b_kernel
+    devs = list(devices) if devices is not None else jax.devices()
+    if config.mesh_devices:
+        devs = devs[: config.mesh_devices]
+    return (
+        int(n_num), int(n_hash),
+        tuple((d.platform, d.id) for d in devs),
+        int(config.batch_rows),
+        config.mesh_devices,
+        int(config.hll_precision),
+        int(config.bins),
+        config.use_pallas,
+        resolve_pass_b_kernel(getattr(config, "pass_b_kernel", None)),
+        config.use_fused,
+    )
+
+
+class RunnerCache:
+    """Bounded LRU of live MeshRunner instances, keyed by
+    :func:`runner_key`.  Thread-safe; the build itself runs under the
+    lock — MeshRunner.__init__ only creates jit *wrappers* (compilation
+    is deferred to first dispatch), so a build is milliseconds and two
+    racing workers resolve to ONE shared runner instead of compiling
+    the same programs twice."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = max(int(capacity), 1)
+        self._lock = threading.Lock()
+        self._runners: "collections.OrderedDict[Tuple, Any]" = \
+            collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, config, n_num: int, n_hash: int,
+            devices: Optional[Sequence] = None):
+        key = runner_key(config, n_num, n_hash, devices)
+        with self._lock:
+            runner = self._runners.get(key)
+            if runner is not None:
+                self._runners.move_to_end(key)
+                self.hits += 1
+                _CACHE_HITS.inc()
+                return runner
+            _note_build_with_cache()
+            from tpuprof.runtime.mesh import MeshRunner
+            runner = MeshRunner(config, n_num, n_hash, devices=devices)
+            self._runners[key] = runner
+            while len(self._runners) > self.capacity:
+                self._runners.popitem(last=False)
+            self.misses += 1
+            _CACHE_MISSES.inc()
+            return runner
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {"runners": len(self._runners),
+                    "capacity": self.capacity,
+                    "hits": self.hits, "misses": self.misses,
+                    "hit_rate": self.hits / total if total else 0.0}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._runners.clear()
+            self.hits = 0
+            self.misses = 0
+
+
+# ---------------------------------------------------------------------------
+# process-wide cache + acquire seam (backends/tpu.py, runtime/stream.py)
+# ---------------------------------------------------------------------------
+
+_process_cache = RunnerCache(_env_capacity() or 1)
+
+
+def process_cache() -> RunnerCache:
+    return _process_cache
+
+
+def cache_enabled() -> bool:
+    return _env_capacity() > 0
+
+
+def acquire_runner(config, n_num: int, n_hash: int,
+                   devices: Optional[Sequence] = None):
+    """The ONE seam every profile path builds runners through
+    (``TPUStatsBackend.collect``, ``StreamingProfiler.__init__``, the
+    serve scheduler's jobs).  Cached by default; with the cache
+    disabled it still routes through the compile-cache gate so repeated
+    builds stay abort-safe."""
+    if not cache_enabled():
+        _CACHE_MISSES.inc()
+        _note_build_with_cache()
+        from tpuprof.runtime.mesh import MeshRunner
+        return MeshRunner(config, n_num, n_hash, devices=devices)
+    return _process_cache.get(config, n_num, n_hash, devices=devices)
+
+
+def cache_stats() -> Dict[str, Any]:
+    """Hit/miss view of the process cache — the serve bench's
+    ``serve_cache_hit_rate`` and the scheduler's stats() read this."""
+    return _process_cache.stats()
+
+
+# ---------------------------------------------------------------------------
+# per-process persistent-compile-cache gate (PR-6 drift-leg crash fix)
+# ---------------------------------------------------------------------------
+
+_cached_builds = [0]        # MeshRunner builds with the persistent cache on
+_gate_warned = [False]
+
+
+def _note_build_with_cache() -> None:
+    """Called immediately before every MeshRunner construction.  The
+    first build in a process with jax's persistent compilation cache
+    enabled keeps it; any LATER build disables the cache first —
+    repeated rebuilds with the cache on are the observed jaxlib abort
+    trigger (benchmarks PR 6), and a long-lived daemon must never trade
+    a second shape's compile time for a process abort."""
+    if os.environ.get("TPUPROF_COMPILE_CACHE_REBUILDS") \
+            in ("1", "true", "yes"):
+        return
+    try:
+        import jax
+        current = getattr(jax.config, "jax_compilation_cache_dir", None)
+    except Exception:
+        return
+    if not current:
+        return
+    _cached_builds[0] += 1
+    if _cached_builds[0] <= 1:
+        return
+    from tpuprof.backends.tpu import disable_compile_cache
+    disable_compile_cache()
+    if not _gate_warned[0]:
+        _gate_warned[0] = True
+        from tpuprof.utils.trace import logger
+        logger.info(
+            "persistent compilation cache gated off for this process's "
+            "further program builds (first build kept it): repeated "
+            "MeshRunner rebuilds with the cache enabled intermittently "
+            "abort jaxlib.  Warm starts come from the in-process runner "
+            "cache; set TPUPROF_COMPILE_CACHE_REBUILDS=1 to opt out.")

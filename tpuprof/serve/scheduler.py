@@ -1,0 +1,328 @@
+"""Request scheduler — job lifecycle owner for `tpuprof serve`.
+
+The ROADMAP-named refactor: job orchestration moves OUT of the CLI.
+``cmd_profile`` keeps its one-shot path (byte-unchanged), but a
+long-lived service admits requests through :class:`ProfileScheduler`:
+a bounded multi-tenant queue (serve/jobs.py), N worker threads sharing
+ONE warm mesh via the keyed runner cache (serve/cache.py), and the
+existing obs/heartbeat machinery as the SLO layer — request counters by
+status, queue-depth gauge, an end-to-end latency histogram (p50/p99),
+and a ``serve_job`` JSONL event per terminal job.  The CLI becomes one
+client among many: `tpuprof submit` (serve/server.py) talks to the same
+scheduler a library embedding would.
+
+Fault story: each job runs the SAME ProfileReport path the one-shot CLI
+runs, so the PR-4 degradation ladder (retries, quarantine, watchdogs,
+checkpoint fallback) applies per job, and a typed failure marks THAT
+job failed with its CLI exit code — the daemon and its other tenants
+keep serving.  SIGUSR1 postmortems include the live queue snapshot via
+the flight recorder's context-provider hook (obs/blackbox.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from tpuprof.obs import blackbox
+from tpuprof.obs import events as _obs_events
+from tpuprof.obs import metrics as _obs_metrics
+from tpuprof.serve import cache as _cache
+from tpuprof.serve.jobs import (DONE, FAILED, QUEUED, REJECTED, RUNNING,
+                                TERMINAL, Job, JobQueue, QueueClosed,
+                                QueueFull, TenantQuotaExceeded, percentile)
+
+_REQUESTS = _obs_metrics.counter(
+    "tpuprof_serve_requests_total",
+    "profile requests by terminal status (done|failed|rejected)")
+_QUEUE_DEPTH = _obs_metrics.gauge(
+    "tpuprof_serve_queue_depth",
+    "jobs waiting in the serve admission queue")
+_ACTIVE = _obs_metrics.gauge(
+    "tpuprof_serve_active_jobs", "jobs currently profiling on the mesh")
+_JOB_SECONDS = _obs_metrics.histogram(
+    "tpuprof_serve_job_seconds",
+    "end-to-end job latency (enqueue -> terminal), queue wait included "
+    "— the p50/p99 SLO series")
+
+
+class ProfileScheduler:
+    """N worker threads draining a bounded multi-tenant job queue
+    through one process-wide warm mesh."""
+
+    def __init__(self, workers: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 tenant_quota: Optional[int] = None,
+                 devices: Optional[Sequence] = None):
+        from tpuprof.config import (resolve_serve_queue_depth,
+                                    resolve_serve_tenant_quota,
+                                    resolve_serve_workers)
+        self.workers = resolve_serve_workers(workers)
+        self._queue = JobQueue(resolve_serve_queue_depth(queue_depth),
+                               resolve_serve_tenant_quota(tenant_quota))
+        self._devices = devices
+        self._lock = threading.Lock()
+        self._done_cond = threading.Condition(self._lock)
+        self._jobs: Dict[str, Job] = {}
+        self._active: Dict[str, Job] = {}
+        self._counts = {DONE: 0, FAILED: 0, REJECTED: 0}
+        self._latencies: "collections.deque[float]" = \
+            collections.deque(maxlen=4096)   # done jobs only (SLO view)
+        self._submitted = 0
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"tpuprof-serve-{i}")
+            for i in range(self.workers)]
+        for t in self._threads:
+            t.start()
+        # SIGUSR1 postmortems must carry the live queue (ISSUE 9
+        # satellite): the provider is invoked at DUMP time, so the
+        # snapshot is current, not a stale periodic copy
+        self._context_provider = lambda: {"serve_queue": self.snapshot()}
+        blackbox.register_context_provider(self._context_provider)
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, job: Optional[Job] = None, **kwargs) -> Job:
+        """Admit one job (a prebuilt :class:`Job` or its kwargs).
+        Admission failures — full queue, tenant over quota, an invalid
+        config — return the job in the ``rejected`` state with the
+        reason on ``job.error``; they never raise, because a service
+        answers requests, it does not crash on them."""
+        if job is None:
+            job = Job(**kwargs)
+        try:
+            job._config = self._build_config(job)
+            self._queue.admit(job)
+        except (QueueFull, TenantQuotaExceeded, QueueClosed,
+                ValueError, TypeError) as exc:
+            job.to(REJECTED, error=str(exc))
+            with self._lock:
+                self._submitted += 1
+                self._jobs[job.id] = job
+                self._counts[REJECTED] += 1
+            self._record_terminal(job)
+            return job
+        with self._lock:
+            self._submitted += 1
+            self._jobs[job.id] = job
+        _QUEUE_DEPTH.set(len(self._queue))
+        return job
+
+    @staticmethod
+    def _build_config(job: Job):
+        """Validate the job's config overrides NOW (admission time):
+        a typo'd option must reject in milliseconds, not fail a queued
+        job minutes later.  Unknown keys reject explicitly — the
+        from_kwargs ignore-unknowns tolerance is a library nicety, but
+        a service silently dropping an option would profile the wrong
+        thing with a straight face."""
+        import dataclasses
+
+        from tpuprof.config import ProfilerConfig
+        kwargs = dict(job.config_kwargs)
+        backend = kwargs.pop("backend", "tpu")
+        if backend != "tpu":
+            raise ValueError(
+                f"serve jobs run the tpu engine (got backend="
+                f"{backend!r}): the warm mesh and compiled-program "
+                "cache ARE the service; the cpu oracle has nothing to "
+                "keep warm")
+        known = {f.name for f in dataclasses.fields(ProfilerConfig)}
+        unknown = sorted(set(kwargs) - known)
+        if unknown:
+            raise ValueError(f"unknown config options {unknown}")
+        if job.artifact:
+            kwargs.setdefault("artifact_path", job.artifact)
+        if "metrics_enabled" not in kwargs:
+            # collect() applies each config's obs knobs PROCESS-WIDE
+            # (one-shot CLI semantics); a job that says nothing about
+            # metrics must inherit the daemon's live state, not switch
+            # the daemon's own SLO counters off mid-serve
+            from tpuprof.obs import metrics as _m
+            if _m.enabled():
+                kwargs["metrics_enabled"] = True
+        return ProfilerConfig(backend="tpu", **kwargs)
+
+    # -- worker loop -------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            job = self._queue.next(timeout=0.5)
+            if job is None:
+                if self._closed and not len(self._queue):
+                    return
+                continue
+            _QUEUE_DEPTH.set(len(self._queue))
+            self._run_job(job)
+
+    def _run_job(self, job: Job) -> None:
+        from tpuprof.errors import TYPED_ERRORS, exit_code
+        config = job._config
+        # was this shape's runner already compiled? (probe only — the
+        # hit itself is counted inside collect's acquire)
+        job.cache_hit = self._probe_cache(job, config)
+        job.to(RUNNING)
+        with self._lock:
+            self._active[job.id] = job
+        _ACTIVE.inc()
+        try:
+            from tpuprof import ProfileReport
+            report = ProfileReport(job.source, config=config)
+            if job.output:
+                report.to_file(job.output)
+            if job.stats_json:
+                with open(job.stats_json, "w") as fh:
+                    json.dump(report.to_json_dict(), fh, indent=2)
+            if config.artifact_path:
+                from tpuprof.artifact import write_artifact
+                write_artifact(config.artifact_path,
+                               stats=report.description, config=config,
+                               source=str(job.source))
+            table = report.description["table"]
+            job.result = {"rows": int(table["n"]),
+                          "cols": int(table["nvar"])}
+            job.to(DONE)
+        except TYPED_ERRORS as exc:
+            # the degradation ladder ran out for THIS job: it fails
+            # with its one-shot CLI exit code, the daemon keeps serving
+            job.to(FAILED, error=f"{type(exc).__name__}: {exc}",
+                   exit_code=exit_code(exc))
+            blackbox.dump_postmortem(error=exc, reason="serve_job")
+        except Exception as exc:   # noqa: BLE001 — a service survives
+            job.to(FAILED, error=f"{type(exc).__name__}: {exc}",
+                   exit_code=1)
+            blackbox.record("serve_job_crash", job=job.id,
+                            error=repr(exc))
+        finally:
+            _ACTIVE.dec()
+            self._queue.release(job)
+            with self._done_cond:
+                self._active.pop(job.id, None)
+                self._counts[job.state] += 1
+                if job.state == DONE and job.seconds is not None:
+                    self._latencies.append(job.seconds)
+                self._done_cond.notify_all()
+            self._record_terminal(job)
+
+    def _probe_cache(self, job: Job, config) -> Optional[bool]:
+        """True when the job's (config, shape) key already holds a
+        cached runner — i.e. this job pays no compile.  Shape discovery
+        needs the source's schema; any failure there returns None and
+        lets the real run report the error."""
+        if not _cache.cache_enabled():
+            return False
+        try:
+            from tpuprof.ingest.arrow import ArrowIngest
+            ingest = ArrowIngest(job.source, config.batch_rows,
+                                 columns=config.columns,
+                                 nested=config.nested)
+            key = _cache.runner_key(config, ingest.plan.n_num,
+                                    ingest.plan.n_hash, self._devices)
+            with _cache.process_cache()._lock:
+                return key in _cache.process_cache()._runners
+        except Exception:
+            return None
+
+    def _record_terminal(self, job: Job) -> None:
+        _REQUESTS.inc(status=job.state)
+        if job.seconds is not None:
+            _JOB_SECONDS.observe(job.seconds)
+        _obs_events.emit("serve_job", id=job.id, tenant=job.tenant,
+                         status=job.state,
+                         seconds=round(job.seconds or 0.0, 4),
+                         queue_seconds=round(job.queue_seconds or 0.0, 4)
+                         if job.queue_seconds is not None else None,
+                         cache_hit=job.cache_hit,
+                         error=job.error)
+
+    # -- client API --------------------------------------------------------
+
+    def job(self, job_id: str) -> Optional[Job]:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def wait(self, job: "Job | str",
+             timeout: Optional[float] = None) -> Job:
+        """Block until the job reaches a terminal state (returns it
+        either way; raises TimeoutError past the deadline)."""
+        import time
+        j = job if isinstance(job, Job) else self.job(job)
+        if j is None:
+            raise KeyError(f"unknown job {job!r}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._done_cond:
+            while j.state not in TERMINAL:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"job {j.id} still {j.state} after {timeout}s")
+                self._done_cond.wait(remaining)
+        return j
+
+    def stats(self) -> Dict[str, Any]:
+        """The serve bench's scoreboard: request counts by status,
+        end-to-end p50/p99 of completed jobs, and the compiled-program
+        cache's hit/miss view."""
+        with self._lock:
+            lat: List[float] = list(self._latencies)
+            out = {
+                "requests": self._submitted,
+                "done": self._counts[DONE],
+                "failed": self._counts[FAILED],
+                "rejected": self._counts[REJECTED],
+                "active": len(self._active),
+                "queued": len(self._queue),
+                "workers": self.workers,
+            }
+        out["p50_s"] = round(percentile(lat, 50), 4)
+        out["p99_s"] = round(percentile(lat, 99), 4)
+        out["cache"] = _cache.cache_stats()
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Live queue view — the SIGUSR1 postmortem's context card entry
+        and the daemon's result-channel status."""
+        with self._lock:
+            active = [j.to_wire() for j in self._active.values()]
+            recent = [j.to_wire() for j in
+                      list(self._jobs.values())[-8:]
+                      if j.state in TERMINAL]
+        snap = self._queue.snapshot()
+        snap.update({"active_jobs": active, "recent": recent,
+                     "counts": dict(self._counts)})
+        return snap
+
+    def heartbeat(self) -> Dict[str, Any]:
+        """One cheap liveness read (the StreamingProfiler.heartbeat
+        idiom): emitted as a ``serve_heartbeat`` event when a sink is
+        configured, and stamped onto the flight-recorder context."""
+        st = self.stats()
+        hb = {k: st[k] for k in ("requests", "done", "failed",
+                                 "rejected", "active", "queued")}
+        _obs_events.emit("serve_heartbeat", **hb)
+        blackbox.set_context(last_serve_heartbeat=hb)
+        return hb
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def shutdown(self, wait: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop admitting; drain queued jobs (workers exit once the
+        queue empties); idempotent."""
+        self._closed = True
+        self._queue.close()
+        if wait:
+            for t in self._threads:
+                t.join(timeout)
+        blackbox.unregister_context_provider(self._context_provider)
+
+    def __enter__(self) -> "ProfileScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

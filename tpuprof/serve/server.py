@@ -1,0 +1,218 @@
+"""`tpuprof serve` daemon + `tpuprof submit` client transport.
+
+Transport is a spool DIRECTORY, not a socket: the repo's coordination
+idiom (runtime/fleet.py) and the right fit for the deployment shape —
+one resident daemon per host holding the mesh, with clients on the same
+host (or shared storage) handing it work.  No ports, no auth surface,
+no new dependency; requests and results are plain JSON files written
+atomically (tmp + rename), so a crashed client or daemon never leaves a
+torn message.
+
+Layout under the spool dir::
+
+    jobs/<id>.json      one request (schema tpuprof-serve-job-v1),
+                        written atomically by `tpuprof submit`
+    results/<id>.json   the terminal record (tpuprof-serve-result-v1),
+                        written atomically by the daemon; the request
+                        file is unlinked after the result lands, so a
+                        daemon restart re-runs only jobs with no result
+    tmp/                atomic-write staging
+
+The daemon is a thin shell: scanning the spool and writing results; job
+lifecycle itself lives in serve/scheduler.py, which `tpuprof submit`,
+the bench harness and library embeddings share.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from tpuprof.serve.jobs import TERMINAL, Job
+from tpuprof.serve.scheduler import ProfileScheduler
+
+JOB_SCHEMA = "tpuprof-serve-job-v1"
+RESULT_SCHEMA = "tpuprof-serve-result-v1"
+
+
+def _spool_dirs(spool: str) -> Dict[str, str]:
+    dirs = {name: os.path.join(spool, name)
+            for name in ("jobs", "results", "tmp")}
+    for d in dirs.values():
+        os.makedirs(d, exist_ok=True)
+    return dirs
+
+
+def _atomic_write_json(dirs: Dict[str, str], path: str,
+                       payload: Dict[str, Any]) -> None:
+    tmp = os.path.join(dirs["tmp"],
+                       f".{os.path.basename(path)}.{os.getpid()}")
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=1, default=str)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# client side (`tpuprof submit`)
+# ---------------------------------------------------------------------------
+
+def write_job(spool: str, source: str, output: Optional[str] = None,
+              tenant: str = "default",
+              stats_json: Optional[str] = None,
+              artifact: Optional[str] = None,
+              config_kwargs: Optional[Dict[str, Any]] = None,
+              job_id: Optional[str] = None) -> str:
+    """Drop one request into the spool; returns the job id.  Paths in
+    the request are resolved to absolute here — the daemon's cwd is not
+    the client's."""
+    from tpuprof.serve.jobs import new_job_id
+    dirs = _spool_dirs(spool)
+    jid = job_id or new_job_id()
+    payload = {
+        "schema": JOB_SCHEMA, "id": jid, "tenant": str(tenant),
+        "source": os.path.abspath(source),
+        "output": os.path.abspath(output) if output else None,
+        "stats_json": os.path.abspath(stats_json) if stats_json else None,
+        "artifact": os.path.abspath(artifact) if artifact else None,
+        "config": dict(config_kwargs or {}),
+    }
+    _atomic_write_json(dirs, os.path.join(dirs["jobs"], f"{jid}.json"),
+                       payload)
+    return jid
+
+
+def read_result(spool: str, job_id: str) -> Optional[Dict[str, Any]]:
+    path = os.path.join(spool, "results", f"{job_id}.json")
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None         # absent, or mid-rename on a non-posix fs
+
+
+def wait_result(spool: str, job_id: str, timeout: Optional[float] = None,
+                poll_interval: float = 0.1) -> Dict[str, Any]:
+    """Poll the results dir until the job's terminal record lands."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        res = read_result(spool, job_id)
+        if res is not None:
+            return res
+        if deadline is not None and time.monotonic() > deadline:
+            raise TimeoutError(
+                f"no result for job {job_id} after {timeout}s — is "
+                f"`tpuprof serve {spool}` running?")
+        time.sleep(poll_interval)
+
+
+# ---------------------------------------------------------------------------
+# daemon side (`tpuprof serve`)
+# ---------------------------------------------------------------------------
+
+class ServeDaemon:
+    """Spool watcher around a :class:`ProfileScheduler`."""
+
+    def __init__(self, spool: str,
+                 scheduler: Optional[ProfileScheduler] = None,
+                 poll_interval: float = 0.2, **scheduler_kwargs):
+        self.spool = spool
+        self.dirs = _spool_dirs(spool)
+        self.poll_interval = max(float(poll_interval), 0.01)
+        self.scheduler = scheduler if scheduler is not None \
+            else ProfileScheduler(**scheduler_kwargs)
+        self._pending: Dict[str, Job] = {}   # submitted, result not yet out
+        self._seen: set = set()
+        self.stop_event = threading.Event()
+
+    # -- one scan ----------------------------------------------------------
+
+    def poll_once(self) -> int:
+        """Pick up new job files, flush finished jobs' results.
+        Returns how many jobs are still in flight (queued/running with
+        no result written)."""
+        for name in sorted(os.listdir(self.dirs["jobs"])):
+            if not name.endswith(".json") or name in self._seen:
+                continue
+            self._seen.add(name)
+            self._ingest_job_file(name)
+        for jid, job in list(self._pending.items()):
+            if job.state in TERMINAL:
+                self._write_result(job)
+                del self._pending[jid]
+        return len(self._pending)
+
+    def _ingest_job_file(self, name: str) -> None:
+        path = os.path.join(self.dirs["jobs"], name)
+        try:
+            with open(path) as fh:
+                req = json.load(fh)
+            if req.get("schema") != JOB_SCHEMA:
+                raise ValueError(
+                    f"job schema {req.get('schema')!r} is not "
+                    f"{JOB_SCHEMA}")
+            job = Job(source=req["source"], output=req.get("output"),
+                      tenant=req.get("tenant") or "default",
+                      job_id=req.get("id") or name[: -len(".json")],
+                      stats_json=req.get("stats_json"),
+                      artifact=req.get("artifact"),
+                      config_kwargs=req.get("config") or {})
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            # a torn/garbage request file must answer, not rot silently
+            # in the spool: synthesize a rejected result under the
+            # filename's id so the submitter's wait() terminates
+            jid = name[: -len(".json")]
+            self._write_result_payload(jid, {
+                "schema": RESULT_SCHEMA, "id": jid, "status": "rejected",
+                "error": f"unreadable job file: {exc}"})
+            self._unlink_job(name)
+            return
+        job = self.scheduler.submit(job)
+        if job.state in TERMINAL:       # rejected at admission
+            self._write_result(job)
+        else:
+            self._pending[job.id] = job
+
+    def _write_result(self, job: Job) -> None:
+        payload = {"schema": RESULT_SCHEMA}
+        payload.update(job.to_wire())
+        self._write_result_payload(job.id, payload)
+        self._unlink_job(f"{job.id}.json")
+
+    def _write_result_payload(self, jid: str,
+                              payload: Dict[str, Any]) -> None:
+        _atomic_write_json(
+            self.dirs, os.path.join(self.dirs["results"], f"{jid}.json"),
+            payload)
+
+    def _unlink_job(self, name: str) -> None:
+        try:
+            os.unlink(os.path.join(self.dirs["jobs"], name))
+        except OSError:
+            pass
+        self._seen.discard(name)
+
+    # -- loop --------------------------------------------------------------
+
+    def run(self, once: bool = False) -> None:
+        """Serve until :attr:`stop_event` (or, with ``once``, until the
+        spool's current jobs are all answered — the CI/test mode)."""
+        while not self.stop_event.is_set():
+            in_flight = self.poll_once()
+            if once and not in_flight \
+                    and not os.listdir(self.dirs["jobs"]):
+                return
+            self.stop_event.wait(self.poll_interval)
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        self.stop_event.set()
+        self.scheduler.shutdown(wait=True, timeout=timeout)
+        # flush results of anything that finished during shutdown
+        for jid, job in list(self._pending.items()):
+            if job.state in TERMINAL:
+                self._write_result(job)
+                del self._pending[jid]
