@@ -291,6 +291,20 @@ def _measure_serve() -> dict:
                              warm_jobs=2, concurrent=2)
 
 
+def _measure_watch() -> dict:
+    """Continuous-drift watch envelope (ISSUE 10): warm cycle latency
+    and drifted-delta-to-alert latency of one DriftWatcher at smoke
+    scale — the `watch` scenario (benchmarks/run.py) tracks the full
+    methodology; these keys put a watch-loop regression in the
+    headline BENCH line."""
+    import sys
+    import tempfile
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.run import measure_watch
+    with tempfile.TemporaryDirectory() as td:
+        return measure_watch(1 << 13 if _SMOKE else 1 << 14, td)
+
+
 def _measure_guardrail() -> dict:
     """Clean-path cost of the fault-tolerance plumbing (ISSUE 4): the
     retry-guard wrapper on the serial prepare loop, A/B'd in the same
@@ -324,6 +338,7 @@ def main() -> None:
     artifact = _measure_artifact()        # store + incremental costs
     rebalance = _measure_rebalance()      # elastic scheduler envelope
     serve = _measure_serve()              # warm-mesh daemon envelope
+    watch = _measure_watch()              # continuous-drift watch loop
     render_s = _measure_render()          # host-only, before the device
 
     devices = jax.devices()[:1]           # single-chip measurement
@@ -438,6 +453,11 @@ def main() -> None:
         "serve_warm_p50_s": serve["serve_warm_p50_s"],
         "serve_cold_vs_warm_ratio": serve["serve_cold_vs_warm_ratio"],
         "serve_cache_hit_rate": serve["serve_cache_hit_rate"],
+        # continuous drift watch (ISSUE 10): steady-state cycle latency
+        # (bounds how tight --every can go) and the drifted-delta ->
+        # alert-on-disk latency (the leg FAILS if no alert fires)
+        "watch_cycle_s": watch["watch_cycle_s"],
+        "watch_alert_latency_s": watch["watch_alert_latency_s"],
         "device_mem_in_use_bytes": int(device_mem_in_use),
         # per-stage breakdown (obs spans; NEW keys only — existing keys
         # above keep their names so BENCH_r* comparisons stay valid)

@@ -1026,6 +1026,92 @@ def measure_serve(rows: int, workdir: str, warm_jobs: int = 4,
     }
 
 
+def measure_watch(rows: int, workdir: str) -> dict:
+    """Continuous-drift watch envelope (ISSUE 10): 3 cycles of one
+    DriftWatcher at smoke scale through a warm scheduler —
+
+    * ``watch_cycle_s`` — steady-state cycle latency (profile +
+      artifact + diff + manifest seal; cycle 2, after the cold
+      compile), the figure that bounds how tight ``--every`` can go.
+    * ``watch_alert_latency_s`` — wall time from a drifted delta
+      landing in the source to the alert being on disk (cycle 3 runs
+      against an atomically-replaced, hard-shifted fixture; the leg
+      FAILS if no drift alert fires — a silent-watch regression is a
+      correctness bug, not a slow round).
+    * artifact rotation verified on disk (keep=2 -> exactly 2 retained
+      generations after 3 cycles).
+
+    The persistent DISK compile cache stays off (run_drift's
+    rationale); the runner cache provides the in-process warmth a real
+    daemon has."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from tpuprof.backends.tpu import disable_compile_cache
+    from tpuprof.serve import DriftWatcher, ProfileScheduler
+
+    disable_compile_cache()
+    fixture = _ensure_fixture("taxi", rows, workdir)
+    with tempfile.TemporaryDirectory() as td:
+        src = os.path.join(td, "watched.parquet")
+        shutil.copyfile(fixture, src)
+        spool = os.path.join(td, "spool")
+        sched = ProfileScheduler(workers=1)
+        watcher = DriftWatcher(spool, [src], sched, every_s=0, keep=2,
+                               config_kwargs={"batch_rows": 1 << 12})
+        w = watcher.watches[0]
+        cold = watcher.run_cycle(w)
+        warm = watcher.run_cycle(w)
+        if cold["status"] != "ok" or warm["status"] != "ok":
+            raise RuntimeError(f"clean watch cycles failed: "
+                               f"{[cold, warm]}")
+        # the drifted delta: shift every numeric column hard and
+        # publish atomically, as a production pipeline would
+        table = pq.read_table(src)
+        import pandas as pd
+        df = table.to_pandas()
+        for col in df.columns:
+            if df[col].dtype.kind == "f":
+                df[col] = df[col] * 4.0 + 100.0
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                       src + ".new")
+        os.replace(src + ".new", src)
+        t0 = time.perf_counter()
+        drifted = watcher.run_cycle(w)
+        alert_latency = time.perf_counter() - t0
+        if drifted["status"] != "drift" or not w.alerts:
+            raise RuntimeError(
+                f"injected drift did not alert: {drifted} "
+                f"(alerts: {w.alerts})")
+        retained = [c for c, _ in w.chain()]
+        if len(retained) != 2:
+            raise RuntimeError(
+                f"rotation violated keep=2 on disk: {retained}")
+        sched.shutdown()
+    return {
+        "rows": rows,
+        "watch_cold_cycle_s": round(cold["seconds"], 3),
+        "watch_cycle_s": round(warm["seconds"], 4),
+        "watch_alert_latency_s": round(alert_latency, 4),
+        "watch_alerts": len(w.alerts),
+        "watch_drift_columns": int(drifted.get("n_drift") or 0),
+        "watch_retained": len(retained),
+        "rows_per_sec": round(rows / warm["seconds"], 1),
+    }
+
+
+def run_watch(scale: float, workdir: str) -> dict:
+    # small fixture on purpose, like serve: the tracked signals are the
+    # warm cycle latency and the alert latency, not scan throughput
+    rows = max(int(1_000_000 * scale), 10_000)
+    out = measure_watch(rows, workdir)
+    out["scenario"] = "watch"
+    return out
+
+
 def run_serve(scale: float, workdir: str) -> dict:
     # small fixtures on purpose: the tracked signal is the cold:warm
     # RATIO (compile amortization), which a big scan denominator would
@@ -1038,7 +1124,7 @@ def run_serve(scale: float, workdir: str) -> dict:
 
 REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
                         "hostfed", "prepare", "passb", "faults", "drift",
-                        "rebalance", "serve")
+                        "rebalance", "serve", "watch")
 
 
 def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
@@ -1230,6 +1316,9 @@ def run_regression(scale: float, workdir: str,
         if "serve_cold_vs_warm_ratio" in r:
             notes = (f"cold:warm {r['serve_cold_vs_warm_ratio']}x, "
                      f"hit {r['serve_cache_hit_rate']}")
+        if "watch_alert_latency_s" in r:
+            notes = (f"cycle {r['watch_cycle_s']}s, "
+                     f"alert {r['watch_alert_latency_s']}s")
         rate = r.get("rows_per_sec",
                      r.get("prepare_rows_per_sec", float("nan")))
         print(f"| {r['scenario']} | {r.get('rows', '—'):,} | "
@@ -1245,8 +1334,8 @@ def main() -> None:
                                              "hostfed", "prepare",
                                              "passb", "faults", "drift",
                                              "rebalance", "wideexact",
-                                             "serve", "regression",
-                                             "all"])
+                                             "serve", "watch",
+                                             "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
     parser.add_argument("--backend", default="tpu")
@@ -1282,7 +1371,7 @@ def main() -> None:
 
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
               "prepare", "passb", "faults", "drift", "rebalance",
-              "wideexact", "serve"]
+              "wideexact", "serve", "watch"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -1307,6 +1396,8 @@ def main() -> None:
             result = run_wideexact(args.scale, args.workdir)
         elif name == "serve":
             result = run_serve(args.scale, args.workdir)
+        elif name == "watch":
+            result = run_watch(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

@@ -260,6 +260,75 @@ class TestServeConfigRoundTrip:
         assert args.once is False
 
 
+class TestJobTimeoutRoundTrip:
+    """`job_timeout_s` + the watch knobs resolve identically from env,
+    CLI and config (ISSUE 10 satellite — the standard three-way
+    round-trip; the watchdog itself is scheduler-side)."""
+
+    def test_env_cli_config_resolve_identically(self, monkeypatch):
+        from tpuprof.cli import build_parser
+        from tpuprof.config import resolve_job_timeout
+
+        monkeypatch.delenv("TPUPROF_JOB_TIMEOUT_S", raising=False)
+        via_config = resolve_job_timeout(
+            ProfilerConfig(job_timeout_s=3).job_timeout_s)
+        args = build_parser().parse_args(
+            ["serve", "spool", "--job-timeout", "3"])
+        via_cli = resolve_job_timeout(args.job_timeout_s)
+        monkeypatch.setenv("TPUPROF_JOB_TIMEOUT_S", "3")
+        via_env = resolve_job_timeout(None)
+        assert via_config == via_cli == via_env == 3.0
+        # explicit value beats the env twin
+        assert resolve_job_timeout(7) == 7.0
+        monkeypatch.delenv("TPUPROF_JOB_TIMEOUT_S")
+        # default: off (a one-shot profile may legitimately run hours)
+        assert resolve_job_timeout(None) is None
+
+    def test_watch_parser_carries_the_same_dest(self):
+        from tpuprof.cli import build_parser
+        args = build_parser().parse_args(
+            ["watch", "spool", "src.parquet", "--job-timeout", "5",
+             "--every", "60", "--keep", "4"])
+        assert args.job_timeout_s == 5.0
+        assert args.watch_every_s == 60.0
+        assert args.artifact_keep == 4
+        # unset flags leave resolution open to env/defaults
+        args = build_parser().parse_args(["watch", "spool", "s"])
+        assert args.job_timeout_s is None
+        assert args.watch_every_s is None
+        assert args.artifact_keep is None
+        assert args.cycles is None
+
+    def test_watch_knobs_env_round_trip(self, monkeypatch):
+        from tpuprof.config import (resolve_artifact_keep,
+                                    resolve_watch_every)
+        monkeypatch.delenv("TPUPROF_WATCH_EVERY_S", raising=False)
+        monkeypatch.delenv("TPUPROF_ARTIFACT_KEEP", raising=False)
+        assert resolve_watch_every(None) == 300.0       # default
+        assert resolve_artifact_keep(None) == 3
+        monkeypatch.setenv("TPUPROF_WATCH_EVERY_S", "30")
+        monkeypatch.setenv("TPUPROF_ARTIFACT_KEEP", "5")
+        assert resolve_watch_every(None) == 30.0
+        assert resolve_artifact_keep(None) == 5
+        assert resolve_watch_every(0) == 0.0            # explicit wins
+        assert resolve_artifact_keep(2) == 2
+        via_config = ProfilerConfig(watch_every_s=45, artifact_keep=2)
+        assert resolve_watch_every(via_config.watch_every_s) == 45.0
+        assert resolve_artifact_keep(via_config.artifact_keep) == 2
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="job_timeout_s"):
+            ProfilerConfig(job_timeout_s=0)
+        with pytest.raises(ValueError, match="job_timeout_s"):
+            ProfilerConfig(job_timeout_s=-1)
+        with pytest.raises(ValueError, match="watch_every_s"):
+            ProfilerConfig(watch_every_s=-1)
+        with pytest.raises(ValueError, match="artifact_keep"):
+            ProfilerConfig(artifact_keep=0)
+        # 0 cadence is legal (back-to-back cycles, the CI mode)
+        assert ProfilerConfig(watch_every_s=0).watch_every_s == 0
+
+
 SNAPSHOT_NUM_FIELDS = sorted(schema.NUM_FIELDS)
 
 

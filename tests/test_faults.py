@@ -569,3 +569,127 @@ class TestHostDeath:
     def test_cli_maps_host_death_to_exit_8(self):
         from tpuprof.errors import HostDeathError, exit_code
         assert exit_code(HostDeathError("host_death", 4)) == 8
+
+
+# ---------------------------------------------------------------------------
+# serve / watch fault lane (ISSUE 10): seeded injection at the
+# serve_job / watch_cycle / artifact_write sites — the daemon survives
+# with failed-cycle alerts recorded, never dies
+# ---------------------------------------------------------------------------
+
+class TestServeWatchFaults:
+    @pytest.fixture
+    def parquet_source(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        rng = np.random.default_rng(0)
+        df = pd.DataFrame({
+            "a": rng.normal(10, 2, 3000),
+            "c": rng.choice(["x", "y", "z"], 3000),
+        })
+        path = str(tmp_path / "w.parquet")
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                       path)
+        return path
+
+    def _watcher(self, tmp_path, source, **kw):
+        from tpuprof.serve import DriftWatcher, ProfileScheduler
+        sched = ProfileScheduler(workers=1)
+        watcher = DriftWatcher(str(tmp_path / "spool"), [source], sched,
+                               every_s=0,
+                               config_kwargs={"batch_rows": 1024}, **kw)
+        return sched, watcher
+
+    def test_windowed_sleep_grammar(self):
+        plan = faults.FaultPlan.from_spec("serve_job:sleep=0.01@2")
+        faults.install(plan)
+        t0 = __import__("time").perf_counter()
+        faults.hit("serve_job", key="j1")       # 1st: no sleep
+        fast = __import__("time").perf_counter() - t0
+        faults.hit("serve_job", key="j2")       # 2nd: sleeps
+        faults.hit("serve_job", key="j3")       # 3rd: no sleep
+        assert fast < 0.01
+        with pytest.raises(ValueError):
+            faults.FaultPlan.from_spec("serve_job:sleep=1@0")
+
+    def test_prep_fault_fails_the_cycle_not_the_watch(self, tmp_path,
+                                                      parquet_source):
+        sched, watcher = self._watcher(tmp_path, parquet_source)
+        try:
+            w = watcher.watches[0]
+            faults.install(faults.FaultPlan.from_spec("prep:fatal@1"))
+            assert watcher.run_cycle(w)["status"] == "failed"
+            assert faults.injected("prep") == 1
+            assert w.alerts[0]["kind"] == "failed_cycle"
+            faults.reset()
+            assert watcher.run_cycle(w)["status"] == "ok"
+        finally:
+            sched.shutdown()
+
+    def test_fold_fault_fails_the_cycle_not_the_watch(self, tmp_path,
+                                                      parquet_source):
+        sched, watcher = self._watcher(tmp_path, parquet_source)
+        try:
+            w = watcher.watches[0]
+            faults.install(faults.FaultPlan.from_spec("fold:fatal@1"))
+            assert watcher.run_cycle(w)["status"] == "failed"
+            assert faults.injected("fold") == 1
+            faults.reset()
+            assert watcher.run_cycle(w)["status"] == "ok"
+        finally:
+            sched.shutdown()
+
+    def test_transient_prep_faults_are_absorbed_by_the_ladder(
+            self, tmp_path, parquet_source):
+        """The rung-1 retry inside a serve job: every batch's first
+        prep attempt fails, retries succeed — the cycle is CLEAN."""
+        sched, watcher = self._watcher(tmp_path, parquet_source)
+        try:
+            w = watcher.watches[0]
+            faults.install(faults.FaultPlan.from_spec("prep:transient"))
+            assert watcher.run_cycle(w)["status"] == "ok"
+            assert faults.injected("prep") > 0
+            assert w.alerts == []
+        finally:
+            faults.reset()
+            sched.shutdown()
+
+    @pytest.mark.smoke
+    def test_env_driven_daemon_survives_artifact_faults(self, tmp_path,
+                                                        parquet_source):
+        """The satellite lane: a real `tpuprof watch --cycles 3` daemon
+        under TPUPROF_FAULTS survives a torn artifact write mid-watch —
+        exit 0, one failed-cycle alert on file, the other cycles
+        clean."""
+        import json as _json
+        import subprocess
+        import sys as _sys
+        spool = str(tmp_path / "spool")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TPUPROF_FAULTS="artifact_write:truncate@2")
+        proc = subprocess.run(
+            [_sys.executable, "-m", "tpuprof", "watch", spool,
+             parquet_source, "--every", "0", "--cycles", "3",
+             "--serve-workers", "1", "--no-compile-cache",
+             "--config-json", '{"batch_rows": 1024}'],
+            env=env, cwd=repo, capture_output=True, text=True,
+            timeout=420)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "1 failed cycles" in proc.stderr
+        from tpuprof.serve import watch as watchmod
+        key = watchmod.source_key(parquet_source)
+        alerts = _json.load(
+            open(os.path.join(spool, "watch", key, "alerts.json")))
+        failed = [a for a in alerts if a["kind"] == "failed_cycle"]
+        assert len(failed) == 1 and failed[0]["cycle"] == 2
+        assert "CorruptArtifactError" in failed[0]["error"]
+        manifest = watchmod.read_manifest(
+            os.path.join(spool, "watch", key, "manifest.json"))
+        assert manifest["cycle"] == 3
+        # cycles 1 and 3 are on disk; the torn cycle 2 never joined
+        # the chain
+        chain = sorted(int(n[6:14]) for n in os.listdir(
+            os.path.join(spool, "watch", key))
+            if n.startswith("cycle_") and n.endswith(".artifact.json"))
+        assert chain == [1, 3]

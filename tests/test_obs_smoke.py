@@ -78,6 +78,38 @@ EVENT_SCHEMA = {
                         "requests": ((int,), True),
                         "done": ((int,), True),
                         "queued": ((int,), True)},
+    # stats artifacts (tpuprof/artifact, ISSUE 6) — documented in
+    # OBSERVABILITY.md since PR 6 but only exercised with a live sink
+    # once the watch loop landed
+    "artifact_write": {"ts": ((int, float), True),
+                       "path": ((str,), True), "rows": ((int,), True),
+                       "bytes": ((int,), True),
+                       "foldable": ((bool,), True)},
+    "drift_report": {"ts": ((int, float), True),
+                     "verdict": ((str,), True),
+                     "n_drift": ((int,), True),
+                     "n_warn": ((int,), True),
+                     "columns": ((int,), True)},
+    # continuous drift watch (serve/watch.py, ISSUE 10): one per watch
+    # cycle (status ok|warn|drift|failed) ...
+    "watch_cycle": {"ts": ((int, float), True),
+                    "source": ((str,), True), "cycle": ((int,), True),
+                    "status": ((str,), True),
+                    "seconds": ((int, float), True),
+                    "artifact": ((str, type(None)), False),
+                    "n_drift": ((int,), False),
+                    "n_warn": ((int,), False)},
+    # ... and one per raised alert ("alert" carries the alert kind —
+    # drift | failed_cycle | corrupt_manifest — since "kind" is the
+    # event discriminator; severity in warn|drift|failed)
+    "drift_alert": {"ts": ((int, float), True),
+                    "alert": ((str,), True), "seq": ((int,), True),
+                    "source": ((str,), True), "cycle": ((int,), True),
+                    "severity": ((str,), True),
+                    "verdict": ((str,), False),
+                    "error": ((str,), False),
+                    "columns": ((list,), False),
+                    "exit_code": ((int,), False)},
 }
 
 
@@ -296,3 +328,56 @@ def test_cli_metrics_json_smoke(tmp_path):
     # the report footer carries the pipeline line
     page = open(out).read()
     assert "pipeline:" in page and "rows ingested" in page
+
+
+def test_watch_event_stream_validates(tmp_path):
+    """The watch loop's JSONL contract (ISSUE 10): every watch_cycle /
+    drift_alert event a drifting watch emits validates against
+    EVENT_SCHEMA, and the watch metrics land in the exposition."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from tpuprof import obs
+    from tpuprof.serve import DriftWatcher, ProfileScheduler
+
+    rng = np.random.default_rng(0)
+    src = str(tmp_path / "w.parquet")
+
+    def publish(shift):
+        df = pd.DataFrame({"a": rng.normal(10, 2, 2000) + shift,
+                           "c": np.random.default_rng(1).choice(
+                               ["x", "y"], 2000)})
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                       src + ".tmp")
+        os.replace(src + ".tmp", src)
+
+    publish(0.0)
+    mpath = str(tmp_path / "watch.jsonl")
+    obs.configure(enabled=True, jsonl_path=mpath)
+    try:
+        sched = ProfileScheduler(workers=1)
+        watcher = DriftWatcher(str(tmp_path / "spool"), [src], sched,
+                               every_s=0,
+                               config_kwargs={"batch_rows": 1024})
+        w = watcher.watches[0]
+        assert watcher.run_cycle(w)["status"] == "ok"
+        publish(500.0)                   # hard shift: must alert
+        assert watcher.run_cycle(w)["status"] == "drift"
+        sched.shutdown()
+        obs.finalize(reason="test")
+        prom = obs.registry().render_text()
+    finally:
+        obs.configure(enabled=False, jsonl_path=None)
+    events = [json.loads(line) for line in open(mpath)
+              if line.strip()]
+    kinds = {e["kind"] for e in events}
+    assert "watch_cycle" in kinds and "drift_alert" in kinds
+    for ev in events:
+        validate_event(ev)
+    alert = [e for e in events if e["kind"] == "drift_alert"][-1]
+    assert alert["alert"] == "drift" and "a" in alert["columns"]
+    parsed = parse_prom(prom)
+    assert ("status", "drift") in [
+        s for _, l, _v in parsed["tpuprof_watch_cycles_total"]["samples"]
+        for s in l.items()]
+    assert parsed["tpuprof_drift_alerts_total"]["samples"]

@@ -74,17 +74,21 @@ def test_load_baseline_prefers_explicit_then_committed_then_workdir(
 
 def _pin_history(monkeypatch, payloads):
     """Pin the committed-REGRESSION_r* glob (and reads) to a synthetic
-    history so the repo's real snapshots cannot leak into the test."""
+    history so the repo's real snapshots cannot leak into the test.
+    File names must sort in payload order — _historical_bands sorts the
+    glob result, and random NamedTemporaryFile prefixes used to scramble
+    the round sequence (the swing between two rounds depends on their
+    order, so the computed band flaked run to run)."""
     import glob as _glob
     import tempfile
     real_glob = _glob.glob
+    hist_dir = tempfile.mkdtemp(prefix="tpuprof-reg-history-")
     paths = []
     for i, payload in enumerate(payloads):
-        fh = tempfile.NamedTemporaryFile(
-            "w", suffix=f"_r{i:02d}.json", delete=False)
-        json.dump(payload, fh)
-        fh.close()
-        paths.append(fh.name)
+        path = os.path.join(hist_dir, f"REGRESSION_r{i:02d}.json")
+        with open(path, "w") as fh:
+            json.dump(payload, fh)
+        paths.append(path)
     monkeypatch.setattr(
         _glob, "glob",
         lambda pat, *a, **k: (list(paths) if "REGRESSION_r*" in pat

@@ -472,6 +472,197 @@ class TestServeDaemon:
 
 
 # ---------------------------------------------------------------------------
+# torn result files: typed corrupt path (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+class TestCorruptResult:
+    def _write_result(self, spool, jid, payload=None):
+        results = os.path.join(spool, "results")
+        os.makedirs(results, exist_ok=True)
+        path = os.path.join(results, f"{jid}.json")
+        with open(path, "w") as fh:
+            json.dump(payload or {"schema": "tpuprof-serve-result-v1",
+                                  "id": jid, "status": "done",
+                                  "rows": 3000, "cols": 3}, fh, indent=1)
+        return path
+
+    def test_truncation_at_every_offset_is_typed(self, tmp_path):
+        """The checkpoint truncation-sweep idiom on the serve result
+        transport: any torn prefix is CorruptResultError, never a raw
+        json.JSONDecodeError out of read_result."""
+        from tpuprof.errors import CorruptResultError
+        from tpuprof.serve import read_result
+        spool = str(tmp_path / "spool")
+        path = self._write_result(spool, "j1")
+        data = open(path, "rb").read()
+        assert read_result(spool, "j1")["status"] == "done"
+        for cut in range(len(data)):
+            with open(path, "wb") as fh:
+                fh.write(data[:cut])
+            with pytest.raises(CorruptResultError):
+                read_result(spool, "j1")
+        # a missing file is "not answered yet", not corruption
+        os.unlink(path)
+        assert read_result(spool, "j1") is None
+
+    def test_wait_result_repolls_then_raises_typed(self, tmp_path):
+        """wait_result re-polls past a torn record (an atomic writer
+        may still replace it) and surfaces the TYPED error at the
+        deadline — not a misleading 'is the daemon running?' timeout."""
+        from tpuprof.errors import CorruptResultError
+        from tpuprof.serve import wait_result
+        spool = str(tmp_path / "spool")
+        path = self._write_result(spool, "j2")
+        with open(path, "w") as fh:
+            fh.write('{"status": "do')               # torn mid-write
+        t0 = time.monotonic()
+        with pytest.raises(CorruptResultError):
+            wait_result(spool, "j2", timeout=0.4, poll_interval=0.05)
+        assert time.monotonic() - t0 >= 0.4          # it DID re-poll
+        # an absent record still times out the old way
+        with pytest.raises(TimeoutError, match="is .tpuprof serve"):
+            wait_result(spool, "nope", timeout=0.2, poll_interval=0.05)
+
+    def test_wait_result_recovers_when_record_heals(self, tmp_path):
+        """The re-poll exists for exactly this: a torn read followed by
+        the writer's atomic replace must succeed, not error."""
+        from tpuprof.serve import wait_result
+        spool = str(tmp_path / "spool")
+        path = self._write_result(spool, "j3")
+        with open(path, "w") as fh:
+            fh.write("{torn")
+        healed = {"schema": "tpuprof-serve-result-v1", "id": "j3",
+                  "status": "done"}
+
+        def _heal():
+            time.sleep(0.3)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(healed, fh)
+            os.replace(tmp, path)
+
+        t = threading.Thread(target=_heal)
+        t.start()
+        try:
+            assert wait_result(spool, "j3", timeout=10,
+                               poll_interval=0.05)["status"] == "done"
+        finally:
+            t.join()
+
+    def test_corrupt_result_speaks_exit_code_6(self, tmp_path):
+        """CorruptResultError rides the CorruptArtifactError exit-code
+        mapping ('a persisted product rotted') — the code automation
+        branches on."""
+        from tpuprof.errors import (CorruptArtifactError,
+                                    CorruptResultError, exit_code)
+        from tpuprof.serve import wait_result
+        spool = str(tmp_path / "spool")
+        results = os.path.join(spool, "results")
+        os.makedirs(results, exist_ok=True)
+        with open(os.path.join(results, "pinned.json"), "w") as fh:
+            fh.write("{torn")
+        with pytest.raises(CorruptResultError) as exc_info:
+            wait_result(spool, "pinned", timeout=0.2)
+        assert isinstance(exc_info.value, CorruptArtifactError)
+        assert exit_code(exc_info.value) == 6
+
+
+# ---------------------------------------------------------------------------
+# daemon restart recovery: exactly-once results (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+class TestRestartRecovery:
+    def test_sigkill_midrun_then_restart_answers_every_job(
+            self, parquet_path, tmp_path):
+        """Accept N jobs, SIGKILL the daemon mid-run, restart on the
+        same spool: every accepted job eventually has exactly one
+        result — no loss (unanswered requests re-run), no duplicates
+        (answered requests are consumed, and a restart skips any job
+        whose result already landed)."""
+        import subprocess
+        import sys as _sys
+
+        from tpuprof.serve import wait_result, write_job
+        spool = str(tmp_path / "spool")
+        jids = [write_job(spool, parquet_path,
+                          output=str(tmp_path / f"r{k}.html"),
+                          config_kwargs={"batch_rows": 1024})
+                for k in range(3)]
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # the daemon hangs on its SECOND job (windowed sleep fault), so
+        # the kill deterministically lands mid-run: one job answered,
+        # one in flight, one queued
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TPUPROF_FAULTS="serve_job:sleep=300@2")
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "tpuprof", "serve", spool,
+             "--serve-workers", "1", "--no-compile-cache"],
+            env=env, cwd=repo, stderr=subprocess.DEVNULL)
+        try:
+            first = wait_result(spool, jids[0], timeout=420)
+            assert first["status"] == "done"
+            time.sleep(1.0)              # job 2 is now in the sleep
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == -signal.SIGKILL
+        # mid-run state: job 0 answered + consumed; jobs 1-2 still
+        # spooled with no result
+        assert sorted(os.listdir(os.path.join(spool, "results"))) == \
+            [f"{jids[0]}.json"]
+        assert sorted(os.listdir(os.path.join(spool, "jobs"))) == \
+            sorted(f"{j}.json" for j in jids[1:])
+        # restart on the same spool (no faults): --once answers the
+        # backlog and exits
+        proc = subprocess.run(
+            [_sys.executable, "-m", "tpuprof", "serve", spool, "--once",
+             "--serve-workers", "1", "--no-compile-cache"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo,
+            capture_output=True, text=True, timeout=420)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        results = sorted(os.listdir(os.path.join(spool, "results")))
+        assert results == sorted(f"{j}.json" for j in jids)
+        for jid in jids:
+            rec = json.load(open(os.path.join(spool, "results",
+                                              f"{jid}.json")))
+            assert rec["status"] == "done", rec
+        assert os.listdir(os.path.join(spool, "jobs")) == []
+        # job 0 ran exactly once: the restarted daemon served only 2
+        assert "served 2 jobs" in proc.stderr
+
+    def test_restart_consumes_job_file_left_after_result(self, tmp_path,
+                                                         parquet_path):
+        """The crash window between result-write and request-unlink: a
+        restart must consume the request WITHOUT re-running it."""
+        from tpuprof.serve import ServeDaemon, write_job
+        spool = str(tmp_path / "spool")
+        jid = write_job(spool, parquet_path,
+                        config_kwargs={"batch_rows": 1024})
+        # simulate the torn window: a result already on disk while the
+        # request file still exists
+        marker = {"schema": "tpuprof-serve-result-v1", "id": jid,
+                  "status": "done", "rows": 1, "cols": 1,
+                  "marker": "from-before-the-crash"}
+        results = os.path.join(spool, "results")
+        os.makedirs(results, exist_ok=True)
+        with open(os.path.join(results, f"{jid}.json"), "w") as fh:
+            json.dump(marker, fh)
+        daemon = ServeDaemon(spool, workers=1, poll_interval=0.05)
+        try:
+            daemon.run(once=True)
+        finally:
+            daemon.close()
+        # the request was consumed, the ORIGINAL result untouched
+        assert os.listdir(os.path.join(spool, "jobs")) == []
+        rec = json.load(open(os.path.join(results, f"{jid}.json")))
+        assert rec["marker"] == "from-before-the-crash"
+        assert daemon.scheduler.stats()["requests"] == 0   # never re-ran
+
+
+# ---------------------------------------------------------------------------
 # signal handlers: idempotent install + SIGUSR1 queue snapshot
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,551 @@
+"""Continuous drift watch (tpuprof/serve/watch.py — ISSUE 10,
+ROBUSTNESS.md rung 6): the CRC-sealed watch manifest, cycle/alert/
+retention mechanics, crash-safe restore (torn manifest, corrupt
+retained artifact head), degraded-cycle semantics, the per-job serve
+watchdog, and the chaos acceptance gauntlet."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof.errors import CorruptManifestError
+from tpuprof.obs import metrics as obs_metrics
+from tpuprof.serve import DriftWatcher, ProfileScheduler
+from tpuprof.serve import watch as watchmod
+from tpuprof.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _frame(shift: float = 0.0, scale: float = 1.0, n: int = 3000):
+    rng = np.random.default_rng(0)
+    return pd.DataFrame({
+        "a": rng.normal(10, 2, n) * scale + shift,
+        "b": rng.exponential(1.0, n),
+        "c": rng.choice(["x", "y", "z"], n),
+    })
+
+
+def _write_source(path: str, df: pd.DataFrame) -> None:
+    """Atomic replace, as a production data pipeline would publish."""
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                   path + ".tmp")
+    os.replace(path + ".tmp", path)
+
+
+@pytest.fixture
+def source(tmp_path):
+    path = str(tmp_path / "watched.parquet")
+    _write_source(path, _frame())
+    return path
+
+
+@pytest.fixture
+def spool(tmp_path):
+    return str(tmp_path / "spool")
+
+
+CFG = {"batch_rows": 1024}
+
+
+@pytest.fixture
+def sched():
+    s = ProfileScheduler(workers=1)
+    yield s
+    s.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# the watch manifest: CRC-sealed, typed corruption
+# ---------------------------------------------------------------------------
+
+class TestWatchManifest:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "manifest.json")
+        state = {"source": "s.parquet", "cycle": 7,
+                 "last_artifact": "cycle_00000007.artifact.json",
+                 "alert_seq": 3, "last_alert_key": ["drift", "warn", []]}
+        watchmod.write_manifest(path, state)
+        doc = watchmod.read_manifest(path)
+        for k, v in state.items():
+            assert doc[k] == v
+        assert doc["schema"] == watchmod.WATCH_MANIFEST_SCHEMA
+
+    def test_missing_is_file_not_found(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            watchmod.read_manifest(str(tmp_path / "nope.json"))
+
+    def test_truncation_at_every_offset_is_typed(self, tmp_path):
+        """The checkpoint/artifact sweep applied to the NEW durable
+        class: any truncated prefix must be CorruptManifestError, never
+        a raw json error."""
+        path = str(tmp_path / "manifest.json")
+        watchmod.write_manifest(path, {"source": "s", "cycle": 2,
+                                       "last_artifact": None,
+                                       "alert_seq": 0,
+                                       "last_alert_key": None})
+        data = open(path, "rb").read()
+        torn = str(tmp_path / "torn.json")
+        for cut in range(len(data)):
+            with open(torn, "wb") as fh:
+                fh.write(data[:cut])
+            with pytest.raises(CorruptManifestError):
+                watchmod.read_manifest(torn)
+
+    def test_bit_flip_and_junk_are_typed(self, tmp_path):
+        path = str(tmp_path / "manifest.json")
+        watchmod.write_manifest(path, {"source": "s", "cycle": 1,
+                                       "last_artifact": None,
+                                       "alert_seq": 0,
+                                       "last_alert_key": None})
+        data = bytearray(open(path, "rb").read())
+        # flip a byte inside the payload (after the schema line)
+        data[len(data) // 2] ^= 0xFF
+        with open(path, "wb") as fh:
+            fh.write(data)
+        with pytest.raises(CorruptManifestError):
+            watchmod.read_manifest(path)
+        with open(path, "w") as fh:
+            fh.write('{"schema": "something-else-v9", "cycle": 1}')
+        with pytest.raises(CorruptManifestError, match="schema"):
+            watchmod.read_manifest(path)
+
+    def test_source_key_distinguishes_paths(self, tmp_path):
+        a = watchmod.source_key(str(tmp_path / "x" / "data.parquet"))
+        b = watchmod.source_key(str(tmp_path / "y" / "data.parquet"))
+        assert a != b
+        assert a.startswith("data.parquet-")
+        # stable across calls (restart finds the same state dir)
+        assert a == watchmod.source_key(str(tmp_path / "x" /
+                                            "data.parquet"))
+
+
+# ---------------------------------------------------------------------------
+# cycles, retention, alerts (in-process — the warm runner cache keeps
+# repeat profiles cheap)
+# ---------------------------------------------------------------------------
+
+class TestWatchCycles:
+    def test_cycles_rotate_and_seal_manifest(self, spool, source, sched):
+        watcher = DriftWatcher(spool, [source], sched, every_s=0,
+                               keep=2, config_kwargs=dict(CFG))
+        w = watcher.watches[0]
+        for _ in range(4):
+            rec = watcher.run_cycle(w)
+            assert rec["status"] == "ok"
+        # retention: exactly `keep` artifacts, the newest generations
+        assert [c for c, _ in w.chain()] == [4, 3]
+        assert w.last_artifact == w.artifact_path(4)
+        doc = watchmod.read_manifest(w.manifest_path)
+        assert doc["cycle"] == 4
+        assert doc["last_artifact"] == w.artifact_path(4)
+        assert watcher.counts == {"ok": 4, "warn": 0, "drift": 0,
+                                  "failed": 0}
+        assert w.alerts == []           # stable data: nothing to say
+
+    def test_final_cycle_stats_equal_one_shot(self, spool, source,
+                                              sched):
+        """The acceptance byte-equality: a watch cycle's persisted
+        stats are the SAME export a one-shot profile of the same data
+        produces."""
+        from tpuprof import ProfileReport, ProfilerConfig
+        from tpuprof.artifact import read_artifact
+        from tpuprof.report.export import stats_to_json
+        watcher = DriftWatcher(spool, [source], sched, every_s=0,
+                               config_kwargs=dict(CFG))
+        w = watcher.watches[0]
+        assert watcher.run_cycle(w)["status"] == "ok"
+        art = read_artifact(w.last_artifact)
+        report = ProfileReport(source, config=ProfilerConfig(
+            backend="tpu", **CFG))
+        assert json.dumps(art.stats, sort_keys=True) == \
+            json.dumps(stats_to_json(report.description), sort_keys=True)
+
+    def test_drift_raises_alert_and_dedups_the_episode(
+            self, spool, source, sched, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUPROF_POSTMORTEM_DIR", str(tmp_path))
+        watcher = DriftWatcher(spool, [source], sched, every_s=0,
+                               config_kwargs=dict(CFG))
+        w = watcher.watches[0]
+        assert watcher.run_cycle(w)["status"] == "ok"
+        # the data shifts hard: cycle 2 must alert at drift severity
+        _write_source(source, _frame(shift=100.0, scale=4.0))
+        rec = watcher.run_cycle(w)
+        assert rec["status"] == "drift" and rec["n_drift"] >= 1
+        assert len(w.alerts) == 1
+        alert = w.alerts[0]
+        assert alert["kind"] == "drift" and alert["severity"] == "drift"
+        assert "a" in alert["columns"] and alert["cycle"] == 2
+        # the same episode KEEPS drifting (the source shifts again by
+        # the same shape): the cycle records drift, the alert dedups
+        _write_source(source, _frame(shift=300.0, scale=16.0))
+        rec = watcher.run_cycle(w)
+        assert rec["status"] == "drift"
+        assert len(w.alerts) == 1       # deduped
+        # an ok cycle clears the episode; the next drift re-alerts
+        rec = watcher.run_cycle(w)      # same data vs same data
+        assert rec["status"] == "ok"
+        _write_source(source, _frame())     # shift all the way back
+        rec = watcher.run_cycle(w)
+        assert rec["status"] == "drift"
+        assert len(w.alerts) == 2
+        assert w.alerts[1]["seq"] == 2
+        # the operator-pollable feed matches the in-memory view
+        feed = json.load(open(w.alerts_path))
+        assert [a["seq"] for a in feed] == [1, 2]
+
+    def test_failed_cycle_keeps_watching(self, spool, tmp_path, sched):
+        """Degraded-cycle semantics: a missing/poison source records a
+        failed-cycle alert and the watch CONTINUES."""
+        source = str(tmp_path / "not_yet.parquet")
+        watcher = DriftWatcher(spool, [source], sched, every_s=0,
+                               config_kwargs=dict(CFG))
+        w = watcher.watches[0]
+        rec = watcher.run_cycle(w)
+        assert rec["status"] == "failed"
+        assert w.alerts[0]["kind"] == "failed_cycle"
+        assert "profile job failed" in w.alerts[0]["error"]
+        assert w.cycle == 1 and w.last_artifact is None
+        # the source appears: the very next cycle succeeds
+        _write_source(source, _frame())
+        rec = watcher.run_cycle(w)
+        assert rec["status"] == "ok" and w.cycle == 2
+        assert watcher.counts["failed"] == 1
+        assert watcher.counts["ok"] == 1
+
+    def test_artifact_write_fault_is_a_failed_cycle(self, spool, source,
+                                                    sched):
+        """A torn artifact write (the `artifact_write` truncate site)
+        must never become the drift baseline: the cycle fails, the file
+        is dropped, the previous baseline survives."""
+        watcher = DriftWatcher(spool, [source], sched, every_s=0,
+                               config_kwargs=dict(CFG))
+        w = watcher.watches[0]
+        assert watcher.run_cycle(w)["status"] == "ok"
+        faults.install(faults.FaultPlan.from_spec(
+            "artifact_write:truncate@1"))
+        rec = watcher.run_cycle(w)
+        assert rec["status"] == "failed"
+        assert faults.injected("artifact_write") == 1
+        assert "CorruptArtifactError" in w.alerts[0]["error"]
+        assert w.alerts[0]["exit_code"] == 6
+        faults.reset()
+        # the torn file is gone; baseline is still cycle 1
+        assert [c for c, _ in w.chain()] == [1]
+        assert watcher.run_cycle(w)["status"] == "ok"
+
+    def test_watch_cycle_fault_site(self, spool, source, sched):
+        watcher = DriftWatcher(spool, [source], sched, every_s=0,
+                               config_kwargs=dict(CFG))
+        w = watcher.watches[0]
+        faults.install(faults.FaultPlan.from_spec("watch_cycle:fatal@1"))
+        rec = watcher.run_cycle(w)
+        assert rec["status"] == "failed"
+        assert "injected fatal" in w.alerts[0]["error"]
+        faults.reset()
+        assert watcher.run_cycle(w)["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# crash-safe restore
+# ---------------------------------------------------------------------------
+
+class TestWatchRestore:
+    def _run_two_cycles(self, spool, source, sched):
+        watcher = DriftWatcher(spool, [source], sched, every_s=0,
+                               keep=3, config_kwargs=dict(CFG))
+        w = watcher.watches[0]
+        assert watcher.run_cycle(w)["status"] == "ok"
+        assert watcher.run_cycle(w)["status"] == "ok"
+        return w
+
+    def test_restart_restores_cycle_and_baseline(self, spool, source,
+                                                 sched):
+        w = self._run_two_cycles(spool, source, sched)
+        watcher2 = DriftWatcher(spool, [source], sched, every_s=0,
+                                keep=3, config_kwargs=dict(CFG))
+        w2 = watcher2.watches[0]
+        assert w2.cycle == 2
+        assert w2.last_artifact == w.artifact_path(2)
+        # and the next cycle numbers on from there
+        assert watcher2.run_cycle(w2)["cycle"] == 3
+
+    def test_torn_manifest_rebuilds_from_chain_with_alert(
+            self, spool, source, sched):
+        w = self._run_two_cycles(spool, source, sched)
+        data = open(w.manifest_path, "rb").read()
+        with open(w.manifest_path, "wb") as fh:
+            fh.write(data[: len(data) // 2])
+        watcher2 = DriftWatcher(spool, [source], sched, every_s=0,
+                                keep=3, config_kwargs=dict(CFG))
+        w2 = watcher2.watches[0]
+        # state rebuilt from the artifact chain: the cycle counter
+        # adopts the newest on-disk generation (no name collisions)
+        assert w2.cycle == 2
+        corrupt = [a for a in w2.alerts
+                   if a["kind"] == "corrupt_manifest"]
+        assert len(corrupt) == 1
+        assert "CorruptManifestError" in corrupt[0]["error"]
+        # and the watch just keeps going, re-sealing a good manifest
+        assert watcher2.run_cycle(w2)["status"] == "ok"
+        assert watchmod.read_manifest(w2.manifest_path)["cycle"] == 3
+
+    def test_corrupt_retained_head_walks_back(self, spool, source,
+                                              sched):
+        """The checkpoint-restore walk applied to the artifact chain: a
+        rotted newest artifact falls back to the previous generation as
+        the drift baseline."""
+        obs_metrics.set_enabled(True)
+        try:
+            w = self._run_two_cycles(spool, source, sched)
+            head = w.artifact_path(2)
+            data = open(head, "rb").read()
+            with open(head, "wb") as fh:
+                fh.write(data[: len(data) // 2])
+            watcher2 = DriftWatcher(spool, [source], sched, every_s=0,
+                                    keep=3, config_kwargs=dict(CFG))
+            w2 = watcher2.watches[0]
+            snap0 = obs_metrics.registry().snapshot()["counters"].get(
+                "tpuprof_watch_artifact_fallbacks_total", {}).get("", 0)
+            base = w2.baseline()
+            assert base is not None
+            assert base.path == w2.artifact_path(1)
+            snap1 = obs_metrics.registry().snapshot()["counters"].get(
+                "tpuprof_watch_artifact_fallbacks_total", {}).get("", 0)
+            assert snap1 == snap0 + 1
+        finally:
+            obs_metrics.set_enabled(False)
+
+    def test_alert_cursor_survives_restart(self, spool, source, sched):
+        watcher = DriftWatcher(spool, [source], sched, every_s=0,
+                               config_kwargs=dict(CFG))
+        w = watcher.watches[0]
+        assert watcher.run_cycle(w)["status"] == "ok"
+        _write_source(source, _frame(shift=100.0, scale=4.0))
+        assert watcher.run_cycle(w)["status"] == "drift"
+        assert w.alerts[-1]["seq"] == 1
+        watcher2 = DriftWatcher(spool, [source], sched, every_s=0,
+                                config_kwargs=dict(CFG))
+        w2 = watcher2.watches[0]
+        assert w2.alert_seq == 1 and len(w2.alerts) == 1
+        # the dedup key also survived: the same episode still dedups
+        _write_source(source, _frame(shift=300.0, scale=16.0))
+        assert watcher2.run_cycle(w2)["status"] == "drift"
+        assert len(w2.alerts) == 1
+
+
+# ---------------------------------------------------------------------------
+# per-job watchdog (serve/scheduler.py — the rung-4 ladder in serve)
+# ---------------------------------------------------------------------------
+
+class TestServeJobWatchdog:
+    def test_hung_job_fails_with_exit_4_and_frees_the_worker(
+            self, source, tmp_path):
+        with ProfileScheduler(workers=1) as sched:
+            warm = sched.submit(source=source, config_kwargs=dict(CFG))
+            sched.wait(warm, timeout=600)
+            assert warm.state == "done"
+            faults.install(faults.FaultPlan.from_spec(
+                "serve_job:sleep=3@1"))
+            t0 = time.monotonic()
+            hung = sched.submit(source=source, config_kwargs=dict(
+                CFG, job_timeout_s=0.5))
+            sched.wait(hung, timeout=60)
+            assert hung.state == "failed"
+            assert hung.exit_code == 4
+            assert "serve_job" in hung.error
+            assert time.monotonic() - t0 < 3
+            faults.reset()
+            # the worker is free: the next job completes
+            ok = sched.submit(source=source,
+                              output=str(tmp_path / "after.html"),
+                              config_kwargs=dict(CFG))
+            sched.wait(ok, timeout=600)
+            assert ok.state == "done"
+            # let the abandoned body thread drain before teardown
+            time.sleep(2.7)
+
+    def test_daemon_level_timeout_is_a_default_jobs_can_override(
+            self, source):
+        with ProfileScheduler(workers=1, job_timeout_s=900) as sched:
+            job = sched.submit(source=source, config_kwargs=dict(CFG))
+            assert job._config.job_timeout_s == 900
+            override = sched.submit(source=source, config_kwargs=dict(
+                CFG, job_timeout_s=5))
+            assert override._config.job_timeout_s == 5
+            sched.wait(job, timeout=600)
+            sched.wait(override, timeout=600)
+
+    def test_hung_watch_cycle_is_a_failed_cycle(self, spool, source):
+        """The tentpole wiring end-to-end: watchdog kill inside a watch
+        cycle -> failed-cycle alert with exit-code-4 semantics, watch
+        continues."""
+        with ProfileScheduler(workers=1) as sched:
+            watcher = DriftWatcher(spool, [source], sched, every_s=0,
+                                   job_timeout_s=0.5,
+                                   config_kwargs=dict(CFG))
+            w = watcher.watches[0]
+            # warm the shape so only the faulted cycle can time out
+            warm = sched.submit(source=source, config_kwargs=dict(CFG))
+            sched.wait(warm, timeout=600)
+            faults.install(faults.FaultPlan.from_spec(
+                "serve_job:sleep=3@1"))
+            rec = watcher.run_cycle(w)
+            assert rec["status"] == "failed"
+            assert w.alerts[0]["kind"] == "failed_cycle"
+            assert w.alerts[0]["exit_code"] == 4
+            faults.reset()
+            assert watcher.run_cycle(w)["status"] == "ok"
+            time.sleep(2.7)     # drain the abandoned body thread
+
+
+# ---------------------------------------------------------------------------
+# the chaos acceptance gauntlet (ISSUE 10): poison cycle + watchdog
+# kill + SIGKILL/restart + corrupt retained head, >= 5 cycles, correct
+# alerts, exactly-once results, retention respected, final stats
+# byte-equal to one-shot
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+class TestChaosAcceptance:
+    def test_watch_survives_the_gauntlet(self, tmp_path):
+        from tpuprof import ProfileReport, ProfilerConfig
+        from tpuprof.artifact import read_artifact
+        from tpuprof.report.export import stats_to_json
+        from tpuprof.serve import write_job
+
+        spool = str(tmp_path / "spool")
+        source = str(tmp_path / "watched.parquet")
+        _write_source(source, _frame())
+
+        # --- cycles 1-2: clean baseline (in-process watcher) ----------
+        sched1 = ProfileScheduler(workers=1)
+        watcher1 = DriftWatcher(spool, [source], sched1, every_s=0,
+                                keep=3, config_kwargs=dict(CFG))
+        w = watcher1.watches[0]
+        assert watcher1.run_cycle(w)["status"] == "ok"
+        assert watcher1.run_cycle(w)["status"] == "ok"
+
+        # --- cycle 3: poison cycle ------------------------------------
+        faults.install(faults.FaultPlan.from_spec("watch_cycle:fatal@1"))
+        assert watcher1.run_cycle(w)["status"] == "failed"
+        faults.reset()
+        sched1.shutdown()
+
+        # --- cycle 4: watchdog-killed job (a "restart": fresh watcher
+        # restores from the manifest) ----------------------------------
+        sched2 = ProfileScheduler(workers=1)
+        watcher2 = DriftWatcher(spool, [source], sched2, every_s=0,
+                                keep=3, job_timeout_s=0.5,
+                                config_kwargs=dict(CFG))
+        w2 = watcher2.watches[0]
+        assert w2.cycle == 3            # restored
+        faults.install(faults.FaultPlan.from_spec("serve_job:sleep=3@1"))
+        rec = watcher2.run_cycle(w2)
+        assert rec["status"] == "failed" and rec["cycle"] == 4
+        faults.reset()
+        time.sleep(2.7)                 # drain the abandoned body
+        sched2.shutdown()
+
+        # --- cycle 5 attempt: SIGKILL the daemon MID-CYCLE ------------
+        # two spool jobs ride along so the restart's exactly-once serve
+        # recovery is part of the same gauntlet
+        jid1 = write_job(spool, source, config_kwargs=dict(CFG))
+        jid2 = write_job(spool, source,
+                         output=str(tmp_path / "spool_job2.html"),
+                         config_kwargs=dict(CFG))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TPUPROF_FAULTS="serve_job:sleep=300")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpuprof", "watch", spool, source,
+             "--every", "0", "--cycles", "1", "--keep", "3",
+             "--serve-workers", "1", "--no-compile-cache",
+             "--config-json", json.dumps(CFG)],
+            env=env, cwd=REPO, stderr=subprocess.PIPE, text=True)
+        try:
+            # its first line says the watch is up; every job then hangs
+            # in the injected sleep — kill it mid-cycle
+            line = proc.stderr.readline()
+            assert "watching" in line
+            time.sleep(2.0)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == -signal.SIGKILL
+        # mid-cycle death: the manifest still says 4, CRC-valid
+        assert watchmod.read_manifest(w2.manifest_path)["cycle"] == 4
+
+        # --- corrupt the retained artifact head + drift the data ------
+        head = w2.artifact_path(2)
+        data = open(head, "rb").read()
+        with open(head, "wb") as fh:
+            fh.write(data[: len(data) // 2])
+        _write_source(source, _frame(shift=100.0, scale=4.0))
+
+        # --- restart: cycles 5-6 + the spool jobs, clean --------------
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpuprof", "watch", spool, source,
+             "--every", "0", "--cycles", "2", "--keep", "3",
+             "--serve-workers", "1", "--no-compile-cache",
+             "--config-json", json.dumps(CFG)],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO,
+            capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "0 failed cycles" in proc.stderr, proc.stderr[-2000:]
+
+        # >= 5 cycles completed, sealed manifest
+        doc = watchmod.read_manifest(w2.manifest_path)
+        assert doc["cycle"] == 6
+
+        # exactly-once results for every accepted spool job
+        results = sorted(os.listdir(os.path.join(spool, "results")))
+        assert results == sorted([f"{jid1}.json", f"{jid2}.json"])
+        for jid in (jid1, jid2):
+            rec = json.load(open(os.path.join(spool, "results",
+                                              f"{jid}.json")))
+            assert rec["status"] == "done"
+        assert os.listdir(os.path.join(spool, "jobs")) == []
+
+        # correct alert records: poison (exit 1), watchdog (exit 4),
+        # then the drift alert after the corrupt-head fallback
+        alerts = json.load(open(w2.alerts_path))
+        kinds = [(a["kind"], a.get("exit_code")) for a in alerts]
+        assert ("failed_cycle", 1) in kinds
+        assert ("failed_cycle", 4) in kinds
+        drift_alerts = [a for a in alerts if a["kind"] == "drift"]
+        assert len(drift_alerts) == 1
+        assert drift_alerts[0]["severity"] == "drift"
+        assert drift_alerts[0]["cycle"] == 5
+        # the drift baseline was cycle 1 — the corrupt cycle-2 head was
+        # walked past, not trusted and not fatal
+        assert drift_alerts[0]["baseline"] == w2.artifact_path(1)
+
+        # retention depth respected on disk
+        chain = w2.chain()
+        assert len(chain) <= 3
+        assert chain[0][0] == 6
+
+        # final clean cycle's stats byte-equal a one-shot profile
+        art = read_artifact(w2.artifact_path(6))
+        report = ProfileReport(source, config=ProfilerConfig(
+            backend="tpu", **CFG))
+        assert json.dumps(art.stats, sort_keys=True) == \
+            json.dumps(stats_to_json(report.description), sort_keys=True)

@@ -279,6 +279,13 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N",
                    help="per-tenant queued+running cap; 0 = unlimited "
                         "(default: TPUPROF_SERVE_TENANT_QUOTA, else 0)")
+    s.add_argument("--job-timeout", type=float, default=None,
+                   dest="job_timeout_s", metavar="SEC",
+                   help="per-job watchdog: a profile running past SEC "
+                        "fails with exit-code-4 semantics and frees its "
+                        "worker instead of wedging the daemon "
+                        "(ROBUSTNESS.md rung 6; default: "
+                        "TPUPROF_JOB_TIMEOUT_S, else off)")
     s.add_argument("--once", action="store_true",
                    help="answer the spool's current jobs, then exit "
                         "(CI / cron mode; default: serve forever)")
@@ -302,6 +309,69 @@ def build_parser() -> argparse.ArgumentParser:
              "(default: ~/.cache/tpuprof/xla; later builds are gated "
              "per-process — see serve/cache.py)")
     serve_cache.add_argument("--no-compile-cache", action="store_true",
+                             help="disable the persistent cache")
+
+    w = sub.add_parser(
+        "watch", help="continuous drift watch: a serve daemon that "
+                      "re-profiles each SOURCE every --every seconds "
+                      "through the warm mesh, persists cycle artifacts "
+                      "(--keep generations), diffs consecutive cycles "
+                      "and raises drift alerts (ROBUSTNESS.md rung 6); "
+                      "the spool still answers `tpuprof submit` jobs")
+    w.add_argument("spool", help="spool directory — watch state lives "
+                                 "under SPOOL/watch/<source-key>/")
+    w.add_argument("sources", nargs="+", metavar="SOURCE",
+                   help="Parquet file/directory path(s) to watch")
+    w.add_argument("--every", type=float, default=None,
+                   dest="watch_every_s", metavar="SEC",
+                   help="seconds between re-profile cycles per source "
+                        "(default: TPUPROF_WATCH_EVERY_S, else 300; "
+                        "0 = back-to-back, the CI mode)")
+    w.add_argument("--keep", type=int, default=None,
+                   dest="artifact_keep", metavar="N",
+                   help="cycle artifacts retained per source; the "
+                        "drift baseline walks past a corrupt head to "
+                        "the newest good generation (default: "
+                        "TPUPROF_ARTIFACT_KEEP, else 3)")
+    w.add_argument("--cycles", type=int, default=None, metavar="N",
+                   help="stop after N cycles over every source "
+                        "(CI/cron mode; default: watch forever)")
+    w.add_argument("--psi-threshold", type=float, default=None,
+                   metavar="X",
+                   help="PSI at or above X alerts at drift severity "
+                        "(default 0.25; warn band at half)")
+    w.add_argument("--ks-threshold", type=float, default=None,
+                   metavar="X",
+                   help="KS distance at or above X alerts at drift "
+                        "severity (default 0.2; warn band at half)")
+    w.add_argument("--job-timeout", type=float, default=None,
+                   dest="job_timeout_s", metavar="SEC",
+                   help="per-job watchdog: a hung cycle profile fails "
+                        "(exit-code-4 semantics) and the watch "
+                        "continues (default: TPUPROF_JOB_TIMEOUT_S, "
+                        "else off)")
+    w.add_argument("--serve-workers", type=int, default=None,
+                   metavar="N",
+                   help="concurrent jobs on the one warm mesh "
+                        "(default: TPUPROF_SERVE_WORKERS, else 2)")
+    w.add_argument("--poll-interval", type=float, default=0.2,
+                   metavar="SEC", help="spool scan cadence")
+    w.add_argument("--config-json", metavar="JSON|@FILE",
+                   help="ProfilerConfig kwargs applied to every watch "
+                        "cycle's profile job, as inline JSON or "
+                        "@path-to-file (unknown keys fail the cycle)")
+    w.add_argument("--metrics-json", metavar="PATH",
+                   help="stream watch_cycle/drift_alert + serve JSONL "
+                        "events here and dump PATH.prom on exit")
+    w.add_argument("--metrics-interval", type=float, default=0.0,
+                   metavar="SEC",
+                   help="with --metrics-json: periodic snapshot cadence")
+    watch_cache = w.add_mutually_exclusive_group()
+    watch_cache.add_argument(
+        "--compile-cache", metavar="DIR", default=None,
+        help="persistent XLA cache for the daemon's first program "
+             "build (default: ~/.cache/tpuprof/xla)")
+    watch_cache.add_argument("--no-compile-cache", action="store_true",
                              help="disable the persistent cache")
 
     u = sub.add_parser(
@@ -442,7 +512,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
     daemon = ServeDaemon(args.spool, poll_interval=args.poll_interval,
                          workers=args.serve_workers,
                          queue_depth=args.serve_queue_depth,
-                         tenant_quota=args.serve_tenant_quota)
+                         tenant_quota=args.serve_tenant_quota,
+                         job_timeout_s=args.job_timeout_s)
     sched = daemon.scheduler
     # a daemon drains on SIGTERM (finish running jobs, flush results +
     # the .prom dump, exit 0) — overriding the flight recorder's
@@ -486,7 +557,111 @@ def cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def _parse_config_json(raw) -> dict:
+    """``--config-json JSON|@FILE`` (submit and watch): a dict of extra
+    ProfilerConfig kwargs.  Raises ValueError in the CLI's bad-request
+    convention."""
+    if not raw:
+        return {}
+    try:
+        if raw.startswith("@"):
+            with open(raw[1:]) as fh:
+                extra = json.load(fh)
+        else:
+            extra = json.loads(raw)
+    except OSError as exc:
+        raise ValueError(str(exc)) from exc
+    if not isinstance(extra, dict):
+        raise ValueError("must be a JSON object")
+    return extra
+
+
+def cmd_watch(args: argparse.Namespace) -> int:
+    from tpuprof import obs
+    from tpuprof.artifact import DriftThresholds
+    from tpuprof.obs import blackbox
+    from tpuprof.serve import DriftWatcher, ServeDaemon
+
+    try:
+        config_kwargs = _parse_config_json(args.config_json)
+    except ValueError as exc:
+        print(f"tpuprof: error: --config-json: {exc}", file=sys.stderr)
+        return 2
+    blackbox.install_signal_handlers()
+    cache_dir = _resolve_cache_dir(args)
+    if cache_dir:
+        from tpuprof.backends.tpu import _enable_compile_cache
+        _enable_compile_cache(cache_dir)
+    ticker = None
+    if args.metrics_json:
+        obs.configure(enabled=True, jsonl_path=args.metrics_json)
+        if args.metrics_interval > 0:
+            from tpuprof.obs.progress import Ticker
+            ticker = Ticker(args.metrics_interval,
+                            snapshots=True).start()
+    daemon = ServeDaemon(args.spool, poll_interval=args.poll_interval,
+                         workers=args.serve_workers,
+                         job_timeout_s=args.job_timeout_s)
+    watcher = DriftWatcher(
+        args.spool, args.sources, daemon.scheduler,
+        every_s=args.watch_every_s, keep=args.artifact_keep,
+        thresholds=DriftThresholds.from_cli(psi=args.psi_threshold,
+                                            ks=args.ks_threshold),
+        job_timeout_s=args.job_timeout_s, config_kwargs=config_kwargs)
+    blackbox.set_context(watch_sources=[w.source
+                                        for w in watcher.watches])
+
+    import signal as _signal
+    import threading as _threading
+
+    def _graceful(signum, frame):
+        blackbox.record("signal", name="SIGTERM", action="drain")
+        watcher.stop_event.set()
+        daemon.stop_event.set()
+
+    try:
+        _signal.signal(_signal.SIGTERM, _graceful)
+    except (ValueError, OSError):
+        pass                    # non-main thread: rely on stop_event
+    print(f"tpuprof: watching {len(watcher.watches)} source(s) every "
+          f"{watcher.every_s:g}s (keep {watcher.keep}"
+          + (f", job timeout {watcher.job_timeout_s:g}s"
+             if watcher.job_timeout_s else "")
+          + f") — spool {args.spool}"
+          + (f" ({args.cycles} cycles)" if args.cycles else ""),
+          file=sys.stderr)
+    # the spool keeps answering `tpuprof submit` while the watch runs:
+    # the daemon's poll loop rides a background thread, the watch loop
+    # owns the foreground
+    spool_thread = _threading.Thread(target=daemon.run, daemon=True,
+                                     name="tpuprof-watch-spool")
+    spool_thread.start()
+    try:
+        watcher.run(cycles=args.cycles)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        watcher.stop_event.set()
+        daemon.stop_event.set()
+        spool_thread.join(timeout=30)
+        daemon.close()
+        if ticker is not None:
+            ticker.stop()
+        if args.metrics_json:
+            obs.finalize(reason="watch")
+            with open(args.metrics_json + ".prom", "w") as fh:
+                fh.write(obs.registry().render_text())
+    st = watcher.stats()
+    c = st["cycles"]
+    print(f"tpuprof: watched {st['sources']} source(s): "
+          f"{c['ok']} ok, {c['warn']} warn, {c['drift']} drift, "
+          f"{c['failed']} failed cycles · {st['alerts']} alerts on "
+          f"file", file=sys.stderr)
+    return 0
+
+
 def cmd_submit(args: argparse.Namespace) -> int:
+    from tpuprof.errors import CorruptResultError, exit_code
     from tpuprof.serve import wait_result, write_job
 
     config = {}
@@ -500,21 +675,12 @@ def cmd_submit(args: argparse.Namespace) -> int:
         config["columns"] = cols
     if args.single_pass:
         config["exact_passes"] = False
-    if args.config_json:
-        raw = args.config_json
-        try:
-            if raw.startswith("@"):
-                with open(raw[1:]) as fh:
-                    extra = json.load(fh)
-            else:
-                extra = json.loads(raw)
-            if not isinstance(extra, dict):
-                raise ValueError("must be a JSON object")
-        except (OSError, ValueError) as exc:
-            print(f"tpuprof: error: --config-json: {exc}",
-                  file=sys.stderr)
-            return 2
-        config.update(extra)
+    try:
+        config.update(_parse_config_json(args.config_json))
+    except ValueError as exc:
+        print(f"tpuprof: error: --config-json: {exc}",
+              file=sys.stderr)
+        return 2
     job_id = write_job(args.spool, args.source, output=args.output,
                        tenant=args.tenant, stats_json=args.stats_json,
                        artifact=args.artifact, config_kwargs=config)
@@ -523,6 +689,11 @@ def cmd_submit(args: argparse.Namespace) -> int:
         return 0
     try:
         result = wait_result(args.spool, job_id, timeout=args.timeout)
+    except CorruptResultError as exc:
+        # the result landed but rotted (non-atomic fs crash, disk rot):
+        # the integrity rung's exit code, not a "daemon down" timeout
+        print(f"tpuprof: error: {exc}", file=sys.stderr)
+        return exit_code(exc)
     except TimeoutError as exc:
         print(f"tpuprof: error: {exc}", file=sys.stderr)
         return 4                    # the watchdog-shaped failure
@@ -746,6 +917,8 @@ def main(argv=None) -> int:
         return cmd_profile(args)
     if args.command == "serve":
         return cmd_serve(args)
+    if args.command == "watch":
+        return cmd_watch(args)
     if args.command == "submit":
         return cmd_submit(args)
     if args.command == "diff":

@@ -275,6 +275,41 @@ def resolve_serve_tenant_quota(value: Optional[int] = None) -> int:
     return max(env, 0) if env is not None else 0
 
 
+def resolve_job_timeout(value: Optional[float] = None) -> Optional[float]:
+    """Per-job serve watchdog (``job_timeout_s`` — ROBUSTNESS.md rung 6):
+    a profile job in the serve daemon that runs past this many seconds
+    raises :class:`~tpuprof.errors.WatchdogTimeout` — the job fails with
+    exit-code-4 semantics and the worker is freed, instead of one hung
+    job wedging the daemon forever.  Explicit config value, else
+    ``TPUPROF_JOB_TIMEOUT_S``, else None = off (the one-shot CLI's
+    historical behavior — a profile may legitimately run for hours)."""
+    return resolve_watchdog_timeout(value, "TPUPROF_JOB_TIMEOUT_S")
+
+
+def resolve_watch_every(value: Optional[float] = None) -> float:
+    """Continuous-drift watch cadence (``tpuprof watch --every``):
+    seconds between re-profile cycles per watched source.  Explicit
+    config value, else ``TPUPROF_WATCH_EVERY_S``, else 300.  0 is legal
+    (back-to-back cycles — the bench/CI mode)."""
+    if value is not None:
+        return max(float(value), 0.0)
+    env = _env_float("TPUPROF_WATCH_EVERY_S")
+    return max(env, 0.0) if env is not None else 300.0
+
+
+def resolve_artifact_keep(value: Optional[int] = None) -> int:
+    """Watch-cycle artifact retention depth per watched source
+    (``tpuprof watch --keep``): how many cycle artifacts stay on disk;
+    older generations rotate away, and the drift-baseline walk falls
+    back past a corrupt head exactly like checkpoint restore does.
+    Explicit config value, else ``TPUPROF_ARTIFACT_KEEP``, else 3 (the
+    current baseline plus two generations of fallback)."""
+    if value is not None:
+        return max(int(value), 1)
+    env = _env_int("TPUPROF_ARTIFACT_KEEP")
+    return max(env, 1) if env is not None else 3
+
+
 PASS_B_KERNELS = ("cumulative", "legacy")
 
 
@@ -615,6 +650,32 @@ class ProfilerConfig:
                                               # None = auto: TPUPROF_
                                               # SERVE_TENANT_QUOTA env,
                                               # else 0
+    job_timeout_s: Optional[float] = None   # serve per-job watchdog
+                                            # (ROBUSTNESS.md rung 6): a
+                                            # job running past this
+                                            # raises WatchdogTimeout —
+                                            # the job fails (exit 4
+                                            # semantics), the worker is
+                                            # freed, the daemon keeps
+                                            # serving.  None = auto:
+                                            # TPUPROF_JOB_TIMEOUT_S
+                                            # env, else off
+    watch_every_s: Optional[float] = None   # continuous-drift watch
+                                            # cadence: seconds between
+                                            # re-profile cycles per
+                                            # watched source (`tpuprof
+                                            # watch --every`).  None =
+                                            # auto: TPUPROF_WATCH_
+                                            # EVERY_S env, else 300
+    artifact_keep: Optional[int] = None     # watch-cycle artifact
+                                            # retention per source
+                                            # (`tpuprof watch --keep`):
+                                            # generations on disk; the
+                                            # baseline walk falls back
+                                            # past a corrupt head like
+                                            # checkpoint restore.  None
+                                            # = auto: TPUPROF_ARTIFACT_
+                                            # KEEP env, else 3
     prepare_workers: Optional[int] = None   # cross-batch host-prep
                                             # pipeline width (decode/hash/
                                             # pack of DIFFERENT batches in
@@ -755,10 +816,17 @@ class ProfilerConfig:
             raise ValueError("liveness_timeout_s must be > 0 (or None)")
         if self.max_quarantined is not None and self.max_quarantined < 0:
             raise ValueError("max_quarantined must be >= 0 (or None)")
-        for fname in ("drain_timeout_s", "barrier_timeout_s"):
+        for fname in ("drain_timeout_s", "barrier_timeout_s",
+                      "job_timeout_s"):
             v = getattr(self, fname)
             if v is not None and v <= 0:
                 raise ValueError(f"{fname} must be > 0 (or None = off)")
+        if self.watch_every_s is not None and self.watch_every_s < 0:
+            raise ValueError(
+                "watch_every_s must be >= 0 (0 = back-to-back cycles; "
+                "or None)")
+        if self.artifact_keep is not None and self.artifact_keep < 1:
+            raise ValueError("artifact_keep must be >= 1 (or None)")
         if self.serve_workers is not None and self.serve_workers < 1:
             raise ValueError("serve_workers must be >= 1 (or None)")
         if self.serve_queue_depth is not None \

@@ -72,6 +72,17 @@ class CorruptArtifactError(ValueError):
     to exit code 6."""
 
 
+class CorruptResultError(CorruptArtifactError):
+    """A serve result file (tpuprof/serve spool transport) exists but
+    does not parse — torn by a crash on a non-atomic filesystem or
+    rotted on disk.  ``wait_result`` re-polls past it (the writer may
+    still replace it atomically) and raises THIS at the deadline instead
+    of a misleading "is the daemon running?" timeout; ``read_result``
+    raises it immediately.  Never a raw ``json.JSONDecodeError``.
+    Subclasses :class:`CorruptArtifactError`, so it shares exit code 6
+    ("a persisted product rotted")."""
+
+
 class PoisonBatchError(RuntimeError):
     """A batch failed permanently and no quarantine budget remains."""
 

@@ -8,8 +8,12 @@ is paid once and every warm profile answers in sub-seconds:
                       + shape signature) + the per-process persistent-
                       compile-cache gate
 * serve/jobs.py       job state machine + bounded multi-tenant queue
-* serve/scheduler.py  worker pool, SLO metrics, job lifecycle
+* serve/scheduler.py  worker pool, SLO metrics, job lifecycle,
+                      per-job watchdog (job_timeout_s)
 * serve/server.py     spool-directory daemon + submit client transport
+* serve/watch.py      continuous drift watch: scheduled re-profiles,
+                      artifact retention, alerting, crash-safe
+                      watch-manifest recovery (ROBUSTNESS.md rung 6)
 
 The CLI (`tpuprof serve` / `tpuprof submit`) is one client of this
 package; embed :class:`ProfileScheduler` directly for in-process use
@@ -23,10 +27,15 @@ from tpuprof.serve.jobs import (Job, JobQueue, QueueClosed, QueueFull,
 from tpuprof.serve.scheduler import ProfileScheduler
 from tpuprof.serve.server import (ServeDaemon, read_result, wait_result,
                                   write_job)
+from tpuprof.serve.watch import (DriftWatcher, SourceWatch,
+                                 WATCH_MANIFEST_SCHEMA, read_manifest,
+                                 write_manifest)
 
 __all__ = [
-    "Job", "JobQueue", "ProfileScheduler", "QueueClosed", "QueueFull",
-    "RunnerCache", "ServeDaemon", "TenantQuotaExceeded",
-    "acquire_runner", "cache_stats", "process_cache", "read_result",
-    "runner_key", "wait_result", "write_job",
+    "DriftWatcher", "Job", "JobQueue", "ProfileScheduler",
+    "QueueClosed", "QueueFull", "RunnerCache", "ServeDaemon",
+    "SourceWatch", "TenantQuotaExceeded", "WATCH_MANIFEST_SCHEMA",
+    "acquire_runner", "cache_stats", "process_cache", "read_manifest",
+    "read_result", "runner_key", "wait_result", "write_job",
+    "write_manifest",
 ]

@@ -55,11 +55,16 @@ class ProfileScheduler:
     def __init__(self, workers: Optional[int] = None,
                  queue_depth: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
+                 job_timeout_s: Optional[float] = None,
                  devices: Optional[Sequence] = None):
-        from tpuprof.config import (resolve_serve_queue_depth,
+        from tpuprof.config import (resolve_job_timeout,
+                                    resolve_serve_queue_depth,
                                     resolve_serve_tenant_quota,
                                     resolve_serve_workers)
         self.workers = resolve_serve_workers(workers)
+        # daemon-level default for jobs that say nothing about their
+        # own timeout; a job's explicit job_timeout_s override wins
+        self.job_timeout_s = resolve_job_timeout(job_timeout_s)
         self._queue = JobQueue(resolve_serve_queue_depth(queue_depth),
                                resolve_serve_tenant_quota(tenant_quota))
         self._devices = devices
@@ -112,8 +117,7 @@ class ProfileScheduler:
         _QUEUE_DEPTH.set(len(self._queue))
         return job
 
-    @staticmethod
-    def _build_config(job: Job):
+    def _build_config(self, job: Job):
         """Validate the job's config overrides NOW (admission time):
         a typo'd option must reject in milliseconds, not fail a queued
         job minutes later.  Unknown keys reject explicitly — the
@@ -137,6 +141,11 @@ class ProfileScheduler:
             raise ValueError(f"unknown config options {unknown}")
         if job.artifact:
             kwargs.setdefault("artifact_path", job.artifact)
+        if self.job_timeout_s is not None:
+            # the rung-4 ladder extended into serve (ROBUSTNESS.md rung
+            # 6): every job inherits the daemon's watchdog unless it
+            # names its own deadline
+            kwargs.setdefault("job_timeout_s", self.job_timeout_s)
         if "metrics_enabled" not in kwargs:
             # collect() applies each config's obs knobs PROCESS-WIDE
             # (one-shot CLI semantics); a job that says nothing about
@@ -170,21 +179,38 @@ class ProfileScheduler:
             self._active[job.id] = job
         _ACTIVE.inc()
         try:
-            from tpuprof import ProfileReport
-            report = ProfileReport(job.source, config=config)
-            if job.output:
-                report.to_file(job.output)
-            if job.stats_json:
-                with open(job.stats_json, "w") as fh:
-                    json.dump(report.to_json_dict(), fh, indent=2)
-            if config.artifact_path:
-                from tpuprof.artifact import write_artifact
-                write_artifact(config.artifact_path,
-                               stats=report.description, config=config,
-                               source=str(job.source))
-            table = report.description["table"]
-            job.result = {"rows": int(table["n"]),
-                          "cols": int(table["nvar"])}
+            def _body() -> None:
+                from tpuprof.testing import faults as _faults
+                _faults.hit("serve_job", key=job.id)
+                from tpuprof import ProfileReport
+                report = ProfileReport(job.source, config=config)
+                if job.output:
+                    report.to_file(job.output)
+                if job.stats_json:
+                    with open(job.stats_json, "w") as fh:
+                        json.dump(report.to_json_dict(), fh, indent=2)
+                if config.artifact_path:
+                    from tpuprof.artifact import write_artifact
+                    write_artifact(config.artifact_path,
+                                   stats=report.description,
+                                   config=config, source=str(job.source))
+                table = report.description["table"]
+                job.result = {"rows": int(table["n"]),
+                              "cols": int(table["nvar"])}
+
+            # per-job watchdog (ROBUSTNESS.md rung 6): a hung profile
+            # raises WatchdogTimeout — THIS job fails with exit-code-4
+            # semantics and the worker is freed (the body thread is
+            # abandoned), instead of wedging the daemon forever.  With
+            # no timeout the body runs unwrapped — zero overhead, the
+            # historical path.
+            from tpuprof.config import resolve_job_timeout
+            from tpuprof.runtime import guard
+            guard.watched(
+                _body, resolve_job_timeout(config.job_timeout_s),
+                site="serve_job",
+                heartbeat=lambda: {"job": job.id, "tenant": job.tenant,
+                                   "source": str(job.source)})
             job.to(DONE)
         except TYPED_ERRORS as exc:
             # the degradation ladder ran out for THIS job: it fails

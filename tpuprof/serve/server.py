@@ -87,23 +87,54 @@ def write_job(spool: str, source: str, output: Optional[str] = None,
 
 
 def read_result(spool: str, job_id: str) -> Optional[Dict[str, Any]]:
+    """One result read: the record dict, None when it has not landed
+    yet, or a typed :class:`~tpuprof.errors.CorruptResultError` when a
+    file EXISTS but does not parse — never a raw ``JSONDecodeError``
+    (the daemon writes atomically, so a torn record means a non-atomic
+    filesystem crash or on-disk rot, which the caller must be able to
+    tell apart from "the daemon has not answered")."""
+    from tpuprof.errors import CorruptResultError
     path = os.path.join(spool, "results", f"{job_id}.json")
     try:
-        with open(path) as fh:
-            return json.load(fh)
-    except (OSError, ValueError):
-        return None         # absent, or mid-rename on a non-posix fs
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except OSError:
+        return None                     # not answered yet
+    try:
+        doc = json.loads(data)
+    except ValueError as exc:
+        raise CorruptResultError(
+            f"result file {path!r} is torn or corrupt "
+            f"({type(exc).__name__}: {exc})") from exc
+    if not isinstance(doc, dict):
+        raise CorruptResultError(
+            f"result file {path!r} decodes to {type(doc).__name__}, "
+            "not a result record")
+    return doc
 
 
 def wait_result(spool: str, job_id: str, timeout: Optional[float] = None,
                 poll_interval: float = 0.1) -> Dict[str, Any]:
-    """Poll the results dir until the job's terminal record lands."""
+    """Poll the results dir until the job's terminal record lands.
+
+    A torn result file is re-polled, not fatal — on a non-atomic
+    filesystem the writer's rename may still land a whole record — but
+    at the deadline the typed :class:`CorruptResultError` surfaces
+    instead of a misleading "is the daemon running?" timeout."""
+    from tpuprof.errors import CorruptResultError
     deadline = None if timeout is None else time.monotonic() + timeout
+    corrupt: Optional[CorruptResultError] = None
     while True:
-        res = read_result(spool, job_id)
+        try:
+            res = read_result(spool, job_id)
+            corrupt = None
+        except CorruptResultError as exc:
+            res, corrupt = None, exc
         if res is not None:
             return res
         if deadline is not None and time.monotonic() > deadline:
+            if corrupt is not None:
+                raise corrupt
             raise TimeoutError(
                 f"no result for job {job_id} after {timeout}s — is "
                 f"`tpuprof serve {spool}` running?")
@@ -148,6 +179,14 @@ class ServeDaemon:
 
     def _ingest_job_file(self, name: str) -> None:
         path = os.path.join(self.dirs["jobs"], name)
+        # crash-safe restart idempotence: a daemon killed between
+        # writing the result and unlinking the request must not re-run
+        # (and re-answer) the job on restart — exactly-once results
+        jid = name[: -len(".json")]
+        if os.path.exists(os.path.join(self.dirs["results"],
+                                       f"{jid}.json")):
+            self._unlink_job(name)
+            return
         try:
             with open(path) as fh:
                 req = json.load(fh)

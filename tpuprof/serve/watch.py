@@ -1,0 +1,522 @@
+"""Continuous drift watch inside the serve daemon (ROBUSTNESS.md rung 6,
+ROADMAP item 1 remainder (a) / item 4 "next rung").
+
+``tpuprof watch SPOOL SOURCE ...`` turns the resident daemon from
+"profiles when asked" into "watches its own data": per watched source,
+a :class:`DriftWatcher` re-profiles on a configured cadence THROUGH the
+existing scheduler (one warm mesh, the same quota/queue machinery every
+`tpuprof submit` job uses), persists each cycle as a ``tpuprof-stats-v1``
+artifact (tpuprof/artifact), diffs consecutive cycles with the drift
+engine, and raises alerts when PSI/KS/schema bands cross
+:class:`~tpuprof.artifact.DriftThresholds`.
+
+Continuous operation is the robustness core — a watch loop that runs
+for weeks meets every failure a one-shot profile meets, plus its own:
+
+* **Per-job watchdog** — the scheduler wraps each job body in
+  ``guard.watched(job_timeout_s)`` (serve/scheduler.py), so a hung
+  profile raises :class:`WatchdogTimeout`, frees the worker, and fails
+  THAT job with exit-code-4 semantics instead of wedging the daemon.
+* **Crash-safe recovery** — watch state (cycle counter, baseline
+  artifact path, alert dedup cursor) persists in a CRC-sealed,
+  atomically-written *watch manifest* per source.  A torn/truncated
+  manifest is the typed :class:`CorruptManifestError` — never a raw
+  JSON error — and the restore path degrades to rebuilding state from
+  the retained artifact chain on disk, recording an alert.  (Spool jobs
+  with no result are re-run by the daemon itself — serve/server.py.)
+* **Artifact retention** — ``artifact_keep`` cycle artifacts per source
+  rotate on disk; the drift-baseline read walks past a corrupt head to
+  the newest good generation, exactly as checkpoint restore does.
+* **Degraded-cycle semantics** — a cycle whose profile fails (poison
+  data, watchdog kill, torn artifact, injected fault) records a
+  ``failed_cycle`` alert and the watch CONTINUES; the baseline stays at
+  the last good cycle, so the next comparison is still meaningful.
+
+What the operator sees: ``drift_alert`` JSONL events,
+``tpuprof_drift_alerts_total{severity}`` /
+``tpuprof_watch_cycles_total{status}`` metrics, and a pollable
+``alerts.json`` per watched source (OBSERVABILITY.md "Continuous drift
+watch").
+
+Layout under the spool dir::
+
+    watch/<key>/manifest.json              CRC-sealed watch state
+    watch/<key>/cycle_<n>.artifact.json    retained cycle artifacts
+    watch/<key>/alerts.json                the operator-pollable
+                                           alert feed (newest last,
+                                           capped at ALERTS_CAP)
+
+where ``<key>`` is the source basename plus a short path hash — stable
+across restarts, collision-free across sources with one name.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Sequence
+
+from tpuprof.errors import (CorruptArtifactError, CorruptManifestError,
+                            TYPED_ERRORS, exit_code)
+from tpuprof.obs import blackbox
+from tpuprof.obs import events as _obs_events
+from tpuprof.obs import metrics as _obs_metrics
+from tpuprof.serve.jobs import DONE
+from tpuprof.testing import faults as _faults
+
+WATCH_MANIFEST_SCHEMA = "tpuprof-watch-manifest-v1"
+
+# the alert feed is an operator surface, not an archive: the JSONL
+# event stream (and the metrics counters) keep the full history
+ALERTS_CAP = 256
+
+_CYCLES = _obs_metrics.counter(
+    "tpuprof_watch_cycles_total",
+    "drift-watch cycles by outcome (ok|warn|drift|failed)")
+_ALERTS = _obs_metrics.counter(
+    "tpuprof_drift_alerts_total",
+    "drift-watch alerts raised, by severity (warn|drift|failed)")
+_CYCLE_SECONDS = _obs_metrics.histogram(
+    "tpuprof_watch_cycle_seconds",
+    "wall seconds per watch cycle (submit -> alert decision)")
+_FALLBACKS = _obs_metrics.counter(
+    "tpuprof_watch_artifact_fallbacks_total",
+    "baseline reads that walked past a corrupt retained artifact head")
+
+# canonical serialization the manifest CRC covers — the artifact
+# store's idiom: key-sorted, no whitespace, so any parsed-value change
+# changes these bytes
+_CANON = {"sort_keys": True, "separators": (",", ":")}
+
+_CYCLE_RE = re.compile(r"cycle_(\d{8})\.artifact\.json$")
+
+
+def source_key(source: Any) -> str:
+    """Stable per-source directory name: sanitized basename + a short
+    hash of the absolute path (two sources named ``data.parquet`` in
+    different directories must not share watch state)."""
+    text = str(source)
+    base = re.sub(r"[^A-Za-z0-9._-]+", "_",
+                  os.path.basename(text.rstrip("/")) or "source")
+    digest = hashlib.sha1(os.path.abspath(text).encode()).hexdigest()[:8]
+    return f"{base}-{digest}"
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+
+
+def write_manifest(path: str, state: Dict[str, Any]) -> None:
+    """Atomically persist one source's watch state, CRC-sealed so a
+    torn write can never be mistaken for a valid cursor."""
+    core = {"schema": WATCH_MANIFEST_SCHEMA}
+    core.update(state)
+    doc = dict(core)
+    doc["integrity"] = {
+        "algorithm": "crc32/canonical-json",
+        "crc32": zlib.crc32(json.dumps(core, **_CANON).encode())
+        & 0xFFFFFFFF,
+    }
+    _atomic_write(path, json.dumps(doc, indent=1).encode())
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    """Read + integrity-check a watch manifest.  A genuinely missing
+    file raises ``FileNotFoundError`` ("first cycle ever"); EVERY other
+    failure — truncation at any offset, bit rot, junk, a foreign
+    schema — is the typed :class:`CorruptManifestError`."""
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except FileNotFoundError:
+        raise
+    except OSError as exc:
+        raise CorruptManifestError(
+            f"watch manifest {path!r} is unreadable "
+            f"({type(exc).__name__}: {exc})") from exc
+    try:
+        doc = json.loads(data)
+    except Exception as exc:
+        raise CorruptManifestError(
+            f"watch manifest {path!r} is not valid JSON — truncated or "
+            f"corrupt ({type(exc).__name__}: {exc})") from exc
+    if not isinstance(doc, dict) or doc.get("schema") != WATCH_MANIFEST_SCHEMA:
+        raise CorruptManifestError(
+            f"watch manifest {path!r} has schema "
+            f"{doc.get('schema') if isinstance(doc, dict) else type(doc).__name__!r}; "
+            f"this build reads {WATCH_MANIFEST_SCHEMA!r}")
+    integrity = doc.pop("integrity", None)
+    if not isinstance(integrity, dict) or "crc32" not in integrity:
+        raise CorruptManifestError(
+            f"watch manifest {path!r} lacks its integrity envelope — "
+            "torn or hand-edited")
+    canon = json.dumps(doc, **_CANON).encode()
+    if zlib.crc32(canon) & 0xFFFFFFFF != integrity["crc32"]:
+        raise CorruptManifestError(
+            f"watch manifest {path!r} CRC mismatch — corrupt manifest")
+    return doc
+
+
+class SourceWatch:
+    """One watched source's durable state: the cycle counter, the
+    baseline artifact, the alert dedup cursor, and the retained
+    artifact chain on disk."""
+
+    def __init__(self, root: str, source: Any, keep: int):
+        self.source = str(source)
+        self.key = source_key(source)
+        self.dir = os.path.join(root, self.key)
+        os.makedirs(self.dir, exist_ok=True)
+        self.keep = max(int(keep), 1)
+        self.cycle = 0                      # completed (or failed) cycles
+        self.last_artifact: Optional[str] = None
+        self.alert_seq = 0
+        self.last_alert_key: Optional[List[Any]] = None
+        self.alerts: List[Dict[str, Any]] = []
+        self.recovered: Optional[str] = None   # degraded-restore note
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.dir, "manifest.json")
+
+    @property
+    def alerts_path(self) -> str:
+        return os.path.join(self.dir, "alerts.json")
+
+    def artifact_path(self, cycle: int) -> str:
+        return os.path.join(self.dir, f"cycle_{cycle:08d}.artifact.json")
+
+    def chain(self) -> List[tuple]:
+        """Retained ``(cycle, path)`` artifacts, newest first."""
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        for name in names:
+            m = _CYCLE_RE.match(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.dir, name)))
+        return sorted(out, reverse=True)
+
+    # -- crash-safe restore -------------------------------------------------
+
+    def restore(self) -> None:
+        """Adopt the on-disk state: the manifest when it verifies, else
+        (torn manifest — the typed path) a degraded rebuild from the
+        retained artifact chain, noted on :attr:`recovered` so the
+        watcher records it as an alert."""
+        try:
+            doc = read_manifest(self.manifest_path)
+            self.cycle = int(doc.get("cycle") or 0)
+            self.last_artifact = doc.get("last_artifact")
+            self.alert_seq = int(doc.get("alert_seq") or 0)
+            key = doc.get("last_alert_key")
+            self.last_alert_key = list(key) if key is not None else None
+        except FileNotFoundError:
+            # fresh source — unless artifacts exist with no manifest (a
+            # crash before the very first manifest write): adopt the
+            # chain so cycle numbers never collide
+            self._rebuild_from_chain(reason=None)
+        except CorruptManifestError as exc:
+            self._rebuild_from_chain(
+                reason=f"{type(exc).__name__}: {exc}")
+        # the alert feed is advisory: restore best-effort, never fatal
+        try:
+            with open(self.alerts_path) as fh:
+                alerts = json.load(fh)
+            if isinstance(alerts, list):
+                self.alerts = alerts[-ALERTS_CAP:]
+        except (OSError, ValueError):
+            pass
+        self.alert_seq = max(
+            self.alert_seq,
+            max((int(a.get("seq") or 0) for a in self.alerts
+                 if isinstance(a, dict)), default=0))
+
+    def _rebuild_from_chain(self, reason: Optional[str]) -> None:
+        chain = self.chain()
+        self.cycle = chain[0][0] if chain else 0
+        self.last_artifact = None       # baseline() re-walks the chain
+        self.alert_seq = 0              # re-derived from alerts.json
+        self.last_alert_key = None
+        if reason:
+            self.recovered = reason
+
+    def baseline(self, before: Optional[int] = None):
+        """The newest READABLE retained artifact (the drift comparison
+        base), walking past corrupt heads the way checkpoint restore
+        walks its generations.  ``before`` excludes the cycle currently
+        being produced.  Returns the Artifact or None (first cycle /
+        fully-corrupt chain)."""
+        from tpuprof.artifact import read_artifact
+        for cyc, path in self.chain():
+            if before is not None and cyc >= before:
+                continue
+            try:
+                art = read_artifact(path)
+            except (CorruptArtifactError, OSError) as exc:
+                _FALLBACKS.inc()
+                blackbox.record("watch_artifact_fallback",
+                                source=self.source, path=path,
+                                error=f"{type(exc).__name__}: {exc}")
+                continue
+            self.last_artifact = path
+            return art
+        self.last_artifact = None
+        return None
+
+    def rotate(self) -> None:
+        """Retention: keep the newest ``keep`` cycle artifacts, and
+        sweep stray ``.part`` files left by failed/abandoned cycles
+        (only the watcher renames a .part into the chain, so at rotate
+        time — a cycle just succeeded — none is in flight)."""
+        for _cyc, path in self.chain()[self.keep:]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        try:
+            strays = [n for n in os.listdir(self.dir)
+                      if n.endswith(".artifact.json.part")]
+        except OSError:
+            strays = []
+        for name in strays:
+            try:
+                os.unlink(os.path.join(self.dir, name))
+            except OSError:
+                pass
+
+
+class DriftWatcher:
+    """The watch loop: per source, re-profile -> persist -> diff ->
+    alert, on a cadence, forever (or ``cycles`` times in CI mode)."""
+
+    def __init__(self, spool: str, sources: Sequence[Any], scheduler,
+                 every_s: Optional[float] = None,
+                 keep: Optional[int] = None,
+                 thresholds=None,
+                 job_timeout_s: Optional[float] = None,
+                 config_kwargs: Optional[Dict[str, Any]] = None,
+                 tenant: str = "watch"):
+        from tpuprof.artifact import DriftThresholds
+        from tpuprof.config import (resolve_artifact_keep,
+                                    resolve_job_timeout,
+                                    resolve_watch_every)
+        if not sources:
+            raise ValueError("watch needs at least one source")
+        self.spool = spool
+        self.root = os.path.join(spool, "watch")
+        os.makedirs(self.root, exist_ok=True)
+        self.scheduler = scheduler
+        self.every_s = resolve_watch_every(every_s)
+        self.keep = resolve_artifact_keep(keep)
+        self.thresholds = thresholds or DriftThresholds()
+        self.job_timeout_s = resolve_job_timeout(job_timeout_s)
+        self.config_kwargs = dict(config_kwargs or {})
+        self.tenant = str(tenant)
+        self.stop_event = threading.Event()
+        self.counts = {"ok": 0, "warn": 0, "drift": 0, "failed": 0}
+        self.watches: List[SourceWatch] = []
+        for src in sources:
+            w = SourceWatch(self.root, src, self.keep)
+            w.restore()
+            self.watches.append(w)
+            if w.recovered:
+                # the manifest was torn: state was rebuilt from the
+                # artifact chain — continuity is degraded (the alert
+                # cursor restarted), and the operator must know
+                self._alert(w, kind="corrupt_manifest",
+                            severity="failed", cycle=w.cycle,
+                            error=w.recovered)
+
+    # -- one cycle ----------------------------------------------------------
+
+    def run_cycle(self, w: SourceWatch) -> Dict[str, Any]:
+        """Profile ``w.source`` once through the scheduler, persist the
+        artifact, diff vs the baseline, alert, rotate, seal the
+        manifest.  NEVER raises on a failing cycle — degraded-cycle
+        semantics: the failure becomes a ``failed_cycle`` alert and the
+        watch continues (the daemon's reason to exist is the NEXT
+        cycle)."""
+        t0 = time.perf_counter()
+        cycle = w.cycle + 1
+        art_path = w.artifact_path(cycle)
+        # the job writes a job-PRIVATE .part file; the artifact enters
+        # the retained chain only through the watcher's validate+rename
+        # on confirmed success.  A watchdog-abandoned job body that
+        # wakes up later and finishes its write can then never
+        # resurrect a failed cycle's artifact into the chain (found
+        # driving the chaos gauntlet: the abandoned thread's late write
+        # landed AFTER the failure path's unlink and became the newest
+        # "good" baseline).
+        part_path = art_path + ".part"
+        status = "ok"
+        extra: Dict[str, Any] = {}
+        try:
+            _faults.hit("watch_cycle", key=cycle)
+            kwargs = dict(self.config_kwargs)
+            if self.job_timeout_s is not None:
+                kwargs.setdefault("job_timeout_s", self.job_timeout_s)
+            job = self.scheduler.submit(
+                source=w.source, tenant=self.tenant, artifact=part_path,
+                config_kwargs=kwargs)
+            # the per-job watchdog is the hang protection; this wait
+            # deadline only bounds the watcher when one is configured
+            wait_s = None if self.job_timeout_s is None \
+                else self.job_timeout_s + 600.0
+            job = self.scheduler.wait(job, timeout=wait_s)
+            if job.state != DONE:
+                err = RuntimeError(
+                    f"profile job {job.state}: {job.error}")
+                err.exit_code = job.exit_code   # type: ignore[attr-defined]
+                raise err
+            from tpuprof.artifact import compute_drift, read_artifact
+            baseline = w.baseline(before=cycle)
+            current = read_artifact(part_path)   # torn write -> typed
+            os.replace(part_path, art_path)      # admit to the chain
+            current.path = art_path
+            if baseline is not None:
+                drift = compute_drift(baseline, current, self.thresholds)
+                s = drift["summary"]
+                status = s["verdict"]            # ok | warn | drift
+                extra = {"n_drift": s["n_drift"], "n_warn": s["n_warn"],
+                         "row_delta": s["row_delta"]}
+                if status == "ok":
+                    # drift cleared: the next episode re-alerts
+                    w.last_alert_key = None
+                else:
+                    flagged = sorted(
+                        c for c, e in drift["columns"].items()
+                        if e["status"] != "ok")
+                    self._alert(w, kind="drift", severity=status,
+                                cycle=cycle, verdict=status,
+                                n_drift=s["n_drift"],
+                                n_warn=s["n_warn"],
+                                columns=flagged[:16],
+                                baseline=baseline.path,
+                                artifact=art_path)
+            w.cycle = cycle
+            w.last_artifact = art_path
+            w.rotate()
+        except Exception as exc:        # noqa: BLE001 — a watch survives
+            status = "failed"
+            # the failed cycle's .part (absent, partial, or torn) is
+            # worthless — drop it; a late write by an abandoned job
+            # body leaves only a stray .part, which rotate() sweeps
+            try:
+                os.unlink(part_path)
+            except OSError:
+                pass
+            code = getattr(exc, "exit_code", None)
+            if code is None:
+                code = exit_code(exc) if isinstance(exc, TYPED_ERRORS) \
+                    else 1
+            self._alert(w, kind="failed_cycle", severity="failed",
+                        cycle=cycle,
+                        error=f"{type(exc).__name__}: {exc}",
+                        exit_code=code)
+            w.cycle = cycle             # failed cycles count: artifact
+                                        # names stay collision-free and
+                                        # the cadence accounting honest
+        seconds = time.perf_counter() - t0
+        self.counts[status] = self.counts.get(status, 0) + 1
+        if _obs_metrics.enabled():
+            _CYCLES.inc(status=status)
+            _CYCLE_SECONDS.observe(seconds)
+        _obs_events.emit("watch_cycle", source=w.source, cycle=cycle,
+                         status=status, seconds=round(seconds, 4),
+                         artifact=w.last_artifact, **extra)
+        self._save(w)
+        return {"source": w.source, "cycle": cycle, "status": status,
+                "seconds": seconds, **extra}
+
+    # -- alerts -------------------------------------------------------------
+
+    def _alert(self, w: SourceWatch, *, kind: str, severity: str,
+               cycle: int, **fields) -> Optional[Dict[str, Any]]:
+        key = [kind, severity, list(fields.get("columns") or [])]
+        if kind == "drift" and w.last_alert_key == key:
+            # dedup: the SAME ongoing drift episode (same severity, same
+            # column set) does not re-alert every cycle — the cycle
+            # record still carries the verdict, and any change in shape
+            # (new column, warn->drift) is a new alert.  The dedup key
+            # rides the manifest, so a restart does not re-fire it.
+            return None
+        w.alert_seq += 1
+        alert = {"seq": w.alert_seq, "ts": round(time.time(), 3),
+                 "source": w.source, "cycle": cycle, "kind": kind,
+                 "severity": severity}
+        alert.update(fields)
+        w.alerts.append(alert)
+        w.alerts = w.alerts[-ALERTS_CAP:]
+        if kind == "drift":
+            w.last_alert_key = key
+        if _obs_metrics.enabled():
+            _ALERTS.inc(severity=severity)
+        # the JSONL twin ("kind" is the event discriminator, so the
+        # alert's own kind rides as "alert")
+        _obs_events.emit("drift_alert", alert=alert["kind"],
+                         **{k: v for k, v in alert.items()
+                            if k not in ("ts", "kind")})
+        try:
+            _atomic_write(w.alerts_path,
+                          json.dumps(w.alerts, indent=1,
+                                     default=str).encode())
+        except OSError:
+            pass        # the feed is best-effort; events/metrics rule
+        return alert
+
+    def _save(self, w: SourceWatch) -> None:
+        write_manifest(w.manifest_path, {
+            "source": w.source,
+            "cycle": w.cycle,
+            "last_artifact": w.last_artifact,
+            "alert_seq": w.alert_seq,
+            "last_alert_key": w.last_alert_key,
+            "keep": w.keep,
+            "updated_unix": round(time.time(), 3),
+        })
+
+    # -- the loop -----------------------------------------------------------
+
+    def run(self, cycles: Optional[int] = None) -> None:
+        """Watch until :attr:`stop_event` (or for ``cycles`` rounds over
+        every source — the CI/bench mode)."""
+        done = 0
+        while not self.stop_event.is_set():
+            for w in self.watches:
+                if self.stop_event.is_set():
+                    return
+                self.run_cycle(w)
+            done += 1
+            if cycles is not None and done >= cycles:
+                return
+            self.stop_event.wait(self.every_s)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "sources": len(self.watches),
+            "cycles": dict(self.counts),
+            "alerts": sum(len(w.alerts) for w in self.watches),
+            "every_s": self.every_s,
+            "keep": self.keep,
+        }

@@ -20,6 +20,12 @@ Sites wired today (grep ``faults.hit`` / ``faults.mangle``):
 ``barrier``               the watched multi-host resume barrier
 ``host_death``            per-batch fleet-participation kill switch
                           (collect fold loop + StreamingProfiler fold)
+``serve_job``             per-job serve execution (serve/scheduler.py —
+                          fails THAT job, the daemon keeps serving;
+                          ``sleep=S`` here is the job-watchdog food)
+``watch_cycle``           per-cycle drift watch (serve/watch.py — a
+                          raising cycle records a failed-cycle alert
+                          and the watch continues)
 ========================  ==================================================
 
 Spec grammar (config/env-driven; ``TPUPROF_FAULTS`` +
@@ -46,7 +52,9 @@ Spec grammar (config/env-driven; ``TPUPROF_FAULTS`` +
 * ``truncate@M`` — for byte-producing sites (``checkpoint_write``):
   :func:`mangle` drops the second half of the payload on the M-th
   call, simulating a torn write that still survived the rename.
-* ``sleep=S`` — delay S seconds on every call (watchdog tests).
+* ``sleep=S`` — delay S seconds on every call (watchdog tests);
+  ``sleep=S@M`` delays ONLY the M-th call (1-based; first attempts for
+  keyed sites) — "hang exactly that job".
 * ``@M`` — host death: raise :class:`HostDeathError` on the M-th call
   (first attempts only for keyed sites) and never again — the process
   is expected to stop participating.  Written ``host_death:@k``:
@@ -94,7 +102,19 @@ class _Rule:
                 raise ValueError(f"death call number must be >=1: {mode!r}")
         elif mode.startswith("sleep="):
             self.kind = "sleep"
-            self.sleep_s = float(mode[len("sleep="):])
+            rest = mode[len("sleep="):]
+            if "@" in rest:
+                # windowed sleep (``sleep=S@M``): delay ONLY the M-th
+                # call — "hang exactly that job" for watchdog tests,
+                # where an every-call sleep would stall the whole run
+                secs, at = rest.split("@", 1)
+                self.sleep_s = float(secs)
+                self.start, self.count = int(at), 1
+                if self.start < 1:
+                    raise ValueError(
+                        f"sleep call number must be >=1: {mode!r}")
+            else:
+                self.sleep_s = float(rest)
         elif "@" in mode:
             left, at = mode.split("@", 1)
             self.start = int(at)
@@ -169,8 +189,16 @@ class FaultPlan:
             if first:
                 rule.firsts += 1
             first_no = rule.firsts
+            do_sleep = False
             if rule.kind == "sleep":
-                pass                         # sleep outside the lock
+                # start 0 = every call (the historic grammar); start>=1
+                # sleeps on that one call only (``sleep=S@M``)
+                n = first_no if key is not None else call_no
+                do_sleep = rule.start == 0 or (
+                    (first or key is None)
+                    and rule.start <= n < rule.start + rule.count)
+                # sleep happens outside the lock; never counted by
+                # injected() — sleeps are delays, not raises
             elif rule.kind == "p":
                 if key is not None:
                     # order-free determinism: one draw per (key, attempt)
@@ -212,7 +240,7 @@ class FaultPlan:
                         f"injected transient fault at {site!r} "
                         f"(call {n})")
             # "truncate" never raises in fire(); mangle() applies it
-        if rule.kind == "sleep":
+        if rule.kind == "sleep" and do_sleep:
             time.sleep(rule.sleep_s)
 
     def mangle_bytes(self, site: str, data: bytes) -> bytes:
