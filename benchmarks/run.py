@@ -1103,6 +1103,163 @@ def measure_watch(rows: int, workdir: str) -> dict:
     }
 
 
+def measure_serve_http(rows: int, workdir: str, jobs: int = 104,
+                       tenants: int = 4, daemons: int = 2,
+                       kill_jobs: int = 12) -> dict:
+    """Network serving plane envelope (ISSUE 11): ``daemons`` real
+    `tpuprof serve --http 0` processes on ONE shared spool, driven
+    over HTTP —
+
+    * byte-identity: one HTTP-served stats export must equal the
+      one-shot in-process path exactly (the leg FAILS otherwise);
+    * load: ``jobs`` jobs from ``tenants`` authenticated tenants
+      round-robined across both edges -> ``serve_http_rps`` and the
+      p50/p99 of the per-job end-to-end latency (queue wait included
+      — the SLO the submitters experience);
+    * kill-one lane: a second batch accepted by BOTH edges, then one
+      daemon SIGKILLed mid-load — every accepted job must still get
+      exactly one result (claims go stale, the survivor steals;
+      ``serve_http_killed_lost`` must be 0)."""
+    import shutil
+    import signal
+    import subprocess
+
+    fixture = _ensure_fixture("taxi", rows, workdir)
+    spool = os.path.join(workdir, "serve_http_spool")
+    shutil.rmtree(spool, ignore_errors=True)
+    auth_path = os.path.join(workdir, "serve_http_tokens")
+    with open(auth_path, "w") as fh:
+        for k in range(tenants):
+            fh.write(f"token{k} tenant{k}\n")
+    cfg = {"batch_rows": 1 << 12}
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    from tpuprof.serve import (discover_edges, submit_job, wait_result,
+                               wait_result_http)
+
+    def spawn(daemon_id):
+        return subprocess.Popen(
+            [sys.executable, "-m", "tpuprof", "serve", spool,
+             "--http", "0", "--daemon-id", daemon_id,
+             "--serve-workers", "2", "--serve-queue-depth", "256",
+             "--liveness-timeout", "2", "--serve-auth-file", auth_path,
+             "--no-compile-cache"],
+            cwd=here, stderr=subprocess.DEVNULL)
+
+    procs = {f"d{k}": spawn(f"d{k}") for k in range(daemons)}
+    out: dict = {"rows": rows}
+    try:
+        deadline = time.monotonic() + 300
+        while len(discover_edges(spool)) < daemons:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"edges never advertised: {discover_edges(spool)}")
+            time.sleep(0.2)
+        urls = discover_edges(spool)
+        edge_list = [urls[f"d{k}"] for k in range(daemons)]
+
+        # warm every daemon (first job pays the compile; the load
+        # numbers below measure the WARM fleet, like the serve leg)
+        for url in edge_list:
+            _code, doc = submit_job(url, fixture, tenant="tenant0",
+                                    config_kwargs=dict(cfg),
+                                    token="token0")
+            res = wait_result_http(url, doc["id"], timeout=1800,
+                                   token="token0")
+            if res["status"] != "done":
+                raise RuntimeError(f"warmup failed: {res}")
+
+        # byte-identity vs the one-shot path
+        http_stats = os.path.join(workdir, "serve_http_stats.json")
+        _code, doc = submit_job(edge_list[0], fixture, tenant="tenant0",
+                                stats_json=http_stats,
+                                config_kwargs=dict(cfg), token="token0")
+        wait_result_http(edge_list[0], doc["id"], timeout=1800,
+                         token="token0")
+        from tpuprof import ProfileReport, ProfilerConfig
+        one_shot = ProfileReport(
+            fixture,
+            config=ProfilerConfig(backend="tpu", **cfg)).to_json_dict()
+        with open(http_stats) as fh:
+            if json.load(fh) != one_shot:
+                raise RuntimeError(
+                    "HTTP-served stats differ from the one-shot path")
+
+        # the load: jobs x tenants across every edge
+        t0 = time.perf_counter()
+        jids = []
+        for k in range(jobs):
+            url = edge_list[k % daemons]
+            tok = f"token{k % tenants}"
+            code, doc = submit_job(url, fixture,
+                                   config_kwargs=dict(cfg), token=tok)
+            if code != 202:
+                raise RuntimeError(f"load submit {k} -> {code}: {doc}")
+            jids.append(doc["id"])
+        latencies = []
+        for jid in jids:
+            res = wait_result(spool, jid, timeout=1800)
+            if res["status"] != "done":
+                raise RuntimeError(f"load job {jid}: {res}")
+            latencies.append(float(res["seconds"]))
+        wall = time.perf_counter() - t0
+        lat = sorted(latencies)
+        out.update({
+            "serve_http_jobs": jobs,
+            "serve_http_tenants": tenants,
+            "serve_http_daemons": daemons,
+            "serve_http_wall_s": round(wall, 3),
+            "serve_http_rps": round(jobs / wall, 2),
+            "serve_http_p50_s": round(lat[(len(lat) - 1) // 2], 4),
+            "serve_http_p99_s": round(
+                lat[min(int(len(lat) * 0.99), len(lat) - 1)], 4),
+            "rows_per_sec": round(rows * jobs / wall, 1),
+        })
+
+        # kill-one lane: accept on both edges, SIGKILL d0, count losses
+        kill_jids = []
+        for k in range(kill_jobs):
+            url = edge_list[k % daemons]
+            _code, doc = submit_job(url, fixture,
+                                    config_kwargs=dict(cfg),
+                                    token="token0")
+            kill_jids.append(doc["id"])
+        victim = procs.pop("d0")
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=60)
+        t0 = time.perf_counter()
+        lost = 0
+        for jid in kill_jids:
+            res = wait_result(spool, jid, timeout=1800)
+            if res["status"] != "done":
+                lost += 1
+        out["serve_http_killed_lost"] = lost
+        out["serve_http_kill_recovery_s"] = \
+            round(time.perf_counter() - t0, 3)
+        if lost:
+            raise RuntimeError(
+                f"kill-one lane lost {lost}/{kill_jobs} jobs")
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=120)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+    return out
+
+
+def run_serve_http(scale: float, workdir: str) -> dict:
+    # small fixture on purpose (the serve-leg rationale): the tracked
+    # signals are edge throughput and tail latency of a WARM fleet,
+    # plus the zero-loss kill-one invariant — not scan throughput
+    rows = max(int(1_000_000 * scale), 10_000)
+    out = measure_serve_http(rows, workdir)
+    out["scenario"] = "serve_http"
+    return out
+
+
 def run_watch(scale: float, workdir: str) -> dict:
     # small fixture on purpose, like serve: the tracked signals are the
     # warm cycle latency and the alert latency, not scan throughput
@@ -1124,7 +1281,7 @@ def run_serve(scale: float, workdir: str) -> dict:
 
 REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
                         "hostfed", "prepare", "passb", "faults", "drift",
-                        "rebalance", "serve", "watch")
+                        "rebalance", "serve", "watch", "serve_http")
 
 
 def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
@@ -1319,6 +1476,10 @@ def run_regression(scale: float, workdir: str,
         if "watch_alert_latency_s" in r:
             notes = (f"cycle {r['watch_cycle_s']}s, "
                      f"alert {r['watch_alert_latency_s']}s")
+        if "serve_http_rps" in r:
+            notes = (f"{r['serve_http_rps']} req/s, "
+                     f"p99 {r['serve_http_p99_s']}s, "
+                     f"lost {r['serve_http_killed_lost']}")
         rate = r.get("rows_per_sec",
                      r.get("prepare_rows_per_sec", float("nan")))
         print(f"| {r['scenario']} | {r.get('rows', '—'):,} | "
@@ -1335,6 +1496,7 @@ def main() -> None:
                                              "passb", "faults", "drift",
                                              "rebalance", "wideexact",
                                              "serve", "watch",
+                                             "serve_http",
                                              "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
@@ -1371,7 +1533,7 @@ def main() -> None:
 
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
               "prepare", "passb", "faults", "drift", "rebalance",
-              "wideexact", "serve", "watch"]
+              "wideexact", "serve", "watch", "serve_http"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -1398,6 +1560,8 @@ def main() -> None:
             result = run_serve(args.scale, args.workdir)
         elif name == "watch":
             result = run_watch(args.scale, args.workdir)
+        elif name == "serve_http":
+            result = run_serve_http(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

@@ -260,6 +260,75 @@ class TestServeConfigRoundTrip:
         assert args.once is False
 
 
+class TestHttpEdgeConfigRoundTrip:
+    """`serve_http_port` / `serve_auth_file` resolve identically from
+    env, CLI and config (ISSUE 11 — the standard three-way
+    round-trip)."""
+
+    def test_http_port_env_cli_config_resolve_identically(self,
+                                                          monkeypatch):
+        from tpuprof.cli import build_parser
+        from tpuprof.config import resolve_serve_http_port
+        monkeypatch.delenv("TPUPROF_SERVE_HTTP_PORT", raising=False)
+        via_config = resolve_serve_http_port(
+            ProfilerConfig(serve_http_port=8080).serve_http_port)
+        args = build_parser().parse_args(
+            ["serve", "spool", "--http", "8080"])
+        via_cli = resolve_serve_http_port(args.serve_http_port)
+        monkeypatch.setenv("TPUPROF_SERVE_HTTP_PORT", "8080")
+        via_env = resolve_serve_http_port(None)
+        assert via_config == via_cli == via_env == 8080
+        # explicit value beats the env twin; 0 (ephemeral) is explicit
+        assert resolve_serve_http_port(0) == 0
+        monkeypatch.delenv("TPUPROF_SERVE_HTTP_PORT")
+        # default: no HTTP edge at all
+        assert resolve_serve_http_port(None) is None
+
+    def test_auth_file_env_cli_config_resolve_identically(self,
+                                                          monkeypatch):
+        from tpuprof.cli import build_parser
+        from tpuprof.config import resolve_serve_auth_file
+        monkeypatch.delenv("TPUPROF_SERVE_AUTH_FILE", raising=False)
+        via_config = resolve_serve_auth_file(
+            ProfilerConfig(serve_auth_file="/etc/t").serve_auth_file)
+        args = build_parser().parse_args(
+            ["serve", "spool", "--serve-auth-file", "/etc/t"])
+        via_cli = resolve_serve_auth_file(args.serve_auth_file)
+        monkeypatch.setenv("TPUPROF_SERVE_AUTH_FILE", "/etc/t")
+        via_env = resolve_serve_auth_file(None)
+        assert via_config == via_cli == via_env == "/etc/t"
+        assert resolve_serve_auth_file("/other") == "/other"
+        monkeypatch.delenv("TPUPROF_SERVE_AUTH_FILE")
+        assert resolve_serve_auth_file(None) is None     # open edge
+
+    def test_watch_parser_carries_the_edge_knobs(self):
+        from tpuprof.cli import build_parser
+        args = build_parser().parse_args(
+            ["watch", "spool", "s.parquet", "--http", "0",
+             "--serve-auth-file", "tok"])
+        assert args.serve_http_port == 0
+        assert args.serve_auth_file == "tok"
+        args = build_parser().parse_args(["watch", "spool", "s"])
+        assert args.serve_http_port is None
+
+    def test_serve_parser_defaults_leave_resolution_open(self):
+        from tpuprof.cli import build_parser
+        args = build_parser().parse_args(["serve", "spool"])
+        assert args.serve_http_port is None
+        assert args.serve_auth_file is None
+        assert args.claim_jobs is False
+        assert args.daemon_id is None
+        assert args.liveness_timeout is None
+
+    def test_config_validation_rejects_bad_ports(self):
+        with pytest.raises(ValueError, match="serve_http_port"):
+            ProfilerConfig(serve_http_port=-1)
+        with pytest.raises(ValueError, match="serve_http_port"):
+            ProfilerConfig(serve_http_port=70000)
+        # 0 = ephemeral is legal (the CI mode)
+        assert ProfilerConfig(serve_http_port=0).serve_http_port == 0
+
+
 class TestJobTimeoutRoundTrip:
     """`job_timeout_s` + the watch knobs resolve identically from env,
     CLI and config (ISSUE 10 satellite — the standard three-way

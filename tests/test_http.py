@@ -1,0 +1,697 @@
+"""Network serving plane (tpuprof/serve/http.py — ISSUE 11): the HTTP
+edge over the serve fleet.  Bearer-token auth -> tenant quotas
+(401/429/400 contracts), the job/result transport round-trip vs the
+one-shot path, multi-daemon spool claims + stale-claim steal, the
+`tpuprof submit --url` client with its typed ServeUnavailableError,
+the shared jittered-backoff poller, and the read-only watch alert
+feed.  Every server binds port 0 (ephemeral) so tier-1 never collides
+on a busy CI box."""
+
+import contextlib
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof.cli import main
+from tpuprof.errors import InputError, ServeUnavailableError, exit_code
+from tpuprof.serve import (HttpEdge, ServeDaemon, discover_edges,
+                           load_auth_file, poll_intervals, submit_job,
+                           wait_result, wait_result_http, write_job)
+
+pytestmark = pytest.mark.http
+
+
+@pytest.fixture
+def parquet_path(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 3000
+    df = pd.DataFrame({
+        "a": rng.normal(10, 2, n),
+        "b": rng.exponential(1.0, n),
+        "c": rng.choice(["x", "y", "z"], n),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+    return path
+
+
+CFG = {"batch_rows": 1024}
+
+
+@contextlib.contextmanager
+def running_edge(spool, auth_file=None, port=0, daemon_id="d1",
+                 **daemon_kwargs):
+    daemon_kwargs.setdefault("workers", 1)
+    daemon_kwargs.setdefault("liveness_timeout_s", 5.0)
+    daemon = ServeDaemon(spool, poll_interval=0.03, claim_jobs=True,
+                         daemon_id=daemon_id, **daemon_kwargs)
+    edge = HttpEdge(daemon, port=port, auth_file=auth_file).start()
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    try:
+        yield daemon, edge
+    finally:
+        edge.close()
+        daemon.stop_event.set()
+        t.join(timeout=30)
+        daemon.close()
+
+
+def _http(method, url, body=None, token=None, timeout=30.0):
+    """Raw exchange -> (status, decoded-json-or-text, headers)."""
+    headers = {}
+    if body is not None:
+        headers["Content-Type"] = "application/json"
+        if isinstance(body, dict):
+            body = json.dumps(body).encode()
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(url, data=body, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw, status, hdrs = resp.read(), resp.status, resp.headers
+    except urllib.error.HTTPError as exc:
+        raw, status, hdrs = exc.read(), exc.code, exc.headers
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        doc = raw.decode("utf-8", "replace")
+    return status, doc, hdrs
+
+
+# ---------------------------------------------------------------------------
+# shared backoff poller (ISSUE 11 satellite: no more fixed busy-poll)
+# ---------------------------------------------------------------------------
+
+class TestPollBackoff:
+    def test_intervals_grow_exponentially_to_the_cap(self):
+        it = poll_intervals(initial=0.05, cap=1.0, factor=2.0,
+                            jitter=0.25)
+        base = [0.05, 0.1, 0.2, 0.4, 0.8, 1.0, 1.0, 1.0]
+        got = [next(it) for _ in base]
+        for expected, actual in zip(base, got):
+            assert expected * 0.74 <= actual <= expected * 1.26, \
+                (expected, actual)
+
+    def test_jitter_scatters_successive_generators(self):
+        # two clients starting together must NOT poll in lockstep —
+        # the whole point of the jitter
+        a = [next(poll_intervals(0.1))for _ in range(32)]
+        assert len({round(v, 9) for v in a}) > 1
+
+    def test_wait_result_backs_off_but_honors_the_deadline(self,
+                                                           tmp_path):
+        """A huge poll_interval must not overshoot a small timeout:
+        the sleep is clamped to the remaining deadline (the old fixed
+        poller slept blind)."""
+        spool = str(tmp_path / "spool")
+        os.makedirs(os.path.join(spool, "results"), exist_ok=True)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            wait_result(spool, "nope", timeout=0.3, poll_interval=30.0)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_wait_result_sleeps_grow(self, tmp_path, monkeypatch):
+        import tpuprof.serve.server as server_mod
+        spool = str(tmp_path / "spool")
+        os.makedirs(os.path.join(spool, "results"), exist_ok=True)
+        slept = []
+        real_sleep = time.sleep
+        monkeypatch.setattr(
+            server_mod.time, "sleep",
+            lambda s: (slept.append(s), real_sleep(0.001))[0])
+        with pytest.raises(TimeoutError):
+            wait_result(spool, "nope", timeout=0.25, poll_interval=0.02)
+        assert len(slept) >= 3
+        # strictly increasing until the cap/deadline clamp kicks in
+        assert slept[1] > slept[0] * 1.2
+
+
+# ---------------------------------------------------------------------------
+# auth file
+# ---------------------------------------------------------------------------
+
+class TestAuthFile:
+    def test_parse_tokens_and_comments(self, tmp_path):
+        path = tmp_path / "tokens"
+        path.write_text("# fleet tokens\n\n"
+                        "secretA analytics\n"
+                        "secretB  ingest\n")
+        assert load_auth_file(str(path)) == {"secretA": "analytics",
+                                             "secretB": "ingest"}
+
+    @pytest.mark.parametrize("content,match", [
+        ("justatoken\n", "expected"),
+        ("tok a\ntok b\n", "twice"),
+        ("# nothing but comments\n", "no tokens"),
+    ])
+    def test_malformed_files_are_typed_input_errors(self, tmp_path,
+                                                    content, match):
+        path = tmp_path / "tokens"
+        path.write_text(content)
+        with pytest.raises(InputError, match=match):
+            load_auth_file(str(path))
+
+    def test_unreadable_file_is_typed(self, tmp_path):
+        with pytest.raises(InputError, match="unreadable"):
+            load_auth_file(str(tmp_path / "missing"))
+
+
+# ---------------------------------------------------------------------------
+# auth + quota over the wire (the satellite acceptance matrix)
+# ---------------------------------------------------------------------------
+
+class TestHttpAuthAndQuota:
+    @pytest.fixture
+    def auth_file(self, tmp_path):
+        path = tmp_path / "tokens"
+        path.write_text("secretA tenantA\nsecretB tenantB\n")
+        return str(path)
+
+    def test_missing_and_bad_tokens_are_401(self, parquet_path,
+                                            tmp_path, auth_file):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool, auth_file=auth_file) as (_daemon, edge):
+            body = {"source": parquet_path, "config": dict(CFG)}
+            code, doc, hdrs = _http("POST", edge.url + "/v1/jobs", body)
+            assert code == 401 and "token" in doc["error"]
+            assert hdrs.get("WWW-Authenticate") == "Bearer"
+            code, doc, _ = _http("POST", edge.url + "/v1/jobs", body,
+                                 token="wrong")
+            assert code == 401
+            # reads need the token too
+            code, _, _ = _http("GET", edge.url + "/v1/results/j1")
+            assert code == 401
+            # /metrics is the scrape surface: open by design
+            code, text, _ = _http("GET", edge.url + "/metrics")
+            assert code == 200 and isinstance(text, str)
+
+    def test_token_maps_tenant_and_overrides_the_body(
+            self, parquet_path, tmp_path, auth_file):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool, auth_file=auth_file) as (_daemon, edge):
+            code, doc, _ = _http(
+                "POST", edge.url + "/v1/jobs",
+                {"source": parquet_path, "config": dict(CFG),
+                 "tenant": "somebody-else"},      # billing fraud attempt
+                token="secretA")
+            assert code == 202
+            assert doc["tenant"] == "tenantA"     # the credential wins
+            res = wait_result_http(edge.url, doc["id"], timeout=600,
+                                   token="secretA")
+            assert res["status"] == "done" and res["tenant"] == "tenantA"
+
+    def test_over_quota_is_429_with_the_scheduler_reason(
+            self, parquet_path, tmp_path, auth_file):
+        from tpuprof.testing import faults
+        spool = str(tmp_path / "spool")
+        # pin tenantA's first job in the worker for 3s so the second
+        # POST deterministically finds the quota slot occupied
+        faults.install(faults.FaultPlan.from_spec("serve_job:sleep=3@1"))
+        try:
+            with running_edge(spool, auth_file=auth_file,
+                              tenant_quota=1) as (_daemon, edge):
+                body = {"source": parquet_path, "config": dict(CFG)}
+                code, first, _ = _http("POST", edge.url + "/v1/jobs",
+                                       body, token="secretA")
+                assert code == 202
+                code, doc, _ = _http("POST", edge.url + "/v1/jobs",
+                                     body, token="secretA")
+                assert code == 429
+                assert doc["reject_kind"] == "TenantQuotaExceeded"
+                assert "tenantA" in doc["error"]          # the reason
+                assert "quota" in doc["error"]
+                # another tenant's quota is untouched
+                code, other, _ = _http("POST", edge.url + "/v1/jobs",
+                                       body, token="secretB")
+                assert code == 202
+                for jid, tok in ((first["id"], "secretA"),
+                                 (other["id"], "secretB")):
+                    assert wait_result_http(
+                        edge.url, jid, timeout=600,
+                        token=tok)["status"] == "done"
+        finally:
+            faults.reset()
+
+    def test_corrupt_body_is_400_never_a_daemon_crash(
+            self, parquet_path, tmp_path):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool) as (_daemon, edge):
+            for body in (b"{not json", b"[1, 2]", b'"a string"'):
+                code, doc, _ = _http("POST", edge.url + "/v1/jobs",
+                                     body)
+                assert code == 400, body
+                assert "error" in doc
+            # field-level garbage is 400 too
+            code, doc, _ = _http("POST", edge.url + "/v1/jobs",
+                                 {"source": 42})
+            assert code == 400 and "source" in doc["error"]
+            code, doc, _ = _http("POST", edge.url + "/v1/jobs",
+                                 {"source": parquet_path,
+                                  "config": "not-a-dict"})
+            assert code == 400 and "config" in doc["error"]
+            code, doc, _ = _http("POST", edge.url + "/v1/jobs",
+                                 {"source": parquet_path,
+                                  "schema": "wrong-schema-v9"})
+            assert code == 400
+            # ...and the daemon still serves real work afterwards
+            code, doc, _ = _http("POST", edge.url + "/v1/jobs",
+                                 {"source": parquet_path,
+                                  "config": dict(CFG)})
+            assert code == 202
+            assert wait_result_http(edge.url, doc["id"],
+                                    timeout=600)["status"] == "done"
+
+    def test_bad_config_rejects_400_with_the_reason(self, parquet_path,
+                                                    tmp_path):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool) as (_daemon, edge):
+            code, doc, _ = _http("POST", edge.url + "/v1/jobs",
+                                 {"source": parquet_path,
+                                  "config": {"bogus_option": 1}})
+            assert code == 400
+            assert "unknown config options" in doc["error"]
+            assert doc["status"] == "rejected"
+
+
+# ---------------------------------------------------------------------------
+# transport round-trip + lifecycle routes
+# ---------------------------------------------------------------------------
+
+class TestHttpRoundTrip:
+    def test_submit_poll_result_matches_one_shot(self, parquet_path,
+                                                 tmp_path):
+        from tpuprof import ProfileReport, ProfilerConfig
+        spool = str(tmp_path / "spool")
+        stats_json = str(tmp_path / "via_http.json")
+        with running_edge(spool) as (_daemon, edge):
+            code, doc = submit_job(edge.url, parquet_path,
+                                   stats_json=stats_json,
+                                   config_kwargs=dict(CFG))
+            assert code == 202
+            jid = doc["id"]
+            res = wait_result_http(edge.url, jid, timeout=600)
+            assert res["status"] == "done"
+            assert res["schema"] == "tpuprof-serve-result-v1"
+            assert res["rows"] == 3000 and res["cols"] == 3
+            assert res["daemon"] == "d1"
+            # lifecycle route agrees once terminal
+            code, job_doc, _ = _http("GET",
+                                     f"{edge.url}/v1/jobs/{jid}")
+            assert code == 200 and job_doc["status"] == "done"
+        served = json.load(open(stats_json))
+        report = ProfileReport(parquet_path,
+                               config=ProfilerConfig(backend="tpu",
+                                                     **CFG))
+        assert served == report.to_json_dict()
+
+    def test_unknown_ids_404_and_malformed_ids_400(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool) as (_daemon, edge):
+            for route in ("/v1/jobs/nope", "/v1/results/nope"):
+                code, doc, _ = _http("GET", edge.url + route)
+                assert code == 404 and "unknown job" in doc["error"]
+            code, _, _ = _http("GET", edge.url + "/v1/results/a%2Fb")
+            assert code == 400
+            code, _, _ = _http("GET", edge.url + "/nope")
+            assert code == 404
+            code, _, _ = _http("GET", edge.url + "/v1/nope")
+            assert code == 404
+
+    def test_pending_result_answers_202(self, parquet_path, tmp_path):
+        from tpuprof.testing import faults
+        spool = str(tmp_path / "spool")
+        faults.install(faults.FaultPlan.from_spec("serve_job:sleep=2@1"))
+        try:
+            with running_edge(spool) as (_daemon, edge):
+                code, doc = submit_job(edge.url, parquet_path,
+                                       config_kwargs=dict(CFG))
+                assert code == 202
+                code, body, _ = _http(
+                    "GET", f"{edge.url}/v1/results/{doc['id']}")
+                assert code == 202 and body["status"] == "pending"
+                assert wait_result_http(
+                    edge.url, doc["id"],
+                    timeout=600)["status"] == "done"
+        finally:
+            faults.reset()
+
+    def test_metrics_route_serves_the_exposition(self, parquet_path,
+                                                 tmp_path):
+        from tpuprof.obs import metrics as obs_metrics
+        spool = str(tmp_path / "spool")
+        prev = obs_metrics.enabled()
+        obs_metrics.set_enabled(True)
+        try:
+            with running_edge(spool) as (_daemon, edge):
+                _http("GET", edge.url + "/v1/jobs/nope")
+                code, text, hdrs = _http("GET", edge.url + "/metrics")
+                assert code == 200
+                assert hdrs.get("Content-Type", "").startswith(
+                    "text/plain")
+                assert "tpuprof_http_requests_total" in text
+                assert 'route="/v1/jobs/<id>"' in text
+        finally:
+            obs_metrics.set_enabled(prev)
+
+    def test_spooled_job_of_a_peer_reads_as_queued(self, parquet_path,
+                                                   tmp_path):
+        """The edge answers for the whole fleet: a job spooled (or
+        claimed by a peer) that this daemon never saw still reads as
+        queued, and its result lands no matter who executed it."""
+        spool = str(tmp_path / "spool")
+        daemon = ServeDaemon(spool, workers=1, claim_jobs=True,
+                             daemon_id="idle", liveness_timeout_s=5.0)
+        edge = HttpEdge(daemon, port=0).start()
+        try:
+            jid = write_job(spool, parquet_path,
+                            config_kwargs=dict(CFG))
+            code, doc, _ = _http("GET", f"{edge.url}/v1/jobs/{jid}")
+            assert (code, doc["status"]) == (200, "queued")
+            code, doc, _ = _http("GET", f"{edge.url}/v1/results/{jid}")
+            assert (code, doc["status"]) == (202, "pending")
+        finally:
+            edge.close()
+            daemon.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-daemon fleet on one spool: claims, steal, exactly-once
+# ---------------------------------------------------------------------------
+
+class TestServeFleet:
+    def test_two_daemons_share_the_load_exactly_once(self, parquet_path,
+                                                     tmp_path):
+        """The in-process fleet lane: 16 jobs from 4 tenants across 2
+        claiming daemons on one spool — every job answered exactly
+        once (claims are the arbiter), both daemons participate, and
+        the claim files are swept with the results."""
+        spool = str(tmp_path / "spool")
+        with running_edge(spool, daemon_id="dA", workers=2) \
+                as (_d1, edge_a), \
+                running_edge(spool, daemon_id="dB", workers=2) \
+                as (_d2, edge_b):
+            jids = []
+            for k in range(16):
+                edge = edge_a if k % 2 == 0 else edge_b
+                code, doc = submit_job(
+                    edge.url, parquet_path, tenant=f"tenant{k % 4}",
+                    config_kwargs=dict(CFG))
+                assert code == 202
+                jids.append(doc["id"])
+            by_daemon = {}
+            for jid in jids:
+                res = wait_result(spool, jid, timeout=600)
+                assert res["status"] == "done", res
+                by_daemon.setdefault(res["daemon"], []).append(jid)
+            assert set(by_daemon) <= {"dA", "dB"}
+            # an HTTP-accepted job is claimed by its accepting daemon,
+            # so with both edges driven both daemons answered
+            assert len(by_daemon) == 2
+            # exactly one result per id, and the spool is clean
+            results = os.listdir(os.path.join(spool, "results"))
+            assert sorted(results) == sorted(f"{j}.json" for j in jids)
+            assert os.listdir(os.path.join(spool, "jobs")) == []
+            assert [n for n in os.listdir(os.path.join(spool, "claims"))
+                    if not n.startswith(".")] == []
+
+    def test_stale_claim_is_stolen_and_answered(self, parquet_path,
+                                                tmp_path):
+        """A job claimed by a daemon that died (no heartbeat) is
+        stolen at the next generation and answered by the survivor —
+        the PR-7 steal contract on jobs."""
+        from tpuprof.obs import metrics as obs_metrics
+        from tpuprof.runtime import fleet as _fleet
+        from tpuprof.serve.server import _STOLEN
+        spool = str(tmp_path / "spool")
+        prev = obs_metrics.enabled()
+        obs_metrics.set_enabled(True)
+        try:
+            base = _STOLEN.value(daemon="survivor")
+            jid = write_job(spool, parquet_path,
+                            config_kwargs=dict(CFG))
+            os.makedirs(os.path.join(spool, "claims"), exist_ok=True)
+            _fleet.excl_create(
+                os.path.join(spool, "claims", f"{jid}.claim"),
+                "dead-daemon")      # no heartbeat file: instantly stale
+            with running_edge(spool, daemon_id="survivor",
+                              liveness_timeout_s=1.0) as (_d, _e):
+                res = wait_result(spool, jid, timeout=600)
+            assert res["status"] == "done"
+            assert res["daemon"] == "survivor"
+            assert _STOLEN.value(daemon="survivor") == base + 1
+        finally:
+            obs_metrics.set_enabled(prev)
+
+    def test_live_peers_claims_are_not_stolen(self, parquet_path,
+                                              tmp_path):
+        """A fresh heartbeat protects a claim even when the owner is
+        slow: the survivor must NOT steal it."""
+        from tpuprof.runtime import fleet as _fleet
+        spool = str(tmp_path / "spool")
+        jid = write_job(spool, parquet_path, config_kwargs=dict(CFG))
+        os.makedirs(os.path.join(spool, "claims"), exist_ok=True)
+        os.makedirs(os.path.join(spool, "daemons"), exist_ok=True)
+        _fleet.excl_create(
+            os.path.join(spool, "claims", f"{jid}.claim"), "slowpoke")
+        _fleet.atomic_write(
+            os.path.join(spool, "daemons", "hb.slowpoke"), b"alive\n")
+        daemon = ServeDaemon(spool, workers=1, claim_jobs=True,
+                             daemon_id="eager", liveness_timeout_s=30.0)
+        try:
+            for _ in range(5):
+                daemon.poll_once()
+                time.sleep(0.02)
+            assert daemon.scheduler.stats()["requests"] == 0
+            claims = os.listdir(os.path.join(spool, "claims"))
+            assert claims == [f"{jid}.claim"]      # no steal file
+        finally:
+            daemon.close()
+
+    def test_restart_with_same_id_adopts_unanswered_claims(
+            self, parquet_path, tmp_path):
+        """A daemon that claimed a job and died re-ingests it when a
+        daemon restarts under the SAME id (the fleet_host_id handoff
+        idiom), without waiting out anyone's liveness timeout."""
+        from tpuprof.runtime import fleet as _fleet
+        spool = str(tmp_path / "spool")
+        jid = write_job(spool, parquet_path, config_kwargs=dict(CFG))
+        os.makedirs(os.path.join(spool, "claims"), exist_ok=True)
+        _fleet.excl_create(
+            os.path.join(spool, "claims", f"{jid}.claim"), "slot-0")
+        with running_edge(spool, daemon_id="slot-0",
+                          liveness_timeout_s=300.0) as (_d, _e):
+            res = wait_result(spool, jid, timeout=600)
+        assert res["status"] == "done" and res["daemon"] == "slot-0"
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL a daemon mid-load: survivors steal, zero lost jobs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+class TestKillOneDaemon:
+    def test_sigkilled_daemons_jobs_are_stolen_by_the_survivor(
+            self, parquet_path, tmp_path):
+        """Two `tpuprof serve --http 0` processes on one spool; jobs
+        accepted over the victim's HTTP edge; the victim is SIGKILLed
+        while one job hangs in its worker.  Every accepted job must
+        end with exactly one result (the PR-10 exactly-once contract,
+        now fleet-wide): the survivor steals the stale claims and
+        serves the backlog."""
+        import subprocess
+        import sys as _sys
+        spool = str(tmp_path / "spool")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        def spawn(daemon_id, extra_env=None):
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       **(extra_env or {}))
+            return subprocess.Popen(
+                [_sys.executable, "-m", "tpuprof", "serve", spool,
+                 "--http", "0", "--daemon-id", daemon_id,
+                 "--serve-workers", "1", "--liveness-timeout", "2",
+                 "--no-compile-cache"],
+                env=env, cwd=repo, stderr=subprocess.DEVNULL)
+
+        # the victim hangs on its SECOND job, so the kill lands with
+        # one job answered, one wedged in the worker, others queued
+        victim = spawn("victim",
+                       {"TPUPROF_FAULTS": "serve_job:sleep=600@2"})
+        survivor = spawn("survivor")
+        try:
+            deadline = time.monotonic() + 120
+            while "victim" not in discover_edges(spool):
+                assert time.monotonic() < deadline, \
+                    "victim edge never advertised"
+                time.sleep(0.2)
+            victim_url = discover_edges(spool)["victim"]
+            jids = []
+            for k in range(4):
+                code, doc = submit_job(victim_url, parquet_path,
+                                       tenant=f"t{k}",
+                                       config_kwargs=dict(CFG))
+                assert code == 202, doc
+                jids.append(doc["id"])
+            # first job answers, second wedges — then kill the victim
+            assert wait_result(spool, jids[0],
+                               timeout=600)["status"] == "done"
+            time.sleep(1.0)
+            os.kill(victim.pid, signal.SIGKILL)
+            victim.wait(timeout=30)
+            # zero lost jobs: every accepted id gets exactly one result
+            by_daemon = {}
+            for jid in jids:
+                res = wait_result(spool, jid, timeout=600)
+                assert res["status"] == "done", (jid, res)
+                by_daemon.setdefault(res["daemon"], []).append(jid)
+            assert set(by_daemon.get("survivor", [])) >= set(jids[1:]), \
+                by_daemon
+            results = os.listdir(os.path.join(spool, "results"))
+            assert sorted(results) == sorted(f"{j}.json" for j in jids)
+        finally:
+            for proc in (victim, survivor):
+                if proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=60)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# watch alert feed over the edge (PR-10 follow-up satellite)
+# ---------------------------------------------------------------------------
+
+class TestWatchAlertsOverHttp:
+    def test_feed_serves_alerts_json_read_only(self, tmp_path):
+        from tpuprof.serve.watch import source_key
+        spool = str(tmp_path / "spool")
+        key = source_key(str(tmp_path / "data.parquet"))
+        watch_dir = os.path.join(spool, "watch", key)
+        os.makedirs(watch_dir)
+        alerts = [{"seq": 1, "kind": "drift", "severity": "drift",
+                   "cycle": 3, "columns": ["a"]}]
+        with open(os.path.join(watch_dir, "alerts.json"), "w") as fh:
+            json.dump(alerts, fh)
+        with running_edge(spool) as (_daemon, edge):
+            code, doc, hdrs = _http(
+                "GET", f"{edge.url}/v1/watch/{key}/alerts")
+            assert code == 200 and doc == alerts
+            code, doc, _ = _http(
+                "GET", edge.url + "/v1/watch/no-such-key/alerts")
+            assert code == 404
+            # a dots-only "key" cannot escape SPOOL/watch/
+            code, doc, _ = _http("GET",
+                                 edge.url + "/v1/watch/../alerts")
+            assert code in (400, 404)
+
+    def test_feed_requires_auth_when_enabled(self, tmp_path):
+        auth = tmp_path / "tokens"
+        auth.write_text("tok tenantA\n")
+        spool = str(tmp_path / "spool")
+        key = "data.parquet-deadbeef"
+        watch_dir = os.path.join(spool, "watch", key)
+        os.makedirs(watch_dir)
+        with open(os.path.join(watch_dir, "alerts.json"), "w") as fh:
+            fh.write("[]")
+        with running_edge(spool, auth_file=str(auth)) as (_d, edge):
+            code, _, _ = _http("GET",
+                               f"{edge.url}/v1/watch/{key}/alerts")
+            assert code == 401
+            code, doc, _ = _http("GET",
+                                 f"{edge.url}/v1/watch/{key}/alerts",
+                                 token="tok")
+            assert code == 200 and doc == []
+
+
+# ---------------------------------------------------------------------------
+# `tpuprof submit --url` CLI + ServeUnavailableError (satellite)
+# ---------------------------------------------------------------------------
+
+class TestSubmitUrlCli:
+    @pytest.mark.smoke
+    def test_submit_url_round_trip(self, parquet_path, tmp_path,
+                                   capsys):
+        spool = str(tmp_path / "spool")
+        stats_json = str(tmp_path / "s.json")
+        with running_edge(spool) as (_daemon, edge):
+            rc = main(["submit", "--url", edge.url, parquet_path,
+                       "--batch-rows", "1024", "--stats-json",
+                       stats_json, "--timeout", "600"])
+            assert rc == 0
+            assert "rows" in capsys.readouterr().err
+            payload = json.load(open(stats_json))
+            assert payload["table"]["n"] == 3000
+            # rejection speaks the CLI bad-request convention
+            rc = main(["submit", "--url", edge.url, parquet_path,
+                       "--config-json", '{"bogus": 1}',
+                       "--timeout", "600"])
+            assert rc == 2
+            assert "rejected" in capsys.readouterr().err
+
+    def test_submit_url_no_wait_prints_the_id(self, parquet_path,
+                                              tmp_path, capsys):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool) as (_daemon, edge):
+            rc = main(["submit", "--url", edge.url, parquet_path,
+                       "--batch-rows", "1024", "--no-wait"])
+            assert rc == 0
+            jid = capsys.readouterr().out.strip()
+            assert jid
+            assert wait_result(spool, jid,
+                               timeout=600)["status"] == "done"
+
+    def test_unreachable_edge_exits_9(self, parquet_path, capsys):
+        # bind-then-close guarantees a dead port with no listener
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        rc = main(["submit", "--url", f"http://127.0.0.1:{port}",
+                   parquet_path, "--no-wait"])
+        assert rc == 9
+        err = capsys.readouterr().err
+        assert "cannot reach tpuprof serve" in err
+
+    def test_serve_unavailable_is_typed_with_exit_code_9(self):
+        exc = ServeUnavailableError("down")
+        assert isinstance(exc, OSError)
+        assert exit_code(exc) == 9
+
+    def test_wrong_token_is_a_local_error(self, parquet_path, tmp_path,
+                                          capsys):
+        auth = tmp_path / "tokens"
+        auth.write_text("tok tenantA\n")
+        spool = str(tmp_path / "spool")
+        with running_edge(spool, auth_file=str(auth)) as (_d, edge):
+            rc = main(["submit", "--url", edge.url, parquet_path,
+                       "--no-wait"])
+            assert rc == 2
+            assert "TPUPROF_SERVE_TOKEN" in capsys.readouterr().err
+            rc = main(["submit", "--url", edge.url, parquet_path,
+                       "--token", "tok", "--batch-rows", "1024",
+                       "--no-wait"])
+            assert rc == 0
+
+    def test_spool_and_url_are_mutually_exclusive(self, parquet_path,
+                                                  tmp_path, capsys):
+        rc = main(["submit", str(tmp_path / "spool"), parquet_path,
+                   "--url", "http://127.0.0.1:1"])
+        assert rc == 2
+        assert "not both" in capsys.readouterr().err
+        rc = main(["submit", "--url", "http://127.0.0.1:1"])
+        assert rc == 2
+        assert "source" in capsys.readouterr().err

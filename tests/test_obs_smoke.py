@@ -78,6 +78,21 @@ EVENT_SCHEMA = {
                         "requests": ((int,), True),
                         "done": ((int,), True),
                         "queued": ((int,), True)},
+    # network serving plane (serve/server.py claim path + serve/http.py,
+    # ISSUE 11): fleet membership audit trail — join/depart per daemon
+    # (depart's `unanswered` is nonzero only on a non-graceful stop)
+    # and one record per stale-claim steal
+    "serve_fleet_join": {"ts": ((int, float), True),
+                         "daemon": ((str,), True),
+                         "spool": ((str,), True)},
+    "serve_fleet_depart": {"ts": ((int, float), True),
+                           "daemon": ((str,), True),
+                           "unanswered": ((int,), True)},
+    "serve_job_stolen": {"ts": ((int, float), True),
+                         "job": ((str,), True),
+                         "daemon": ((str,), True),
+                         "from_daemon": ((str, type(None)), False),
+                         "generation": ((int,), True)},
     # stats artifacts (tpuprof/artifact, ISSUE 6) — documented in
     # OBSERVABILITY.md since PR 6 but only exercised with a live sink
     # once the watch loop landed
@@ -328,6 +343,61 @@ def test_cli_metrics_json_smoke(tmp_path):
     # the report footer carries the pipeline line
     page = open(out).read()
     assert "pipeline:" in page and "rows ingested" in page
+
+
+def test_serve_fleet_event_stream_validates(tmp_path):
+    """The serve-fleet claim path's JSONL contract (ISSUE 11): a
+    claiming daemon that joins, steals a dead peer's job, answers it
+    and departs emits only EVENT_SCHEMA-valid records, and the claim/
+    steal metrics land in the exposition."""
+    import threading
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from tpuprof import obs
+    from tpuprof.runtime import fleet as _fleet
+    from tpuprof.serve import ServeDaemon, wait_result, write_job
+
+    src = str(tmp_path / "f.parquet")
+    df = pd.DataFrame({"a": np.random.default_rng(0).normal(0, 1, 2000)})
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), src)
+    spool = str(tmp_path / "spool")
+    jid = write_job(spool, src, config_kwargs={"batch_rows": 1024})
+    os.makedirs(os.path.join(spool, "claims"), exist_ok=True)
+    _fleet.excl_create(os.path.join(spool, "claims", f"{jid}.claim"),
+                       "dead-peer")     # no heartbeat: instantly stale
+    mpath = str(tmp_path / "fleet.jsonl")
+    obs.configure(enabled=True, jsonl_path=mpath)
+    try:
+        daemon = ServeDaemon(spool, workers=1, poll_interval=0.03,
+                             claim_jobs=True, daemon_id="obs-d",
+                             liveness_timeout_s=0.5)
+        t = threading.Thread(target=daemon.run, daemon=True)
+        t.start()
+        assert wait_result(spool, jid, timeout=600)["status"] == "done"
+        daemon.stop_event.set()
+        t.join(timeout=30)
+        daemon.close()
+        obs.finalize(reason="test")
+        prom = obs.registry().render_text()
+    finally:
+        obs.configure(enabled=False, jsonl_path=None)
+    events = [json.loads(line) for line in open(mpath) if line.strip()]
+    kinds = {e["kind"] for e in events}
+    assert {"serve_fleet_join", "serve_job_stolen",
+            "serve_fleet_depart"} <= kinds
+    for ev in events:
+        validate_event(ev)
+    stolen = [e for e in events if e["kind"] == "serve_job_stolen"][0]
+    assert stolen["job"] == jid and stolen["from_daemon"] == "dead-peer"
+    depart = [e for e in events if e["kind"] == "serve_fleet_depart"][0]
+    assert depart["unanswered"] == 0    # graceful: everything answered
+    parsed = parse_prom(prom)
+    assert ("daemon", "obs-d") in [
+        s for _, l, _v in
+        parsed["tpuprof_serve_jobs_stolen_total"]["samples"]
+        for s in l.items()]
 
 
 def test_watch_event_stream_validates(tmp_path):

@@ -286,6 +286,42 @@ def build_parser() -> argparse.ArgumentParser:
                         "worker instead of wedging the daemon "
                         "(ROBUSTNESS.md rung 6; default: "
                         "TPUPROF_JOB_TIMEOUT_S, else off)")
+    edge = s.add_argument_group(
+        "network edge + serve fleet", "HTTP front door on the same "
+        "scheduler (POST /v1/jobs, GET /v1/results/<id>, /metrics — "
+        "serve/http.py), and multi-daemon membership: N daemons with "
+        "--http (or --claim-jobs) sharing ONE spool claim jobs "
+        "atomically, heartbeat, and steal a SIGKILLed peer's "
+        "unanswered jobs")
+    edge.add_argument("--http", type=int, default=None,
+                      dest="serve_http_port", metavar="PORT",
+                      help="listen for HTTP jobs on PORT (0 = "
+                           "ephemeral, advertised under "
+                           "SPOOL/daemons/; default: "
+                           "TPUPROF_SERVE_HTTP_PORT, else no HTTP "
+                           "edge).  Implies --claim-jobs")
+    edge.add_argument("--serve-auth-file", metavar="PATH",
+                      help="bearer-token file ('<token> <tenant>' "
+                           "lines): /v1/* requests must present a "
+                           "listed token (401 otherwise) and bill the "
+                           "token's tenant quota (default: "
+                           "TPUPROF_SERVE_AUTH_FILE, else open edge)")
+    edge.add_argument("--claim-jobs", action="store_true",
+                      help="fleet mode without HTTP: claim spool jobs "
+                           "atomically so N file-spool daemons can "
+                           "share one spool")
+    edge.add_argument("--daemon-id", metavar="ID",
+                      help="stable daemon identity for claims/"
+                           "heartbeats — pin per slot so a restart "
+                           "adopts its predecessor's unanswered "
+                           "claims (default: TPUPROF_FLEET_HOST_ID, "
+                           "else hostname-pid)")
+    edge.add_argument("--liveness-timeout", type=float, default=None,
+                      metavar="SEC",
+                      help="heartbeat staleness before a fleet daemon "
+                           "is declared dead and its claimed jobs "
+                           "stolen (default: "
+                           "TPUPROF_LIVENESS_TIMEOUT_S, else 10)")
     s.add_argument("--once", action="store_true",
                    help="answer the spool's current jobs, then exit "
                         "(CI / cron mode; default: serve forever)")
@@ -356,6 +392,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default: TPUPROF_SERVE_WORKERS, else 2)")
     w.add_argument("--poll-interval", type=float, default=0.2,
                    metavar="SEC", help="spool scan cadence")
+    w.add_argument("--http", type=int, default=None,
+                   dest="serve_http_port", metavar="PORT",
+                   help="also serve the HTTP edge (submit + "
+                        "GET /v1/watch/<key>/alerts, so watch "
+                        "consumers poll the edge instead of the spool "
+                        "filesystem; 0 = ephemeral; default: "
+                        "TPUPROF_SERVE_HTTP_PORT, else off)")
+    w.add_argument("--serve-auth-file", metavar="PATH",
+                   help="bearer-token file for the HTTP edge "
+                        "(default: TPUPROF_SERVE_AUTH_FILE, else open)")
     w.add_argument("--config-json", metavar="JSON|@FILE",
                    help="ProfilerConfig kwargs applied to every watch "
                         "cycle's profile job, as inline JSON or "
@@ -376,10 +422,24 @@ def build_parser() -> argparse.ArgumentParser:
 
     u = sub.add_parser(
         "submit", help="hand one profile job to a running `tpuprof "
-                       "serve` daemon and (by default) wait for its "
-                       "result")
-    u.add_argument("spool", help="the daemon's spool directory")
-    u.add_argument("source", help="Parquet file/directory path")
+                       "serve` daemon — through its spool directory or "
+                       "its HTTP edge (--url) — and (by default) wait "
+                       "for the result")
+    u.add_argument("spool", nargs="?", default=None,
+                   help="the daemon's spool directory (omit with "
+                        "--url)")
+    u.add_argument("source", nargs="?", default=None,
+                   help="Parquet file/directory path")
+    u.add_argument("--url", metavar="http://HOST:PORT",
+                   help="submit over the daemon's HTTP edge instead of "
+                        "a spool directory (`tpuprof serve --http`); "
+                        "an unreachable edge exits 9 "
+                        "(ServeUnavailableError)")
+    u.add_argument("--token", default=None,
+                   help="bearer token for an auth-enabled edge "
+                        "(default: TPUPROF_SERVE_TOKEN env); the "
+                        "token's tenant is billed, overriding "
+                        "--tenant")
     u.add_argument("-o", "--output", default=None,
                    help="output HTML path (default: none — submit "
                         "--stats-json or --artifact instead for "
@@ -509,12 +569,39 @@ def cmd_serve(args: argparse.Namespace) -> int:
             from tpuprof.obs.progress import Ticker
             ticker = Ticker(interval, progress=args.progress,
                             snapshots=bool(args.metrics_json)).start()
+    from tpuprof.config import (resolve_serve_auth_file,
+                                resolve_serve_http_port)
+    http_port = resolve_serve_http_port(args.serve_http_port)
+    # the HTTP edge implies fleet claims: N `--http` daemons on one
+    # spool is the deployment shape the edge exists for, and claims
+    # are what keep them from double-running each other's jobs
     daemon = ServeDaemon(args.spool, poll_interval=args.poll_interval,
+                         claim_jobs=bool(args.claim_jobs
+                                         or http_port is not None),
+                         daemon_id=args.daemon_id,
+                         liveness_timeout_s=args.liveness_timeout,
                          workers=args.serve_workers,
                          queue_depth=args.serve_queue_depth,
                          tenant_quota=args.serve_tenant_quota,
                          job_timeout_s=args.job_timeout_s)
     sched = daemon.scheduler
+    edge = None
+    if http_port is not None:
+        from tpuprof.errors import InputError
+        from tpuprof.serve.http import HttpEdge
+        try:
+            edge = HttpEdge(
+                daemon, port=http_port,
+                auth_file=resolve_serve_auth_file(
+                    args.serve_auth_file)).start()
+        except (InputError, OSError) as exc:
+            # bad auth file / port in use: refuse to start, one line
+            print(f"tpuprof: error: http edge: {exc}", file=sys.stderr)
+            daemon.close(timeout=5)
+            return 2
+        print(f"tpuprof: http edge on {edge.url}"
+              + (" (auth required)" if edge.tokens else " (open)"),
+              file=sys.stderr)
     # a daemon drains on SIGTERM (finish running jobs, flush results +
     # the .prom dump, exit 0) — overriding the flight recorder's
     # dump-and-die-by-signal disposition, which is right for a crashed
@@ -540,6 +627,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if edge is not None:
+            edge.close()            # stop accepting before draining
         daemon.close()
         if ticker is not None:
             ticker.stop()
@@ -599,9 +688,28 @@ def cmd_watch(args: argparse.Namespace) -> int:
             from tpuprof.obs.progress import Ticker
             ticker = Ticker(args.metrics_interval,
                             snapshots=True).start()
+    from tpuprof.config import (resolve_serve_auth_file,
+                                resolve_serve_http_port)
+    http_port = resolve_serve_http_port(args.serve_http_port)
     daemon = ServeDaemon(args.spool, poll_interval=args.poll_interval,
+                         claim_jobs=http_port is not None,
                          workers=args.serve_workers,
                          job_timeout_s=args.job_timeout_s)
+    edge = None
+    if http_port is not None:
+        from tpuprof.errors import InputError
+        from tpuprof.serve.http import HttpEdge
+        try:
+            edge = HttpEdge(
+                daemon, port=http_port,
+                auth_file=resolve_serve_auth_file(
+                    args.serve_auth_file)).start()
+        except (InputError, OSError) as exc:
+            print(f"tpuprof: error: http edge: {exc}", file=sys.stderr)
+            daemon.close(timeout=5)
+            return 2
+        print(f"tpuprof: http edge on {edge.url} (alert feeds at "
+              f"/v1/watch/<key>/alerts)", file=sys.stderr)
     watcher = DriftWatcher(
         args.spool, args.sources, daemon.scheduler,
         every_s=args.watch_every_s, keep=args.artifact_keep,
@@ -643,6 +751,8 @@ def cmd_watch(args: argparse.Namespace) -> int:
     finally:
         watcher.stop_event.set()
         daemon.stop_event.set()
+        if edge is not None:
+            edge.close()
         spool_thread.join(timeout=30)
         daemon.close()
         if ticker is not None:
@@ -661,8 +771,30 @@ def cmd_watch(args: argparse.Namespace) -> int:
 
 
 def cmd_submit(args: argparse.Namespace) -> int:
+    import os
+
     from tpuprof.errors import CorruptResultError, exit_code
     from tpuprof.serve import wait_result, write_job
+
+    # `submit SPOOL SOURCE` or `submit --url URL SOURCE`: with --url
+    # the single positional is the source (argparse fills
+    # left-to-right, so it lands in `spool`)
+    if args.url:
+        if args.source is None:
+            args.spool, args.source = None, args.spool
+        if args.spool is not None:
+            print("tpuprof: error: pass either a spool directory or "
+                  "--url, not both", file=sys.stderr)
+            return 2
+    if args.source is None:
+        print("tpuprof: error: submit needs a source path (and a "
+              "spool directory or --url)", file=sys.stderr)
+        return 2
+    if args.spool is None and not args.url:
+        print("tpuprof: error: submit needs the daemon's spool "
+              "directory (or --url for its HTTP edge)",
+              file=sys.stderr)
+        return 2
 
     config = {}
     if args.bins is not None:
@@ -681,22 +813,69 @@ def cmd_submit(args: argparse.Namespace) -> int:
         print(f"tpuprof: error: --config-json: {exc}",
               file=sys.stderr)
         return 2
-    job_id = write_job(args.spool, args.source, output=args.output,
-                       tenant=args.tenant, stats_json=args.stats_json,
-                       artifact=args.artifact, config_kwargs=config)
-    if args.no_wait:
-        print(job_id)
-        return 0
-    try:
-        result = wait_result(args.spool, job_id, timeout=args.timeout)
-    except CorruptResultError as exc:
-        # the result landed but rotted (non-atomic fs crash, disk rot):
-        # the integrity rung's exit code, not a "daemon down" timeout
-        print(f"tpuprof: error: {exc}", file=sys.stderr)
-        return exit_code(exc)
-    except TimeoutError as exc:
-        print(f"tpuprof: error: {exc}", file=sys.stderr)
-        return 4                    # the watchdog-shaped failure
+    if args.url:
+        from tpuprof.errors import ServeUnavailableError
+        from tpuprof.serve import submit_job, wait_result_http
+        token = args.token or os.environ.get("TPUPROF_SERVE_TOKEN")
+        try:
+            code, doc = submit_job(
+                args.url, args.source, output=args.output,
+                tenant=args.tenant, stats_json=args.stats_json,
+                artifact=args.artifact, config_kwargs=config,
+                token=token)
+        except ServeUnavailableError as exc:
+            # the edge itself is down: ITS typed exit code (9), so a
+            # retry wrapper can tell "edge unreachable" from "the job
+            # was rejected" without parsing prose
+            print(f"tpuprof: error: {exc}", file=sys.stderr)
+            return exit_code(exc)
+        if code == 401:
+            print(f"tpuprof: error: {doc.get('error', 'unauthorized')}"
+                  " (pass --token or set TPUPROF_SERVE_TOKEN)",
+                  file=sys.stderr)
+            return 2
+        if code not in (200, 202):
+            # the daemon answered and said no: 429 carries the
+            # scheduler's reject reason, 400 the request's own fault
+            print(f"tpuprof: error: job rejected (HTTP {code}): "
+                  f"{doc.get('error', doc)}", file=sys.stderr)
+            return 2
+        job_id = doc["id"]
+        if args.no_wait:
+            print(job_id)
+            return 0
+        try:
+            result = wait_result_http(args.url, job_id,
+                                      timeout=args.timeout, token=token)
+        except ServeUnavailableError as exc:
+            print(f"tpuprof: error: {exc}", file=sys.stderr)
+            return exit_code(exc)
+        except CorruptResultError as exc:
+            print(f"tpuprof: error: {exc}", file=sys.stderr)
+            return exit_code(exc)
+        except TimeoutError as exc:
+            print(f"tpuprof: error: {exc}", file=sys.stderr)
+            return 4                # the watchdog-shaped failure
+    else:
+        job_id = write_job(args.spool, args.source, output=args.output,
+                           tenant=args.tenant,
+                           stats_json=args.stats_json,
+                           artifact=args.artifact, config_kwargs=config)
+        if args.no_wait:
+            print(job_id)
+            return 0
+        try:
+            result = wait_result(args.spool, job_id,
+                                 timeout=args.timeout)
+        except CorruptResultError as exc:
+            # the result landed but rotted (non-atomic fs crash, disk
+            # rot): the integrity rung's exit code, not a "daemon
+            # down" timeout
+            print(f"tpuprof: error: {exc}", file=sys.stderr)
+            return exit_code(exc)
+        except TimeoutError as exc:
+            print(f"tpuprof: error: {exc}", file=sys.stderr)
+            return 4                # the watchdog-shaped failure
     status = result.get("status")
     if status == "done":
         rows = result.get("rows")

@@ -275,6 +275,33 @@ def resolve_serve_tenant_quota(value: Optional[int] = None) -> int:
     return max(env, 0) if env is not None else 0
 
 
+def resolve_serve_http_port(value: Optional[int] = None) -> Optional[int]:
+    """`tpuprof serve` HTTP edge port (``serve_http_port`` —
+    tpuprof/serve/http.py): explicit config value, else
+    ``TPUPROF_SERVE_HTTP_PORT``, else None = no HTTP edge (the
+    file-spool transport stays the only front door, byte-identical to
+    the pre-edge daemon).  0 is legal and means "bind an ephemeral
+    port" — the bound port is advertised in
+    ``SPOOL/daemons/http.<daemon-id>`` and printed at startup, the
+    no-collision mode CI uses."""
+    if value is not None:
+        return int(value)
+    env = _env_int("TPUPROF_SERVE_HTTP_PORT")
+    return env if env is not None else None
+
+
+def resolve_serve_auth_file(value: Optional[str] = None) -> Optional[str]:
+    """Bearer-token file for the HTTP edge (``serve_auth_file``): one
+    ``<token> <tenant>`` pair per line, ``#`` comments — each accepted
+    token maps the request onto that tenant's admission quota.
+    Explicit config value, else ``TPUPROF_SERVE_AUTH_FILE``, else None
+    = open edge (every request lands on the tenant it names, the
+    single-operator default)."""
+    if value:
+        return str(value)
+    return os.environ.get("TPUPROF_SERVE_AUTH_FILE") or None
+
+
 def resolve_job_timeout(value: Optional[float] = None) -> Optional[float]:
     """Per-job serve watchdog (``job_timeout_s`` — ROBUSTNESS.md rung 6):
     a profile job in the serve daemon that runs past this many seconds
@@ -650,6 +677,22 @@ class ProfilerConfig:
                                               # None = auto: TPUPROF_
                                               # SERVE_TENANT_QUOTA env,
                                               # else 0
+    serve_http_port: Optional[int] = None   # `tpuprof serve` HTTP edge
+                                            # (serve/http.py): listen on
+                                            # this port (0 = ephemeral,
+                                            # advertised under SPOOL/
+                                            # daemons/).  None = auto:
+                                            # TPUPROF_SERVE_HTTP_PORT
+                                            # env, else no HTTP edge —
+                                            # the file-spool transport
+                                            # stays the only front door
+    serve_auth_file: Optional[str] = None   # HTTP bearer-token file:
+                                            # "<token> <tenant>" lines;
+                                            # requests authenticate as
+                                            # that tenant (401 without a
+                                            # listed token).  None =
+                                            # auto: TPUPROF_SERVE_AUTH_
+                                            # FILE env, else open edge
     job_timeout_s: Optional[float] = None   # serve per-job watchdog
                                             # (ROBUSTNESS.md rung 6): a
                                             # job running past this
@@ -837,6 +880,11 @@ class ProfilerConfig:
             raise ValueError(
                 "serve_tenant_quota must be >= 0 (0 = unlimited; or "
                 "None)")
+        if self.serve_http_port is not None \
+                and not 0 <= self.serve_http_port <= 65535:
+            raise ValueError(
+                "serve_http_port must be in [0, 65535] (0 = ephemeral; "
+                "or None = no HTTP edge)")
         if self.metrics_interval < 0:
             raise ValueError("metrics_interval must be >= 0")
         if self.metrics_max_bytes is not None \

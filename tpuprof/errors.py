@@ -45,6 +45,16 @@ The elastic fleet runtime (runtime/fleet.py) adds two more:
   fleet layer deletes this host's heartbeat on the way out so
   survivors detect the death immediately; the CLI maps it to exit
   code 8.
+
+The network serving plane (tpuprof/serve/http.py) adds one more:
+
+* ``ServeUnavailableError`` (OSError) — the HTTP edge named by
+  ``tpuprof submit --url`` could not be reached at all (connection
+  refused, DNS failure, socket timeout).  Distinct from "the daemon
+  answered and rejected the job" (an HTTP status) and from "the job
+  ran and failed" (the job's own exit code): automation retrying on
+  a down edge must be able to branch on THIS without parsing prose;
+  the CLI maps it to exit code 9.
 """
 
 from typing import Any, Dict, List, Optional
@@ -113,6 +123,13 @@ class HostDeathError(RuntimeError):
         self.at_call = at_call
 
 
+class ServeUnavailableError(OSError):
+    """The `tpuprof serve` HTTP edge could not be reached (connection
+    refused / DNS failure / socket timeout on ``tpuprof submit --url``).
+    The request never entered any queue — safe to retry against the
+    same or another edge; the CLI maps it to exit code 9."""
+
+
 class WatchdogTimeout(TimeoutError):
     """A watched blocking call overran its deadline."""
 
@@ -131,7 +148,7 @@ class WatchdogTimeout(TimeoutError):
 # shapes": one-line message + distinct exit code, no traceback
 TYPED_ERRORS = (InputError, CorruptCheckpointError, CorruptArtifactError,
                 CorruptManifestError, PoisonBatchError, WatchdogTimeout,
-                HostDeathError)
+                HostDeathError, ServeUnavailableError)
 
 _EXIT_CODES = (
     # order matters: InputError, CorruptCheckpointError,
@@ -143,6 +160,7 @@ _EXIT_CODES = (
     (WatchdogTimeout, 4),
     (PoisonBatchError, 5),
     (HostDeathError, 8),
+    (ServeUnavailableError, 9),
     (InputError, 2),
 )
 

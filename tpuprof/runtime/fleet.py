@@ -114,8 +114,13 @@ def _atomic_write(path: str, data: bytes) -> None:
     # dot-prefixed so an in-flight write can NEVER match the prefix
     # scans (``part.``/``wire.``) — a reader racing the os.replace must
     # see either nothing or the complete file, not torn bytes
-    tmp = os.path.join(os.path.dirname(path) or ".",
-                       f".tmp.{os.path.basename(path)}.{os.getpid()}")
+    # pid + thread id: two daemons in ONE process (threaded serve
+    # fleet, tests) must not collide on the temp name — a shared temp
+    # lets writer A link it away while writer B still needs it
+    tmp = os.path.join(
+        os.path.dirname(path) or ".",
+        f".tmp.{os.path.basename(path)}"
+        f".{os.getpid()}.{threading.get_ident()}")
     try:
         with open(tmp, "wb") as fh:
             fh.write(data)
@@ -139,7 +144,9 @@ def _excl_create(path: str, content: str) -> bool:
     empty claim would read as owned by nobody, i.e. instantly dead,
     and a live host's fresh claim could be wrongly stolen."""
     d = os.path.dirname(path) or "."
-    tmp = os.path.join(d, f".tmp.{os.path.basename(path)}.{os.getpid()}")
+    tmp = os.path.join(
+        d, f".tmp.{os.path.basename(path)}"
+           f".{os.getpid()}.{threading.get_ident()}")
     with open(tmp, "w", encoding="utf-8") as fh:
         fh.write(content)
         fh.flush()
@@ -162,6 +169,14 @@ def _read_small(path: str) -> Optional[str]:
             return fh.read().strip()
     except OSError:
         return None
+
+
+# the coordination primitives the serve fleet (tpuprof/serve/server.py
+# job claims) builds on — same arbiters, different unit of work (a
+# whole job instead of a fragment)
+atomic_write = _atomic_write
+excl_create = _excl_create
+read_small = _read_small
 
 
 def write_part_bytes(payload: Dict[str, Any]) -> bytes:

@@ -10,7 +10,13 @@ is paid once and every warm profile answers in sub-seconds:
 * serve/jobs.py       job state machine + bounded multi-tenant queue
 * serve/scheduler.py  worker pool, SLO metrics, job lifecycle,
                       per-job watchdog (job_timeout_s)
-* serve/server.py     spool-directory daemon + submit client transport
+* serve/server.py     spool-directory daemon + submit client transport,
+                      plus the fleet claim path (N daemons, one spool:
+                      atomic job claims, heartbeats, stale-claim steal)
+* serve/http.py       the network edge: threaded stdlib HTTP server on
+                      the same scheduler (POST /v1/jobs, results,
+                      metrics, watch alert feeds; bearer-token ->
+                      tenant auth) + the `tpuprof submit --url` client
 * serve/watch.py      continuous drift watch: scheduled re-profiles,
                       artifact retention, alerting, crash-safe
                       watch-manifest recovery (ROBUSTNESS.md rung 6)
@@ -22,20 +28,23 @@ package; embed :class:`ProfileScheduler` directly for in-process use
 
 from tpuprof.serve.cache import (RunnerCache, acquire_runner, cache_stats,
                                  process_cache, runner_key)
+from tpuprof.serve.http import (HttpEdge, discover_edges, load_auth_file,
+                                submit_job, wait_result_http)
 from tpuprof.serve.jobs import (Job, JobQueue, QueueClosed, QueueFull,
                                 TenantQuotaExceeded)
 from tpuprof.serve.scheduler import ProfileScheduler
-from tpuprof.serve.server import (ServeDaemon, read_result, wait_result,
-                                  write_job)
+from tpuprof.serve.server import (ServeDaemon, poll_intervals,
+                                  read_result, wait_result, write_job)
 from tpuprof.serve.watch import (DriftWatcher, SourceWatch,
                                  WATCH_MANIFEST_SCHEMA, read_manifest,
                                  write_manifest)
 
 __all__ = [
-    "DriftWatcher", "Job", "JobQueue", "ProfileScheduler",
+    "DriftWatcher", "HttpEdge", "Job", "JobQueue", "ProfileScheduler",
     "QueueClosed", "QueueFull", "RunnerCache", "ServeDaemon",
     "SourceWatch", "TenantQuotaExceeded", "WATCH_MANIFEST_SCHEMA",
-    "acquire_runner", "cache_stats", "process_cache", "read_manifest",
-    "read_result", "runner_key", "wait_result", "write_job",
-    "write_manifest",
+    "acquire_runner", "cache_stats", "discover_edges", "load_auth_file",
+    "poll_intervals", "process_cache", "read_manifest", "read_result",
+    "runner_key", "submit_job", "wait_result", "wait_result_http",
+    "write_job", "write_manifest",
 ]

@@ -1,0 +1,507 @@
+"""Network serving plane: the HTTP edge over the serve fleet
+(ROADMAP item 1 — "the transport is the only missing layer").
+
+``tpuprof serve SPOOL --http PORT`` puts a real network front door on
+the existing scheduler: a threaded stdlib HTTP server (no new
+dependency — the repo rule) speaking the ``tpuprof-serve-job-v1`` /
+``tpuprof-serve-result-v1`` schemas over the wire.  The edge OWNS no
+job lifecycle: admission, quotas, watchdogs and typed failures all
+stay in serve/scheduler.py; HTTP is a second client of the same
+machinery the file spool uses — and the spool stays the durability
+layer (every HTTP-accepted job is spooled + claimed before it is
+admitted, so a SIGKILLed daemon's jobs are stolen and answered by
+fleet peers — serve/server.py claim path).
+
+Routes::
+
+    POST /v1/jobs                submit one job -> 202 {"id", ...};
+                                 quota/depth rejection -> 429 with the
+                                 scheduler's reject reason; malformed
+                                 body -> 400 (never a daemon crash);
+                                 draining daemon -> 503
+    GET  /v1/jobs/<id>           lifecycle view (local live state,
+                                 else the spool's terminal record,
+                                 else "queued" for a peer's job)
+    GET  /v1/results/<id>        the terminal record: 200 when landed,
+                                 202 while pending, 404 unknown
+    GET  /v1/watch/<key>/alerts  a watched source's alerts.json feed
+                                 (read-only; ISSUE 11 satellite — watch
+                                 consumers poll the edge, not the
+                                 spool filesystem)
+    GET  /metrics                Prometheus text exposition of the
+                                 process registry (the scrape surface;
+                                 unauthenticated by design, like every
+                                 /metrics in the fleet)
+
+Auth: a ``serve_auth_file`` of ``<token> <tenant>`` lines maps bearer
+tokens onto tenants — the tenant id feeds the PR-9 per-tenant quotas,
+so one leaked curl loop cannot starve the mesh for everyone else.
+With a token file configured, every ``/v1/*`` request must carry
+``Authorization: Bearer <token>`` (401 otherwise) and the token's
+tenant OVERRIDES anything the body claims: identity comes from the
+credential, not the payload.
+
+The client half (`tpuprof submit --url http://host:port src`) lives
+here too: submit + poll over HTTP with the same jittered backoff the
+file-spool ``wait_result`` uses, and a typed
+:class:`~tpuprof.errors.ServeUnavailableError` (exit code 9) when the
+edge cannot be reached at all.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from tpuprof.errors import (CorruptResultError, InputError,
+                            ServeUnavailableError)
+from tpuprof.obs import metrics as _obs_metrics
+from tpuprof.serve.server import (JOB_SCHEMA, RESULT_SCHEMA, ServeDaemon,
+                                  poll_intervals, read_result)
+
+_REQUESTS = _obs_metrics.counter(
+    "tpuprof_http_requests_total",
+    "HTTP edge requests by status code and route pattern")
+_REQUEST_SECONDS = _obs_metrics.histogram(
+    "tpuprof_http_request_seconds",
+    "HTTP edge request handling latency (receive -> response written) "
+    "— does NOT include the job's own runtime, only the edge")
+
+MAX_BODY_BYTES = 1 << 20            # a job request is a small JSON doc;
+                                    # anything bigger is garbage or abuse
+
+_ID_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+def load_auth_file(path: str) -> Dict[str, str]:
+    """Parse a bearer-token file: one ``<token> <tenant>`` pair per
+    line, blank lines and ``#`` comments ignored.  Every failure is a
+    typed :class:`InputError` — a daemon must refuse to start half-
+    authenticated, not silently serve an open edge."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError as exc:
+        raise InputError(
+            f"serve_auth_file {path!r} is unreadable "
+            f"({type(exc).__name__}: {exc})") from exc
+    tokens: Dict[str, str] = {}
+    for n, raw in enumerate(lines, 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            raise InputError(
+                f"serve_auth_file {path}:{n}: expected '<token> "
+                f"<tenant>', got {raw!r}")
+        token, tenant = parts
+        if token in tokens:
+            raise InputError(
+                f"serve_auth_file {path}:{n}: token listed twice "
+                "(each token maps to exactly one tenant)")
+        tokens[token] = tenant
+    if not tokens:
+        raise InputError(
+            f"serve_auth_file {path!r} lists no tokens — an auth file "
+            "with nothing in it would lock every client out; remove "
+            "the flag for an open edge")
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+
+class _EdgeHandler(BaseHTTPRequestHandler):
+    server_version = "tpuprof-serve"
+    protocol_version = "HTTP/1.1"
+
+    # BaseHTTPRequestHandler logs every request to stderr; the edge's
+    # audit trail is the metrics + serve_job events, not daemon noise
+    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+        pass
+
+    def do_POST(self) -> None:
+        self._route("POST")
+
+    def do_GET(self) -> None:
+        self._route("GET")
+
+    def _route(self, method: str) -> None:
+        edge: "HttpEdge" = self.server.edge  # type: ignore[attr-defined]
+        t0 = time.perf_counter()
+        try:
+            code, body, route = edge.handle(method, self.path,
+                                            self._read_body(),
+                                            self.headers)
+        except Exception as exc:    # noqa: BLE001 — an edge answers
+            code, route = 500, "error"
+            body = {"error": f"{type(exc).__name__}: {exc}"}
+        try:
+            payload = body if isinstance(body, bytes) \
+                else json.dumps(body, indent=1, default=str).encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8" \
+                if isinstance(body, bytes) else "application/json"
+            self.send_response(code)
+            if code == 401:
+                self.send_header("WWW-Authenticate", "Bearer")
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            pass                    # client went away mid-answer
+        _REQUESTS.inc(code=str(code), route=route)
+        _REQUEST_SECONDS.observe(time.perf_counter() - t0)
+
+    def _read_body(self) -> Optional[bytes]:
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            return None
+        if length < 0 or length > MAX_BODY_BYTES:
+            return None
+        return self.rfile.read(length) if length else b""
+
+
+class HttpEdge:
+    """One daemon's HTTP front door: a :class:`ThreadingHTTPServer`
+    delegating every route to the daemon's spool + scheduler.  Bind
+    with ``port=0`` for an ephemeral port (CI — no collisions on a
+    busy box); the bound port is on :attr:`port` and advertised in
+    ``SPOOL/daemons/http.<daemon-id>`` for fleet-local discovery."""
+
+    def __init__(self, daemon: ServeDaemon, port: int = 0,
+                 host: str = "127.0.0.1",
+                 auth_file: Optional[str] = None):
+        self.daemon = daemon
+        self.tokens = load_auth_file(auth_file) if auth_file else None
+        self.httpd = ThreadingHTTPServer((host, int(port)), _EdgeHandler)
+        self.httpd.edge = self      # type: ignore[attr-defined]
+        self.httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self.httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+        self._advert: Optional[str] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "HttpEdge":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name=f"tpuprof-http-{self.port}")
+        self._thread.start()
+        # advertise the endpoint next to the heartbeats: fleet-local
+        # clients (and the bench/CI harness, which binds port 0)
+        # discover the edge from the spool instead of parsing stderr
+        from tpuprof.runtime import fleet as _fleet
+        daemons = os.path.join(self.daemon.spool, "daemons")
+        os.makedirs(daemons, exist_ok=True)
+        self._advert = os.path.join(
+            daemons, f"http.{self.daemon.daemon_id or 'edge'}")
+        _fleet.atomic_write(self._advert, (self.url + "\n").encode())
+        return self
+
+    def close(self) -> None:
+        if self._advert:
+            try:
+                os.unlink(self._advert)
+            except OSError:
+                pass
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -- routing -----------------------------------------------------------
+
+    def handle(self, method: str, path: str, body: Optional[bytes],
+               headers) -> Tuple[int, Any, str]:
+        """(status, body, route-pattern) for one request.  ``body`` as
+        bytes passes through verbatim (the /metrics exposition);
+        anything else is JSON-encoded by the handler."""
+        path = path.split("?", 1)[0]
+        if method == "GET" and path == "/metrics":
+            return (200,
+                    _obs_metrics.registry().render_text().encode(),
+                    "/metrics")
+        if not path.startswith("/v1/"):
+            return 404, {"error": f"no route {path!r}"}, "other"
+        tenant = None
+        if self.tokens is not None:
+            auth = headers.get("Authorization") or ""
+            token = auth[len("Bearer "):] if auth.startswith("Bearer ") \
+                else None
+            tenant = self.tokens.get(token) if token else None
+            if tenant is None:
+                # unknown and missing tokens answer identically: an
+                # auth probe learns nothing about which tokens exist
+                return (401, {"error": "missing or unknown bearer "
+                                       "token"}, "auth")
+        if method == "POST" and path == "/v1/jobs":
+            return self._post_job(body, tenant)
+        if method == "GET":
+            m = re.match(r"^/v1/jobs/([^/]+)$", path)
+            if m:
+                return self._get_job(m.group(1))
+            m = re.match(r"^/v1/results/([^/]+)$", path)
+            if m:
+                return self._get_result(m.group(1))
+            m = re.match(r"^/v1/watch/([^/]+)/alerts$", path)
+            if m:
+                return self._get_alerts(m.group(1))
+        return 404, {"error": f"no route {method} {path!r}"}, "other"
+
+    def _post_job(self, body: Optional[bytes],
+                  auth_tenant: Optional[str]) -> Tuple[int, Any, str]:
+        route = "/v1/jobs"
+        # a corrupt request body is the CLIENT's failure: 400 with the
+        # parse error, never a daemon crash, never a spooled job
+        if body is None:
+            return (400, {"error": "missing or oversized request body "
+                                   f"(cap {MAX_BODY_BYTES} bytes)"},
+                    route)
+        try:
+            req = json.loads(body)
+        except ValueError as exc:
+            return (400, {"error": f"request body is not JSON "
+                                   f"({exc})"}, route)
+        if not isinstance(req, dict):
+            return (400, {"error": "request body must be a JSON "
+                                   "object"}, route)
+        if req.get("schema") not in (None, JOB_SCHEMA):
+            return (400, {"error": f"job schema {req.get('schema')!r} "
+                                   f"is not {JOB_SCHEMA}"}, route)
+        source = req.get("source")
+        if not isinstance(source, str) or not source:
+            return 400, {"error": "job needs a 'source' path"}, route
+        config = req.get("config")
+        if config is not None and not isinstance(config, dict):
+            return (400, {"error": "'config' must be a JSON object of "
+                                   "ProfilerConfig kwargs"}, route)
+        for key in ("output", "stats_json", "artifact", "tenant"):
+            v = req.get(key)
+            if v is not None and not isinstance(v, str):
+                return 400, {"error": f"{key!r} must be a string"}, route
+        # identity comes from the credential when auth is on — a body
+        # naming someone else's tenant is billing fraud, not a knob
+        tenant = auth_tenant if auth_tenant is not None \
+            else (req.get("tenant") or "default")
+        job = self.daemon.submit_local(
+            source, output=req.get("output"), tenant=tenant,
+            stats_json=req.get("stats_json"),
+            artifact=req.get("artifact"), config_kwargs=config)
+        if job.state == "rejected":
+            # the scheduler's admission hook decides the status class:
+            # resource pressure (full queue / tenant over quota) is
+            # 429 retry-later WITH the scheduler's reject reason; a
+            # draining daemon is 503; a bad config is the request's
+            # own fault (400)
+            if job.reject_kind in ("QueueFull", "TenantQuotaExceeded"):
+                code = 429
+            elif job.reject_kind == "QueueClosed":
+                code = 503
+            else:
+                code = 400
+            wire = dict(job.to_wire())
+            wire["schema"] = RESULT_SCHEMA
+            return code, wire, route
+        return (202, {"schema": JOB_SCHEMA, "id": job.id,
+                      "tenant": job.tenant, "status": job.state},
+                route)
+
+    def _get_job(self, jid: str) -> Tuple[int, Any, str]:
+        route = "/v1/jobs/<id>"
+        if not _ID_RE.match(jid):
+            return 400, {"error": f"malformed job id {jid!r}"}, route
+        job = self.daemon.scheduler.job(jid)
+        if job is not None:
+            return 200, dict(job.to_wire()), route
+        try:
+            res = read_result(self.daemon.spool, jid)
+        except CorruptResultError as exc:
+            return 500, {"error": f"{type(exc).__name__}: {exc}"}, route
+        if res is not None:
+            return 200, res, route
+        if os.path.exists(os.path.join(self.daemon.dirs["jobs"],
+                                       f"{jid}.json")):
+            # spooled but not ours: queued on (or stealable from) a
+            # fleet peer — the edge answers for the whole fleet
+            return 200, {"id": jid, "status": "queued"}, route
+        return 404, {"error": f"unknown job {jid!r}"}, route
+
+    def _get_result(self, jid: str) -> Tuple[int, Any, str]:
+        route = "/v1/results/<id>"
+        if not _ID_RE.match(jid):
+            return 400, {"error": f"malformed job id {jid!r}"}, route
+        try:
+            res = read_result(self.daemon.spool, jid)
+        except CorruptResultError as exc:
+            # server-side rot: the poller's re-poll contract applies
+            # (the writer may still atomically replace it), so answer
+            # 500 with the typed name and let the client keep polling
+            return 500, {"error": f"{type(exc).__name__}: {exc}"}, route
+        if res is not None:
+            return 200, res, route
+        if jid in self.daemon.scheduler._jobs \
+                or os.path.exists(os.path.join(self.daemon.dirs["jobs"],
+                                               f"{jid}.json")):
+            return 202, {"id": jid, "status": "pending"}, route
+        return 404, {"error": f"unknown job {jid!r}"}, route
+
+    def _get_alerts(self, key: str) -> Tuple[int, Any, str]:
+        route = "/v1/watch/<key>/alerts"
+        # the key names a directory: the charset check plus the
+        # dots-only rejection ("..") keeps reads inside SPOOL/watch/
+        if not _ID_RE.match(key) or set(key) <= {"."}:
+            return 400, {"error": f"malformed watch key {key!r}"}, route
+        path = os.path.join(self.daemon.spool, "watch", key,
+                            "alerts.json")
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            return (404, {"error": f"no alert feed for watch key "
+                                   f"{key!r}"}, route)
+        # the feed is written atomically (watch.py _atomic_write) and
+        # is already JSON — stream the bytes; no parse, no copy drift
+        return 200, data or b"[]", route
+
+
+# ---------------------------------------------------------------------------
+# client side (`tpuprof submit --url`)
+# ---------------------------------------------------------------------------
+
+def _request(url: str, method: str = "GET",
+             payload: Optional[Dict[str, Any]] = None,
+             token: Optional[str] = None,
+             timeout: float = 30.0) -> Tuple[int, Dict[str, Any]]:
+    """One HTTP exchange -> (status, decoded JSON body).  An HTTP
+    error status is a NORMAL return (the daemon answered); only
+    failing to reach the daemon at all raises, and it raises the typed
+    :class:`ServeUnavailableError` automation can branch on."""
+    import urllib.error
+    import urllib.request
+    data = None
+    headers = {"Accept": "application/json"}
+    if payload is not None:
+        data = json.dumps(payload).encode()
+        headers["Content-Type"] = "application/json"
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(url, data=data, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            status = resp.status
+    except urllib.error.HTTPError as exc:
+        raw = exc.read()
+        status = exc.code
+    except (urllib.error.URLError, OSError) as exc:
+        reason = getattr(exc, "reason", exc)
+        raise ServeUnavailableError(
+            f"cannot reach tpuprof serve at {url}: {reason} — is the "
+            "daemon running with --http?") from exc
+    try:
+        doc = json.loads(raw) if raw else {}
+    except ValueError:
+        doc = {"error": raw.decode("utf-8", "replace")[:500]}
+    if not isinstance(doc, dict):
+        doc = {"body": doc}
+    return status, doc
+
+
+def submit_job(base_url: str, source: str, output: Optional[str] = None,
+               tenant: Optional[str] = None,
+               stats_json: Optional[str] = None,
+               artifact: Optional[str] = None,
+               config_kwargs: Optional[Dict[str, Any]] = None,
+               token: Optional[str] = None,
+               timeout: float = 30.0) -> Tuple[int, Dict[str, Any]]:
+    """POST one job to an HTTP edge.  Paths resolve to absolute
+    client-side, exactly like the spool transport's ``write_job`` —
+    the daemon's cwd is not the client's (the edge and its clients
+    share storage the way spool clients do)."""
+    payload: Dict[str, Any] = {
+        "schema": JOB_SCHEMA,
+        "source": os.path.abspath(source),
+        "output": os.path.abspath(output) if output else None,
+        "stats_json": os.path.abspath(stats_json) if stats_json else None,
+        "artifact": os.path.abspath(artifact) if artifact else None,
+        "config": dict(config_kwargs or {}),
+    }
+    if tenant is not None:
+        payload["tenant"] = str(tenant)
+    return _request(base_url.rstrip("/") + "/v1/jobs", method="POST",
+                    payload=payload, token=token, timeout=timeout)
+
+
+def wait_result_http(base_url: str, job_id: str,
+                     timeout: Optional[float] = None,
+                     poll_interval: float = 0.1,
+                     token: Optional[str] = None) -> Dict[str, Any]:
+    """Poll ``GET /v1/results/<id>`` until the terminal record lands —
+    the HTTP twin of the spool's ``wait_result``, sharing its jittered
+    exponential backoff (ISSUE 11 satellite) and its corrupt-record
+    contract: a 500 naming ``CorruptResultError`` is re-polled and
+    surfaces TYPED at the deadline."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    backoff = poll_intervals(poll_interval)
+    corrupt: Optional[CorruptResultError] = None
+    url = f"{base_url.rstrip('/')}/v1/results/{job_id}"
+    while True:
+        status, doc = _request(url, token=token)
+        if status == 200:
+            return doc
+        if status == 401:
+            raise InputError(
+                f"result poll for job {job_id} rejected: "
+                f"{doc.get('error', 'unauthorized')}")
+        corrupt = CorruptResultError(doc.get("error") or "corrupt") \
+            if status == 500 and "CorruptResultError" in \
+            str(doc.get("error")) else None
+        if deadline is not None and time.monotonic() > deadline:
+            if corrupt is not None:
+                raise corrupt
+            raise TimeoutError(
+                f"no result for job {job_id} after {timeout}s at "
+                f"{base_url} — the job may still be running "
+                "server-side")
+        sleep = next(backoff)
+        if deadline is not None:
+            sleep = min(sleep, max(deadline - time.monotonic(), 0.0)
+                        + 0.001)
+        time.sleep(sleep)
+
+
+def discover_edges(spool: str) -> Dict[str, str]:
+    """{daemon_id: url} from the spool's endpoint advertisements —
+    how the bench harness (and fleet-local tooling) finds ephemeral-
+    port edges without parsing daemon stderr."""
+    daemons = os.path.join(spool, "daemons")
+    out: Dict[str, str] = {}
+    try:
+        names = os.listdir(daemons)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith("http.") or name.startswith(".tmp."):
+            continue
+        try:
+            with open(os.path.join(daemons, name),
+                      encoding="utf-8") as fh:
+                url = fh.read().strip()
+        except OSError:
+            continue
+        if url:
+            out[name[len("http."):]] = url
+    return out

@@ -67,6 +67,10 @@ class Job:
         self.state = QUEUED
         self.error: Optional[str] = None
         self.exit_code: Optional[int] = None
+        self.reject_kind: Optional[str] = None  # admission-failure class
+                                                # name (QueueFull, ...)
+                                                # — the HTTP edge's
+                                                # status-code hook
         self.result: Dict[str, Any] = {}
         self.enqueued_at = time.monotonic()
         self.started_at: Optional[float] = None
@@ -120,6 +124,8 @@ class Job:
             out["error"] = self.error
         if self.exit_code is not None:
             out["exit_code"] = self.exit_code
+        if self.reject_kind is not None:
+            out["reject_kind"] = self.reject_kind
         if self.cache_hit is not None:
             out["cache_hit"] = self.cache_hit
         out.update(self.result)

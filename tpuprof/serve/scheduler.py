@@ -104,6 +104,11 @@ class ProfileScheduler:
             self._queue.admit(job)
         except (QueueFull, TenantQuotaExceeded, QueueClosed,
                 ValueError, TypeError) as exc:
+            # the admission hook the HTTP edge (serve/http.py) maps to
+            # status codes: quota/depth rejections are 429 (retry
+            # later), a closing queue is 503, everything else is the
+            # request's own fault (400)
+            job.reject_kind = type(exc).__name__
             job.to(REJECTED, error=str(exc))
             with self._lock:
                 self._submitted += 1

@@ -17,6 +17,24 @@ Layout under the spool dir::
                         file is unlinked after the result lands, so a
                         daemon restart re-runs only jobs with no result
     tmp/                atomic-write staging
+    claims/             multi-daemon job claims (claim mode only):
+                        <id>.claim owned-by content, <id>.steal.<g>
+                        generation-g takeovers — the runtime/fleet.py
+                        arbiters applied to whole jobs
+    daemons/            one mtime heartbeat (hb.<daemon-id>) per live
+                        daemon, plus http.<daemon-id> endpoint
+                        advertisements from the HTTP edge
+
+**Fleet mode** (``claim_jobs=True`` — `tpuprof serve --http` /
+`--claim-jobs`): N daemons share ONE spool.  Exactly one daemon
+executes each job — the atomic-create claim is the only arbiter, a
+dead daemon's heartbeat goes stale and survivors steal its
+claimed-but-unanswered jobs at the next steal generation
+(runtime/fleet.py's claim/steal/heartbeat machinery, reused on jobs
+instead of fragments).  Results stay exactly-once per id by
+construction: they are keyed files written atomically, and every
+ingest path skips jobs whose result already landed.  The default
+single-daemon spool (`tpuprof serve SPOOL`) takes none of these paths.
 
 The daemon is a thin shell: scanning the spool and writing results; job
 lifecycle itself lives in serve/scheduler.py, which `tpuprof submit`,
@@ -27,15 +45,43 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterator, Optional
 
+from tpuprof.obs import events as _obs_events
+from tpuprof.obs import metrics as _obs_metrics
 from tpuprof.serve.jobs import TERMINAL, Job
 from tpuprof.serve.scheduler import ProfileScheduler
 
 JOB_SCHEMA = "tpuprof-serve-job-v1"
 RESULT_SCHEMA = "tpuprof-serve-result-v1"
+
+_CLAIMED = _obs_metrics.gauge(
+    "tpuprof_serve_jobs_claimed",
+    "spool jobs this daemon has claimed and not yet answered, by "
+    "daemon id (fleet mode)")
+_STOLEN = _obs_metrics.counter(
+    "tpuprof_serve_jobs_stolen_total",
+    "spool jobs taken over from dead fleet daemons, by daemon id")
+
+
+def poll_intervals(initial: float = 0.1, cap: float = 2.0,
+                   factor: float = 2.0,
+                   jitter: float = 0.25) -> Iterator[float]:
+    """Jittered exponential backoff for result polling — shared by
+    :func:`wait_result` (file spool) and the HTTP client poll loop
+    (serve/http.py).  Yields sleep durations starting at ``initial``,
+    doubling to ``cap``, each scattered by ±``jitter`` so a burst of
+    waiting clients never polls in lockstep against one daemon (the
+    fixed 0.1 s busy-poll this replaced hammered shared-storage spools
+    with N synchronized stat calls per second per client)."""
+    delay = max(float(initial), 0.001)
+    cap = max(float(cap), delay)
+    while True:
+        yield delay * (1.0 + random.uniform(-jitter, jitter))
+        delay = min(delay * factor, cap)
 
 
 def _spool_dirs(spool: str) -> Dict[str, str]:
@@ -117,13 +163,18 @@ def wait_result(spool: str, job_id: str, timeout: Optional[float] = None,
                 poll_interval: float = 0.1) -> Dict[str, Any]:
     """Poll the results dir until the job's terminal record lands.
 
-    A torn result file is re-polled, not fatal — on a non-atomic
-    filesystem the writer's rename may still land a whole record — but
-    at the deadline the typed :class:`CorruptResultError` surfaces
-    instead of a misleading "is the daemon running?" timeout."""
+    ``poll_interval`` seeds a jittered exponential backoff
+    (:func:`poll_intervals`): tight while a warm answer is plausible,
+    backing off to a capped cadence for the long waits, never past the
+    deadline.  A torn result file is re-polled, not fatal — on a
+    non-atomic filesystem the writer's rename may still land a whole
+    record — but at the deadline the typed :class:`CorruptResultError`
+    surfaces instead of a misleading "is the daemon running?"
+    timeout."""
     from tpuprof.errors import CorruptResultError
     deadline = None if timeout is None else time.monotonic() + timeout
     corrupt: Optional[CorruptResultError] = None
+    backoff = poll_intervals(poll_interval)
     while True:
         try:
             res = read_result(spool, job_id)
@@ -138,7 +189,12 @@ def wait_result(spool: str, job_id: str, timeout: Optional[float] = None,
             raise TimeoutError(
                 f"no result for job {job_id} after {timeout}s — is "
                 f"`tpuprof serve {spool}` running?")
-        time.sleep(poll_interval)
+        sleep = next(backoff)
+        if deadline is not None:
+            # land ON the deadline, not one full backoff past it
+            sleep = min(sleep, max(deadline - time.monotonic(), 0.0)
+                        + 0.001)
+        time.sleep(sleep)
 
 
 # ---------------------------------------------------------------------------
@@ -146,11 +202,22 @@ def wait_result(spool: str, job_id: str, timeout: Optional[float] = None,
 # ---------------------------------------------------------------------------
 
 class ServeDaemon:
-    """Spool watcher around a :class:`ProfileScheduler`."""
+    """Spool watcher around a :class:`ProfileScheduler`.
+
+    With ``claim_jobs=True`` the daemon is one member of a serve
+    fleet: it heartbeats under ``daemons/hb.<daemon_id>``, ingests
+    only the spool jobs it wins the atomic claim for, and steals a
+    dead peer's claimed-but-unanswered jobs once the peer's heartbeat
+    goes stale (``liveness_timeout_s``).  The default (False) is the
+    historical single-daemon spool, byte-path untouched."""
 
     def __init__(self, spool: str,
                  scheduler: Optional[ProfileScheduler] = None,
-                 poll_interval: float = 0.2, **scheduler_kwargs):
+                 poll_interval: float = 0.2,
+                 claim_jobs: bool = False,
+                 daemon_id: Optional[str] = None,
+                 liveness_timeout_s: Optional[float] = None,
+                 **scheduler_kwargs):
         self.spool = spool
         self.dirs = _spool_dirs(spool)
         self.poll_interval = max(float(poll_interval), 0.01)
@@ -159,6 +226,136 @@ class ServeDaemon:
         self._pending: Dict[str, Job] = {}   # submitted, result not yet out
         self._seen: set = set()
         self.stop_event = threading.Event()
+        self.claim_jobs = bool(claim_jobs)
+        self.daemon_id = None
+        self._hb_thread = None
+        if self.claim_jobs:
+            from tpuprof.config import (resolve_fleet_host_id,
+                                        resolve_liveness_timeout)
+            self.daemon_id = resolve_fleet_host_id(daemon_id)
+            if "/" in self.daemon_id:
+                raise ValueError(
+                    f"daemon_id {self.daemon_id!r} must be a plain "
+                    "filename token (it names heartbeat/claim files)")
+            self.liveness_timeout_s = \
+                resolve_liveness_timeout(liveness_timeout_s)
+            for name in ("claims", "daemons"):
+                self.dirs[name] = os.path.join(spool, name)
+                os.makedirs(self.dirs[name], exist_ok=True)
+            # heartbeat BEFORE the first claim: a claim by a daemon
+            # with no heartbeat file would read as instantly dead
+            from tpuprof.runtime import fleet as _fleet
+            self._hb_path = os.path.join(self.dirs["daemons"],
+                                         f"hb.{self.daemon_id}")
+            _fleet.atomic_write(self._hb_path, b"alive\n")
+            self._hb_thread = threading.Thread(
+                target=self._beat, daemon=True,
+                name=f"tpuprof-serve-hb-{self.daemon_id}")
+            self._hb_thread.start()
+            _obs_events.emit("serve_fleet_join", daemon=self.daemon_id,
+                             spool=self.spool)
+
+    # -- fleet membership (claim mode only) --------------------------------
+
+    def _beat(self) -> None:
+        # mtime refresh is the liveness signal, exactly the
+        # runtime/fleet.py heartbeat contract; a SIGKILL stops the
+        # refresh and the file goes stale, a graceful close() deletes
+        # it so peers steal leftovers immediately
+        interval = min(max(self.liveness_timeout_s / 4.0, 0.05), 2.0)
+        from tpuprof.runtime import fleet as _fleet
+        while not self.stop_event.wait(interval):
+            try:
+                os.utime(self._hb_path)
+            except OSError:
+                try:
+                    _fleet.atomic_write(self._hb_path, b"alive\n")
+                except OSError:
+                    pass
+
+    def _daemon_alive(self, daemon_id: str) -> bool:
+        try:
+            mtime = os.path.getmtime(
+                os.path.join(self.dirs["daemons"], f"hb.{daemon_id}"))
+        except OSError:
+            return False            # no heartbeat file = dead
+        return time.time() - mtime < self.liveness_timeout_s
+
+    def _scan_claims(self) -> Dict[str, tuple]:
+        """One directory read -> {job_id: (generation, owner_path)};
+        the owner's NAME is read lazily (only for jobs we might act
+        on).  Generation 0 is the original claim, g >= 1 are steals —
+        highest generation owns the job."""
+        out: Dict[str, tuple] = {}
+        try:
+            names = os.listdir(self.dirs["claims"])
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith("."):
+                continue            # in-flight atomic-write temps
+            if name.endswith(".claim"):
+                jid, gen = name[: -len(".claim")], 0
+            else:
+                jid, _, g = name.rpartition(".steal.")
+                if not jid or not g.isdigit():
+                    continue
+                gen = int(g)
+            cur = out.get(jid)
+            if cur is None or gen > cur[0]:
+                out[jid] = (gen,
+                            os.path.join(self.dirs["claims"], name))
+        return out
+
+    def _try_own(self, jid: str,
+                 claims: Dict[str, tuple]) -> bool:
+        """Claim-mode arbiter for one spooled job: True exactly when
+        THIS daemon owns it now (fresh claim won, already ours from a
+        restart, or stolen from a dead peer)."""
+        from tpuprof.runtime import fleet as _fleet
+        claim_path = os.path.join(self.dirs["claims"], f"{jid}.claim")
+        cur = claims.get(jid)
+        if cur is None:
+            # unclaimed: the atomic hardlink create is the whole
+            # arbiter — exactly one winner, losers see EEXIST
+            return _fleet.excl_create(claim_path, self.daemon_id)
+        gen, owner_path = cur
+        owner = _fleet.read_small(owner_path)
+        if owner == self.daemon_id:
+            # ours — either the HTTP edge claimed it synchronously
+            # (already in _seen) or a restart with the same daemon_id
+            # is adopting its predecessor's unanswered claims
+            return True
+        if owner and self._daemon_alive(owner):
+            return False            # a live peer's job
+        # owner dead (or claim unreadable): take generation g+1.
+        # Thieves are subject to liveness like anyone else, so a dead
+        # thief's loot is re-stealable at g+2 — runtime/fleet.py's
+        # steal-generation contract on jobs
+        steal_path = os.path.join(self.dirs["claims"],
+                                  f"{jid}.steal.{gen + 1}")
+        if _fleet.excl_create(steal_path, self.daemon_id):
+            _STOLEN.inc(daemon=self.daemon_id)
+            _obs_events.emit("serve_job_stolen", job=jid,
+                             daemon=self.daemon_id,
+                             from_daemon=owner, generation=gen + 1)
+            return True
+        return False
+
+    def _cleanup_claims(self, jid: str) -> None:
+        if not self.claim_jobs:
+            return
+        try:
+            names = os.listdir(self.dirs["claims"])
+        except OSError:
+            return
+        for name in names:
+            if name == f"{jid}.claim" \
+                    or name.startswith(f"{jid}.steal."):
+                try:
+                    os.unlink(os.path.join(self.dirs["claims"], name))
+                except OSError:
+                    pass
 
     # -- one scan ----------------------------------------------------------
 
@@ -166,15 +363,45 @@ class ServeDaemon:
         """Pick up new job files, flush finished jobs' results.
         Returns how many jobs are still in flight (queued/running with
         no result written)."""
+        claims = self._scan_claims() if self.claim_jobs else None
+        # pull, don't hoard: a fleet daemon claims only what its
+        # workers can soon run (workers x2 of prefetch) — claiming the
+        # whole spool up front would serialize a burst onto whichever
+        # daemon's scan ran first and starve its peers (the fleet
+        # scheduler's "a slow host claims less" contract, on jobs)
+        claim_budget = self.scheduler.workers * 2 - len(self._pending) \
+            if claims is not None else 0
         for name in sorted(os.listdir(self.dirs["jobs"])):
             if not name.endswith(".json") or name in self._seen:
                 continue
+            jid = name[: -len(".json")]
+            if claims is not None:
+                if os.path.exists(os.path.join(self.dirs["results"],
+                                               f"{jid}.json")):
+                    # answered (possibly by a peer): consume the
+                    # request so no daemon ever re-runs it
+                    self._unlink_job(name)
+                    self._cleanup_claims(jid)
+                    continue
+                if claim_budget <= 0:
+                    continue
+                if not self._try_own(jid, claims):
+                    # a peer's job — NOT added to _seen: it is
+                    # re-examined every poll so a stale owner's jobs
+                    # become stealable
+                    continue
+                claim_budget -= 1
+                _CLAIMED.set(float(len(self._pending) + 1),
+                             daemon=self.daemon_id)
             self._seen.add(name)
             self._ingest_job_file(name)
         for jid, job in list(self._pending.items()):
             if job.state in TERMINAL:
                 self._write_result(job)
                 del self._pending[jid]
+        if claims is not None:
+            _CLAIMED.set(float(len(self._pending)),
+                         daemon=self.daemon_id)
         return len(self._pending)
 
     def _ingest_job_file(self, name: str) -> None:
@@ -186,6 +413,7 @@ class ServeDaemon:
         if os.path.exists(os.path.join(self.dirs["results"],
                                        f"{jid}.json")):
             self._unlink_job(name)
+            self._cleanup_claims(jid)
             return
         try:
             with open(path) as fh:
@@ -209,6 +437,7 @@ class ServeDaemon:
                 "schema": RESULT_SCHEMA, "id": jid, "status": "rejected",
                 "error": f"unreadable job file: {exc}"})
             self._unlink_job(name)
+            self._cleanup_claims(jid)
             return
         job = self.scheduler.submit(job)
         if job.state in TERMINAL:       # rejected at admission
@@ -219,8 +448,11 @@ class ServeDaemon:
     def _write_result(self, job: Job) -> None:
         payload = {"schema": RESULT_SCHEMA}
         payload.update(job.to_wire())
+        if self.daemon_id:
+            payload["daemon"] = self.daemon_id
         self._write_result_payload(job.id, payload)
         self._unlink_job(f"{job.id}.json")
+        self._cleanup_claims(job.id)
 
     def _write_result_payload(self, jid: str,
                               payload: Dict[str, Any]) -> None:
@@ -234,6 +466,53 @@ class ServeDaemon:
         except OSError:
             pass
         self._seen.discard(name)
+
+    # -- HTTP-edge admission (serve/http.py) -------------------------------
+
+    def submit_local(self, source: str, output: Optional[str] = None,
+                     tenant: str = "default",
+                     stats_json: Optional[str] = None,
+                     artifact: Optional[str] = None,
+                     config_kwargs: Optional[Dict[str, Any]] = None
+                     ) -> Job:
+        """Admit one job through THIS daemon's scheduler, durably.
+
+        The job file lands in the shared spool BEFORE admission and is
+        claimed by this daemon, so an HTTP-accepted job survives its
+        accepting daemon: a SIGKILL mid-run leaves a spooled request
+        whose claim goes stale, and any surviving fleet peer steals
+        and answers it (the PR-10 exactly-once result contract, now
+        fleet-wide).  Admission REJECTIONS answer synchronously (the
+        HTTP 4xx) and also spool a result record so a polling client
+        sees the same terminal state either way."""
+        from tpuprof.serve.jobs import new_job_id
+        jid = new_job_id()
+        if self.claim_jobs:
+            # claim BEFORE the job file lands: a peer's scan between
+            # spool-write and claim would otherwise win the claim and
+            # run the job a second time next to our local admission
+            from tpuprof.runtime import fleet as _fleet
+            _fleet.excl_create(
+                os.path.join(self.dirs["claims"], f"{jid}.claim"),
+                self.daemon_id)
+        write_job(self.spool, source, output=output, tenant=tenant,
+                  stats_json=stats_json, artifact=artifact,
+                  config_kwargs=config_kwargs, job_id=jid)
+        self._seen.add(f"{jid}.json")   # the poll loop must not re-ingest
+        job = Job(source=os.path.abspath(source),
+                  output=os.path.abspath(output) if output else None,
+                  tenant=tenant, job_id=jid,
+                  stats_json=os.path.abspath(stats_json)
+                  if stats_json else None,
+                  artifact=os.path.abspath(artifact)
+                  if artifact else None,
+                  config_kwargs=dict(config_kwargs or {}))
+        job = self.scheduler.submit(job)
+        if job.state in TERMINAL:       # rejected at admission
+            self._write_result(job)
+        else:
+            self._pending[job.id] = job
+        return job
 
     # -- loop --------------------------------------------------------------
 
@@ -255,3 +534,17 @@ class ServeDaemon:
             if job.state in TERMINAL:
                 self._write_result(job)
                 del self._pending[jid]
+        if self.claim_jobs:
+            # graceful depart: delete the heartbeat so fleet peers
+            # steal any leftover claims immediately instead of waiting
+            # out the liveness timeout (the fleet.depart idiom); a
+            # SIGKILL skips this and the mtime goes stale instead
+            try:
+                os.unlink(self._hb_path)
+            except OSError:
+                pass
+            if self._hb_thread is not None:
+                self._hb_thread.join(timeout=5)
+            _obs_events.emit("serve_fleet_depart",
+                             daemon=self.daemon_id,
+                             unanswered=len(self._pending))
