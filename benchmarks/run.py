@@ -1269,6 +1269,47 @@ def run_watch(scale: float, workdir: str) -> dict:
     return out
 
 
+LINT_WALL_TARGET_S = 5.0
+
+
+def measure_lint() -> dict:
+    """ISSUE 12 bench guard: the invariant suite must stay cheap
+    enough to live in tier-1 forever — wall target < 5 s over the real
+    tree on this box (measured ~0.8 s at PR 12).  Tracked signals are
+    the wall and the finding counts (unsuppressed must be 0 at HEAD;
+    the leg FAILS loudly on drift rather than recording it as a
+    number)."""
+    from tpuprof.analysis import run_lint
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.perf_counter()
+    report = run_lint(root)
+    wall = time.perf_counter() - t0
+    unsuppressed = report.unsuppressed()
+    if unsuppressed:
+        raise RuntimeError(
+            f"lint leg: {len(unsuppressed)} unsuppressed finding(s) at "
+            "HEAD — fix or justify before benching: "
+            + "; ".join(f.ident for f in unsuppressed[:5]))
+    return {
+        "lint_wall_s": round(wall, 4),
+        "lint_checkers": len(report.checkers_run),
+        "lint_findings_total": len(report.findings),
+        "lint_suppressed": len(report.suppressed),
+        "lint_under_target": wall < LINT_WALL_TARGET_S,
+        # the differ's generic key so the leg diffs round-over-round
+        # (higher = better, like every other leg): full-suite runs per
+        # second of wall
+        "rows_per_sec": round(1.0 / wall, 4),
+    }
+
+
+def run_lint_leg(scale: float, workdir: str) -> dict:
+    out = measure_lint()
+    out["scenario"] = "lint"
+    return out
+
+
 def run_serve(scale: float, workdir: str) -> dict:
     # small fixtures on purpose: the tracked signal is the cold:warm
     # RATIO (compile amortization), which a big scan denominator would
@@ -1281,7 +1322,8 @@ def run_serve(scale: float, workdir: str) -> dict:
 
 REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
                         "hostfed", "prepare", "passb", "faults", "drift",
-                        "rebalance", "serve", "watch", "serve_http")
+                        "rebalance", "serve", "watch", "serve_http",
+                        "lint")
 
 
 def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
@@ -1496,7 +1538,7 @@ def main() -> None:
                                              "passb", "faults", "drift",
                                              "rebalance", "wideexact",
                                              "serve", "watch",
-                                             "serve_http",
+                                             "serve_http", "lint",
                                              "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
@@ -1533,7 +1575,7 @@ def main() -> None:
 
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
               "prepare", "passb", "faults", "drift", "rebalance",
-              "wideexact", "serve", "watch", "serve_http"]
+              "wideexact", "serve", "watch", "serve_http", "lint"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -1562,6 +1604,8 @@ def main() -> None:
             result = run_watch(args.scale, args.workdir)
         elif name == "serve_http":
             result = run_serve_http(args.scale, args.workdir)
+        elif name == "lint":
+            result = run_lint_leg(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

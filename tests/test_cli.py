@@ -329,6 +329,49 @@ class TestHttpEdgeConfigRoundTrip:
         assert ProfilerConfig(serve_http_port=0).serve_http_port == 0
 
 
+class TestLintSurfaceRoundTrips:
+    """ISSUE 12 config-surface fixes: the two legs the first lint run
+    found missing — `--metrics-max-bytes` (the sink cap had env+config
+    but no flag) and `TPUPROF_QUARANTINE_LOG` (the one ladder knob
+    with no env twin) — resolve identically from env, CLI and
+    config."""
+
+    def test_metrics_max_bytes_env_cli_config(self, monkeypatch):
+        from tpuprof.cli import build_parser
+        from tpuprof.config import resolve_metrics_max_bytes
+
+        monkeypatch.delenv("TPUPROF_METRICS_MAX_BYTES", raising=False)
+        via_config = resolve_metrics_max_bytes(
+            ProfilerConfig(metrics_max_bytes=4096).metrics_max_bytes)
+        args = build_parser().parse_args(
+            ["profile", "x.parquet", "--metrics-max-bytes", "4096"])
+        via_cli = resolve_metrics_max_bytes(args.metrics_max_bytes)
+        monkeypatch.setenv("TPUPROF_METRICS_MAX_BYTES", "4096")
+        via_env = resolve_metrics_max_bytes(None)
+        assert via_config == via_cli == via_env == 4096
+        monkeypatch.delenv("TPUPROF_METRICS_MAX_BYTES")
+        assert resolve_metrics_max_bytes(None) is None   # default: off
+
+    def test_quarantine_log_env_cli_config(self, monkeypatch, tmp_path):
+        from tpuprof.cli import build_parser
+        from tpuprof.config import resolve_quarantine_log
+
+        log = str(tmp_path / "q.jsonl")
+        monkeypatch.delenv("TPUPROF_QUARANTINE_LOG", raising=False)
+        via_config = resolve_quarantine_log(
+            ProfilerConfig(quarantine_log=log).quarantine_log)
+        args = build_parser().parse_args(
+            ["profile", "x.parquet", "--quarantine-log", log])
+        via_cli = resolve_quarantine_log(args.quarantine_log)
+        monkeypatch.setenv("TPUPROF_QUARANTINE_LOG", log)
+        via_env = resolve_quarantine_log(None)
+        assert via_config == via_cli == via_env == log
+        # explicit wins over the env twin
+        assert resolve_quarantine_log("/x") == "/x"
+        monkeypatch.delenv("TPUPROF_QUARANTINE_LOG")
+        assert resolve_quarantine_log(None) is None      # default: none
+
+
 class TestJobTimeoutRoundTrip:
     """`job_timeout_s` + the watch knobs resolve identically from env,
     CLI and config (ISSUE 10 satellite — the standard three-way

@@ -155,13 +155,15 @@ class TestCheckpointIntegrity:
 
     def test_raising_save_leaves_no_tmp(self, tmp_path):
         """Satellite bugfix: a save that raises mid-write must unlink
-        path.tmp (and never publish a head)."""
+        its temp (and never publish a head).  The temp is dot-prefixed
+        since ISSUE 12 (durability lint invariant), so assert the
+        whole directory is empty — any litter under any name fails."""
         faults.configure("checkpoint_write:fatal@1")
         path = tmp_path / "c.ckpt"
         with pytest.raises(RuntimeError, match="injected fatal"):
             _save(path)
         assert not os.path.exists(path)
-        assert not os.path.exists(str(path) + ".tmp")
+        assert os.listdir(tmp_path) == []
         # the next (clean) save works on the same path
         faults.reset()
         _save(path, cursor=9)

@@ -694,52 +694,47 @@ class TestFixedMembershipUntouched:
 
 
 class TestTaxonomyDocSync:
-    """ISSUE 7 satellite: every typed error in tpuprof/errors.py must
-    have a documented exit code in ROBUSTNESS.md's taxonomy table —
-    and the documented codes must match errors.exit_code — so the
-    table can never drift again (it had: PoisonBatchError was mapped
-    to exit 5 in PR 5 while the doc still said 'traceback', and
-    CorruptArtifactError was missing entirely)."""
+    """ISSUE 7 satellite, rewired by ISSUE 12: the hand-rolled
+    ROBUSTNESS.md table parser that used to live here moved into the
+    `error-taxonomy` lint checker (tpuprof/analysis) — this class now
+    asserts THROUGH the analyzer, so the taxonomy contract has exactly
+    one parser.  History the invariant earns its keep on:
+    PoisonBatchError was mapped to exit 5 in PR 5 while the doc still
+    said 'traceback', and CorruptArtifactError was missing entirely."""
 
     @staticmethod
-    def _doc_rows():
-        import re
+    def _findings():
+        from tpuprof.analysis import run_lint
         here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        text = open(os.path.join(here, "ROBUSTNESS.md")).read()
-        rows = {}
-        for line in text.splitlines():
-            m = re.match(r"\|\s*`(\w+)`\s*\|.*\|\s*([^|]+?)\s*\|\s*$",
-                         line)
-            if m:
-                rows[m.group(1)] = m.group(2)
-        return rows
+        return run_lint(here, only=["error-taxonomy"]).unsuppressed()
 
-    def test_every_typed_error_is_documented_with_its_exit_code(self):
-        from tpuprof import errors
-        rows = self._doc_rows()
-        for cls in errors.TYPED_ERRORS:
-            assert cls.__name__ in rows, \
-                f"{cls.__name__} missing from the ROBUSTNESS.md table"
-            documented = rows[cls.__name__]
-            exc = cls.__new__(cls)      # exit_code only isinstance-checks
-            assert str(errors.exit_code(exc)) in documented, \
-                (cls.__name__, documented)
-        # the retry rung's marker class is absorbed, never an exit code
-        assert "TransientError" in rows
+    def test_taxonomy_table_in_sync(self):
+        """Every errors.py class documented with its computed exit
+        code, every _EXIT_CODES entry live + typed + collision-free,
+        no dead doc rows — all through the one checker."""
+        assert self._findings() == []
 
-    def test_no_undocumented_error_classes(self):
-        """Every exception defined in errors.py appears in the table —
-        adding a class without documenting it fails here."""
-        import inspect
+    def test_checker_still_bites(self, tmp_path):
+        """The rewire must not have traded teeth for indirection: the
+        same checker run over a tree whose doc drops a class flags
+        it (the live-tree assertion above is only meaningful if this
+        fails on drift)."""
+        import re
 
-        from tpuprof import errors
-        rows = self._doc_rows()
-        for name, obj in vars(errors).items():
-            if inspect.isclass(obj) \
-                    and issubclass(obj, BaseException) \
-                    and obj.__module__ == "tpuprof.errors":
-                assert name in rows, \
-                    f"{name} is not documented in ROBUSTNESS.md"
+        from tpuprof.analysis import run_lint
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg = tmp_path / "tpuprof"
+        pkg.mkdir()
+        (pkg / "errors.py").write_text(
+            open(os.path.join(here, "tpuprof", "errors.py")).read())
+        doc = open(os.path.join(here, "ROBUSTNESS.md")).read()
+        doc = re.sub(r"^\|\s*`PoisonBatchError`.*\n", "", doc,
+                     flags=re.M)
+        (tmp_path / "ROBUSTNESS.md").write_text(doc)
+        idents = [f.ident for f in
+                  run_lint(str(tmp_path),
+                           only=["error-taxonomy"]).unsuppressed()]
+        assert "PoisonBatchError:undocumented" in idents
 
 
 class TestConfigRoundTrips:

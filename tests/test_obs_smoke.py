@@ -65,6 +65,29 @@ EVENT_SCHEMA = {
                        "hosts": ((int,), True),
                        "quarantined_by_host": ((list,), True),
                        "snapshot": ((dict,), True)},
+    # elastic fleet runtime (runtime/fleet.py, ISSUE 7): membership +
+    # work-movement audit trail.  Documented in OBSERVABILITY.md since
+    # PR 7 but absent here until the lint obs-contract checker flagged
+    # the drift (ISSUE 12) — every events.emit kind must have a row
+    "fleet_join": {"ts": ((int, float), True), "host": ((str,), True),
+                   "fragments": ((int,), True),
+                   "adopted": ((list,), True)},
+    "fleet_depart": {"ts": ((int, float), True),
+                     "host": ((str,), True)},
+    "fleet_contribute": {"ts": ((int, float), True),
+                         "host": ((str,), True), "phase": ((str,), True),
+                         "seq": ((int,), True),
+                         "fragments": ((int,), True)},
+    "fleet_fenced": {"ts": ((int, float), True), "host": ((str,), True),
+                     "phase": ((str,), True), "lost": ((list,), True)},
+    "fleet_rebalance": {"ts": ((int, float), True),
+                        "host": ((str,), True), "phase": ((str,), True),
+                        "stolen": ((list,), True)},
+    # incremental resume (tpuprof/artifact/incremental.py, ISSUE 6):
+    # one per profiler rebuilt from a fold-able artifact
+    "artifact_resume": {"ts": ((int, float), True),
+                        "path": ((str,), True), "rows": ((int,), True),
+                        "cursor": ((int,), True)},
     # profile-as-a-service (tpuprof/serve, ISSUE 9): one per terminal
     # job (done|failed|rejected) — the daemon's per-request audit line
     "serve_job": {"ts": ((int, float), True), "id": ((str,), True),

@@ -1,0 +1,45 @@
+"""tpuprof/analysis — the AST-enforced invariant suite (ANALYSIS.md).
+
+The profiler's correctness rests on conventions — atomic tmp+rename
+publication, dot-prefixed tmp names, the config⇄env⇄CLI⇄doc surface,
+metric/event names synced to their docs, the error⇄exit-code taxonomy,
+the locked runner seam — that used to be enforced by scattered
+doc-sync tests and live incident response (two real tmp-name races
+shipped before this suite existed: the ``part....tmp.<pid>``
+prefix-scan race in PR 7 and the shared-pid tmp-unlink race in PR 11).
+`tpuprof lint` machine-checks them on every PR instead.
+
+Public surface::
+
+    from tpuprof.analysis import run_lint
+    report = run_lint("/path/to/repo")        # LintReport
+    report.unsuppressed()                     # [] = clean tree
+    report.to_json()                          # tpuprof-lint-v1
+
+Exit-code contract (CLI ``tpuprof lint``): clean tree → 0, any
+unsuppressed finding → 2 (:class:`tpuprof.errors.LintFindingsError`).
+"""
+
+from tpuprof.analysis.model import LINT_SCHEMA, Finding, LintReport
+from tpuprof.analysis.registry import (checker, checker_doc, checker_ids,
+                                       run_lint)
+from tpuprof.obs import metrics as _obs_metrics
+
+#: one count per unsuppressed finding, labelled by checker id — a CI
+#: lint run with metrics on exposes drift the same way every other
+#: subsystem exposes failure (OBSERVABILITY.md "Lint")
+FINDINGS_TOTAL = _obs_metrics.counter(
+    "tpuprof_lint_findings_total",
+    "unsuppressed lint findings by checker id (tpuprof/analysis)")
+
+
+def observe(report: LintReport) -> None:
+    """Record a finished run's findings on the process registry (the
+    CLI calls this; library callers may too)."""
+    for f in report.unsuppressed():
+        FINDINGS_TOTAL.inc(checker=f.checker)
+
+
+__all__ = ["Finding", "LintReport", "LINT_SCHEMA", "run_lint",
+           "checker", "checker_ids", "checker_doc", "observe",
+           "FINDINGS_TOTAL"]

@@ -239,7 +239,12 @@ def write_artifact(path: str, stats: Optional[Dict[str, Any]] = None,
         "crc32": zlib.crc32(json.dumps(core, **_CANON).encode()) & 0xFFFFFFFF,
     }
     data = json.dumps(doc, indent=1).encode()
-    tmp = path + ".tmp"
+    # dot-prefixed temp (ISSUE 12 durability invariant): artifact
+    # chains are directory-scanned (watch retention), so the in-flight
+    # write must be invisible to every name filter; single writer per
+    # path, so no pid — a crashed write's litter is reclaimed next time
+    tmp = os.path.join(os.path.dirname(path) or ".",
+                       f".{os.path.basename(path)}.tmp")
     try:
         with open(tmp, "wb") as fh:
             _faults.hit("artifact_write", key=meta["rows"])

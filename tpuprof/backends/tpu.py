@@ -733,13 +733,14 @@ class TPUStatsBackend:
         # to the historical fail-fast behavior.
         from tpuprof.config import (resolve_ingest_retries,
                                     resolve_max_quarantined,
+                                    resolve_quarantine_log,
                                     resolve_retry_backoff,
                                     resolve_watchdog_timeout)
         from tpuprof.runtime import guard as _guard
         from tpuprof.testing import faults as _faults
         quarantine = _guard.Quarantine(
             resolve_max_quarantined(config.max_quarantined),
-            log_path=config.quarantine_log)
+            log_path=resolve_quarantine_log(config.quarantine_log))
         batch_guard = _guard.BatchGuard(
             resolve_ingest_retries(config.ingest_retries),
             resolve_retry_backoff(config.retry_backoff_s),

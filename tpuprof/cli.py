@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -106,6 +107,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --metrics-json: emit a metrics snapshot "
                         "every SEC seconds while the profile runs "
                         "(default: one final snapshot only)")
+    p.add_argument("--metrics-max-bytes", type=int, default=None,
+                   metavar="N",
+                   help="JSONL sink growth cap: rotate PATH -> PATH.1 "
+                        "once at N bytes so long streams stay disk-"
+                        "bounded (~2xN; default: TPUPROF_METRICS_MAX_"
+                        "BYTES env, else unlimited)")
     p.add_argument("--progress", action="store_true",
                    help="print a one-line pipeline status (rows, "
                         "batches, dispatches, recent rows/s) to stderr "
@@ -489,6 +496,30 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--fail-on-drift", action="store_true",
                    help="exit 1 when any column reaches drift severity "
                         "(CI gate); corrupt artifacts exit 6 either way")
+
+    l = sub.add_parser(
+        "lint", help="run the AST-enforced invariant suite over the "
+                     "source tree (tpuprof/analysis; ANALYSIS.md): "
+                     "durability seams, config surface, obs contracts, "
+                     "error taxonomy, runtime discipline")
+    l.add_argument("root", nargs="?", default=None,
+                   help="repo root holding tpuprof/ + the docs "
+                        "(default: the checkout this tpuprof package "
+                        "was imported from)")
+    l.add_argument("--json", metavar="PATH", dest="lint_json",
+                   help="also write the machine-readable "
+                        "tpuprof-lint-v1 report here")
+    l.add_argument("--strict", action="store_true",
+                   help="ignore the suppression file: report every "
+                        "finding, absorb none")
+    l.add_argument("--suppressions", metavar="PATH", default=None,
+                   help="suppression file (default: LINT_SUPPRESSIONS "
+                        "at the root; '<checker> <ident-glob> "
+                        "<reason>' lines)")
+    l.add_argument("--only", metavar="ID[,ID...]", default=None,
+                   help="run only these checker ids (comma-separated)")
+    l.add_argument("--list", action="store_true", dest="lint_list",
+                   help="list checker ids + one-line docs and exit")
     return parser
 
 
@@ -524,6 +555,53 @@ def cmd_diff(args: argparse.Namespace) -> int:
           file=sys.stderr)
     if args.fail_on_drift and s["n_drift"]:
         return 1
+    return 0
+
+
+def _default_lint_root() -> str:
+    """The checkout this package was imported from: the directory
+    holding the ``tpuprof/`` package dir (which is where the docs the
+    checkers parse live in a source tree)."""
+    import tpuprof
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        tpuprof.__file__)))
+
+
+def cmd_lint(args: argparse.Namespace) -> int:
+    from tpuprof import analysis
+    from tpuprof.errors import LintFindingsError, exit_code
+    if args.lint_list:
+        for cid in analysis.checker_ids():
+            print(f"{cid}: {analysis.checker_doc(cid)}")
+        return 0
+    root = args.root or _default_lint_root()
+    only = [c.strip() for c in args.only.split(",")] if args.only \
+        else None
+    try:
+        report = analysis.run_lint(root, only=only,
+                                   suppressions=args.suppressions,
+                                   strict=args.strict)
+    except ValueError as exc:           # unknown checker id
+        print(f"tpuprof: error: {exc}", file=sys.stderr)
+        return 2
+    analysis.observe(report)
+    if args.lint_json:
+        with open(args.lint_json, "w") as fh:
+            fh.write(report.to_json())
+    unsuppressed = report.unsuppressed()
+    for f in unsuppressed:
+        print(f.format())
+    n_sup = len(report.suppressed)
+    if unsuppressed:
+        exc = LintFindingsError(
+            f"{len(unsuppressed)} finding(s) across "
+            f"{len(report.counts_by_checker())} checker(s)"
+            + (f" ({n_sup} suppressed)" if n_sup else ""))
+        print(f"tpuprof lint: {exc}", file=sys.stderr)
+        return exit_code(exc)
+    print(f"tpuprof lint: clean — {len(report.checkers_run)} checkers"
+          + (f", {n_sup} suppressed finding(s)" if n_sup else "")
+          + f" in {report.wall_s:.2f}s", file=sys.stderr)
     return 0
 
 
@@ -992,6 +1070,7 @@ def cmd_profile(args: argparse.Namespace) -> int:
             else None,
             metrics_path=args.metrics_json,
             metrics_interval=args.metrics_interval,
+            metrics_max_bytes=args.metrics_max_bytes,
             artifact_path=args.artifact,
             compile_cache_dir=cache_dir)
     except ValueError as exc:
@@ -1102,6 +1181,8 @@ def main(argv=None) -> int:
         return cmd_submit(args)
     if args.command == "diff":
         return cmd_diff(args)
+    if args.command == "lint":
+        return cmd_lint(args)
     raise AssertionError(args.command)
 
 

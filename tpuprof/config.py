@@ -165,6 +165,19 @@ def resolve_retry_backoff(value: Optional[float] = None) -> float:
     return max(env, 0.0) if env is not None else 0.05
 
 
+def resolve_quarantine_log(value: Optional[str] = None) -> Optional[str]:
+    """Quarantined-batch JSONL side log (``quarantine_log`` —
+    ROBUSTNESS.md): explicit config value, else
+    ``TPUPROF_QUARANTINE_LOG``, else None = no side log (the manifest
+    still rides checkpoints/stats either way).  The env twin closes
+    the last ladder knob that had none (ISSUE 12 config-surface
+    finding): a wrapper can now capture skip records without touching
+    the command line."""
+    if value:
+        return str(value)
+    return os.environ.get("TPUPROF_QUARANTINE_LOG") or None
+
+
 def resolve_max_quarantined(value: Optional[int] = None) -> int:
     """Poison-batch quarantine budget: an explicit config value wins;
     else ``TPUPROF_MAX_QUARANTINED``; else 0 — the historical fail-fast

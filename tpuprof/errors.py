@@ -55,6 +55,11 @@ The network serving plane (tpuprof/serve/http.py) adds one more:
   ran and failed" (the job's own exit code): automation retrying on
   a down edge must be able to branch on THIS without parsing prose;
   the CLI maps it to exit code 9.
+
+The static-analysis suite (tpuprof/analysis — ANALYSIS.md) adds:
+
+* ``LintFindingsError`` (InputError) — `tpuprof lint` found
+  unsuppressed invariant violations; shares InputError's exit code 2.
 """
 
 from typing import Any, Dict, List, Optional
@@ -130,6 +135,15 @@ class ServeUnavailableError(OSError):
     same or another edge; the CLI maps it to exit code 9."""
 
 
+class LintFindingsError(InputError):
+    """`tpuprof lint` found unsuppressed invariant violations
+    (tpuprof/analysis; ANALYSIS.md).  Subclasses :class:`InputError`
+    and shares its exit code 2 — "the tree you asked us to bless is
+    not blessable" is an input problem, the same convention argparse
+    and config validation already use — so CI gates on exit 2 without
+    a new branch."""
+
+
 class WatchdogTimeout(TimeoutError):
     """A watched blocking call overran its deadline."""
 
@@ -148,7 +162,7 @@ class WatchdogTimeout(TimeoutError):
 # shapes": one-line message + distinct exit code, no traceback
 TYPED_ERRORS = (InputError, CorruptCheckpointError, CorruptArtifactError,
                 CorruptManifestError, PoisonBatchError, WatchdogTimeout,
-                HostDeathError, ServeUnavailableError)
+                HostDeathError, ServeUnavailableError, LintFindingsError)
 
 _EXIT_CODES = (
     # order matters: InputError, CorruptCheckpointError,

@@ -34,10 +34,17 @@ def _atomic_text_write(path: str, text: str) -> None:
     member's own liveness snapshot) can transiently elect two leaders,
     and two plain open(path, 'w') writers would interleave into a torn
     prom dump."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # dot-prefixed basename + pid (ISSUE 12 durability invariant): two
+    # transiently-elected leaders need distinct temps, and no directory
+    # scan may ever see the in-flight write.  fsync before the rename —
+    # os.replace is atomic in the namespace, not for data pages
+    tmp = os.path.join(os.path.dirname(path) or ".",
+                       f".{os.path.basename(path)}.tmp.{os.getpid()}")
     try:
         with open(tmp, "w") as fh:
             fh.write(text)
+            fh.flush()
+            os.fsync(fh.fileno())
         os.replace(tmp, path)
     except OSError:
         try:
@@ -105,8 +112,10 @@ def write_fleet(metrics_path: Optional[str],
         return None
     path = fleet_prom_path(metrics_path)
     try:
-        with open(path, "w") as fh:
-            fh.write(merged.render_text())
+        # same atomic seam as write_fleet_labeled: a reader (scraper,
+        # test) racing the collect-finish dump must see the previous
+        # complete file or the new one, never interleaved text
+        _atomic_text_write(path, merged.render_text())
     except OSError:
         return None         # the fleet dump must never fail the profile
     return path

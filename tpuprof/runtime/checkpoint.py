@@ -166,7 +166,13 @@ def save(path: str, state: Any, host_blob: Any, cursor: int,
         "meta": meta,
     }
     payload_bytes = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
-    tmp = path + ".tmp"
+    # dot-prefixed basename (ISSUE 12 durability invariant): a temp
+    # named as a SUFFIX of the real path shares its prefix, and any
+    # prefix/rotation scan would see the in-flight write.  No pid in
+    # the name: one writer per checkpoint path by contract, and a
+    # crashed save's litter is then reclaimed by the next save.
+    tmp = os.path.join(os.path.dirname(path) or ".",
+                       f".{os.path.basename(path)}.tmp")
     try:
         with open(tmp, "wb") as fh:
             faults.hit("checkpoint_write", key=int(cursor))

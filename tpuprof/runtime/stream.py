@@ -181,11 +181,12 @@ class StreamingProfiler:
         from tpuprof.config import (resolve_checkpoint_keep,
                                     resolve_ingest_retries,
                                     resolve_max_quarantined,
+                                    resolve_quarantine_log,
                                     resolve_retry_backoff,
                                     resolve_watchdog_timeout)
         self._quarantine = _guard.Quarantine(
             resolve_max_quarantined(self.config.max_quarantined),
-            log_path=self.config.quarantine_log)
+            log_path=resolve_quarantine_log(self.config.quarantine_log))
         self._batch_guard = _guard.BatchGuard(
             resolve_ingest_retries(self.config.ingest_retries),
             resolve_retry_backoff(self.config.retry_backoff_s),

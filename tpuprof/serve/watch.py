@@ -108,7 +108,11 @@ def source_key(source: Any) -> str:
 
 
 def _atomic_write(path: str, data: bytes) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # dot-prefixed temp (ISSUE 12 durability invariant): the watch dir
+    # is scanned (_CYCLE_RE chain walk, .part stray sweep) and a
+    # suffix-named temp would share the scanned prefix
+    tmp = os.path.join(os.path.dirname(path) or ".",
+                       f".{os.path.basename(path)}.tmp.{os.getpid()}")
     try:
         with open(tmp, "wb") as fh:
             fh.write(data)

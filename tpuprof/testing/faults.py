@@ -79,6 +79,25 @@ from tpuprof.errors import HostDeathError, TransientError
 _ENV_SPEC = "TPUPROF_FAULTS"
 _ENV_SEED = "TPUPROF_FAULTS_SEED"
 
+#: the central site registry (ISSUE 12): every site-string literal the
+#: runtime hands to :func:`hit`/:func:`mangle` — or names in a
+#: ``site=`` keyword on the guard/watchdog/quarantine seams — MUST be
+#: declared here, and every declared site must stay in use.  Enforced
+#: by `tpuprof lint` (the ``runtime-discipline`` checker), so the
+#: docstring table above and the ``TPUPROF_FAULTS`` grammar's users
+#: can trust this set is the whole injectable/observable surface.
+SITES = frozenset({
+    # ingest / fold (retry + quarantine rungs)
+    "prep", "fold",
+    # durable writes (truncation-capable byte sites)
+    "checkpoint_write", "artifact_write",
+    # watchdogs (guard.watched / Deadline)
+    "device_wait", "device_drain", "resume_barrier", "barrier",
+    "fleet_publish", "fleet_finish",
+    # fleet / serve lifecycles
+    "host_death", "serve_job", "watch_cycle",
+})
+
 
 class _Rule:
     """One site's injection rule (parsed from a ``site:mode`` pair)."""
