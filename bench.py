@@ -305,6 +305,21 @@ def _measure_watch() -> dict:
         return measure_watch(1 << 13 if _SMOKE else 1 << 14, td)
 
 
+def _measure_warehouse() -> dict:
+    """Profile-warehouse envelope (ISSUE 13): columnar write cost,
+    column-pruned read vs full-JSON read at a wide shape, and the
+    history-query latency over a 50-generation chain — the `warehouse`
+    scenario (benchmarks/run.py) tracks the full methodology; these
+    keys put a columnar-IO regression in the headline BENCH line."""
+    import sys
+    import tempfile
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.run import measure_warehouse
+    with tempfile.TemporaryDirectory() as td:
+        return measure_warehouse(1 << 11, td,
+                                 cols=200 if _SMOKE else 400)
+
+
 def _measure_guardrail() -> dict:
     """Clean-path cost of the fault-tolerance plumbing (ISSUE 4): the
     retry-guard wrapper on the serial prepare loop, A/B'd in the same
@@ -339,6 +354,7 @@ def main() -> None:
     rebalance = _measure_rebalance()      # elastic scheduler envelope
     serve = _measure_serve()              # warm-mesh daemon envelope
     watch = _measure_watch()              # continuous-drift watch loop
+    wh = _measure_warehouse()             # columnar warehouse IO
     render_s = _measure_render()          # host-only, before the device
 
     devices = jax.devices()[:1]           # single-chip measurement
@@ -458,6 +474,14 @@ def main() -> None:
         # alert-on-disk latency (the leg FAILS if no alert fires)
         "watch_cycle_s": watch["watch_cycle_s"],
         "watch_alert_latency_s": watch["watch_alert_latency_s"],
+        # profile warehouse (ISSUE 13): columnar append cost, the
+        # column-pruned-read-vs-full-JSON win at a wide shape (must
+        # stay > 1x — the leg fails otherwise), and a history stat
+        # query over a 50-generation chain
+        "warehouse_write_s": wh["warehouse_write_s"],
+        "warehouse_pruned_read_speedup":
+            wh["warehouse_pruned_read_speedup"],
+        "history_query_s": wh["history_query_s"],
         "device_mem_in_use_bytes": int(device_mem_in_use),
         # per-stage breakdown (obs spans; NEW keys only — existing keys
         # above keep their names so BENCH_r* comparisons stay valid)

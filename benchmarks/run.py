@@ -1269,6 +1269,127 @@ def run_watch(scale: float, workdir: str) -> dict:
     return out
 
 
+def measure_warehouse(rows: int, workdir: str, cols: int = 400,
+                      gens: int = 50) -> dict:
+    """Profile-warehouse envelope (ISSUE 13) at a WIDE shape:
+
+    * ``warehouse_write_s`` — one columnar generation append (Parquet
+      encode + fsync + rename) for a ``cols``-column profile;
+    * ``warehouse_pruned_read_speedup`` — answering "one stat of one
+      column" from the columnar file (column-pruned read) vs from the
+      full JSON artifact (whole-document parse) — the 10k-column win
+      at bench scale; the leg FAILS if pruning is not faster;
+    * ``history_query_s`` — a `tpuprof history` stat query over a
+      ``gens``-generation chain (the acceptance fixture's shape);
+      the leg FAILS if the answer is wrong.
+
+    The profile itself is fixture prep (cpu oracle — the tracked
+    signals are columnar IO, not scan throughput)."""
+    import statistics
+    import tempfile
+
+    import pandas as pd
+
+    from tpuprof import ProfileReport, ProfilerConfig
+    from tpuprof import warehouse as wh
+    from tpuprof.artifact import read_artifact, write_artifact
+
+    rng = np.random.default_rng(0)
+    data = {f"c{i:04d}": rng.normal(i, 1.0 + i % 7, rows)
+            for i in range(cols - 1)}
+    data["cat"] = rng.choice(["x", "y", "z"], rows)
+    report = ProfileReport(pd.DataFrame(data), backend="cpu")
+
+    def _median(fn, n=5):
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times)
+
+    with tempfile.TemporaryDirectory(dir=workdir) as td:
+        art_path = os.path.join(td, "wide.artifact.json")
+        write_artifact(art_path, stats=report.description,
+                       config=ProfilerConfig(), source="wide")
+        art = read_artifact(art_path)
+        probe_col = "c0007"
+        truth = art.stats["variables"][probe_col]["mean"]
+
+        pq_path = os.path.join(td, "wide.stats.parquet")
+
+        def _write():
+            wh.write_stats_parquet(
+                pq_path, art.stats, art.sketches, source="wide",
+                generation=1, rows=art.rows,
+                artifact_crc32=art.crc32)
+        write_s = _median(_write, n=3)
+
+        def _json_read():
+            a = read_artifact(art_path)
+            return a.stats["variables"][probe_col]["mean"]
+
+        def _pruned_read():
+            g = wh.read_stats_parquet(pq_path, columns=[probe_col],
+                                      stats=["mean"])
+            return g.stats[probe_col]["mean"]
+
+        if _pruned_read() != truth or _json_read() != truth:
+            raise RuntimeError("warehouse leg: columnar/JSON answers "
+                               "disagree — round-trip broken")
+        json_read_s = _median(_json_read)
+        pruned_read_s = _median(_pruned_read)
+        speedup = json_read_s / pruned_read_s
+        if speedup <= 1.0:
+            raise RuntimeError(
+                f"warehouse leg: column-pruned read ({pruned_read_s:.4f}s) "
+                f"is not faster than the full-JSON read "
+                f"({json_read_s:.4f}s) at {cols} columns — the "
+                "warehouse's reason to exist regressed")
+
+        chain_dir = os.path.join(td, "chain")
+        for g in range(1, gens + 1):
+            wh.append_generation(chain_dir, "wide", art.stats,
+                                 art.sketches, generation=g,
+                                 rows=art.rows)
+        src_dir = wh.source_dir(chain_dir, "wide")
+
+        def _history():
+            return wh.query_stat(src_dir, probe_col, "mean")
+        doc = _history()
+        if doc["generations"] != gens or \
+                any(e["value"] != truth for e in doc["series"]):
+            raise RuntimeError("warehouse leg: history query answered "
+                               "wrong over the generation chain")
+        history_s = _median(_history, n=3)
+        file_bytes = os.path.getsize(pq_path)
+
+    return {
+        "rows": rows,
+        "warehouse_cols": cols,
+        "warehouse_generations": gens,
+        "warehouse_write_s": round(write_s, 4),
+        "warehouse_bytes": file_bytes,
+        "warehouse_json_read_s": round(json_read_s, 4),
+        "warehouse_pruned_read_s": round(pruned_read_s, 4),
+        "warehouse_pruned_read_speedup": round(speedup, 2),
+        "history_query_s": round(history_s, 4),
+        # the differ's generic higher-is-better key: stat cells
+        # answered per second by the history query over the chain
+        "rows_per_sec": round(gens / history_s, 1),
+    }
+
+
+def run_warehouse(scale: float, workdir: str) -> dict:
+    # the wide shape is the point (column pruning); rows only size the
+    # fixture-prep profile
+    os.makedirs(workdir, exist_ok=True)
+    rows = max(int(200_000 * scale), 2000)
+    out = measure_warehouse(rows, workdir)
+    out["scenario"] = "warehouse"
+    return out
+
+
 LINT_WALL_TARGET_S = 5.0
 
 
@@ -1323,7 +1444,7 @@ def run_serve(scale: float, workdir: str) -> dict:
 REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
                         "hostfed", "prepare", "passb", "faults", "drift",
                         "rebalance", "serve", "watch", "serve_http",
-                        "lint")
+                        "warehouse", "lint")
 
 
 def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
@@ -1522,9 +1643,19 @@ def run_regression(scale: float, workdir: str,
             notes = (f"{r['serve_http_rps']} req/s, "
                      f"p99 {r['serve_http_p99_s']}s, "
                      f"lost {r['serve_http_killed_lost']}")
+        if "warehouse_pruned_read_speedup" in r:
+            notes = (f"write {r['warehouse_write_s']}s, pruned "
+                     f"{r['warehouse_pruned_read_speedup']}x, history "
+                     f"{r['history_query_s']}s")
+        if "lint_wall_s" in r:
+            notes = f"wall {r['lint_wall_s']}s"
         rate = r.get("rows_per_sec",
                      r.get("prepare_rows_per_sec", float("nan")))
-        print(f"| {r['scenario']} | {r.get('rows', '—'):,} | "
+        rows = r.get("rows")
+        # rows-less legs (lint) print a dash — a string can't take the
+        # thousands format that crashed the r15 table
+        rows_s = f"{rows:,}" if isinstance(rows, (int, float)) else "—"
+        print(f"| {r['scenario']} | {rows_s} | "
               f"{rate:,.0f} | {notes} |")
     _print_deltas(results, base_label, base_results)
     print(f"\nwritten: {out_path}")
@@ -1538,7 +1669,8 @@ def main() -> None:
                                              "passb", "faults", "drift",
                                              "rebalance", "wideexact",
                                              "serve", "watch",
-                                             "serve_http", "lint",
+                                             "serve_http", "warehouse",
+                                             "lint",
                                              "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
@@ -1575,7 +1707,8 @@ def main() -> None:
 
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
               "prepare", "passb", "faults", "drift", "rebalance",
-              "wideexact", "serve", "watch", "serve_http", "lint"]
+              "wideexact", "serve", "watch", "serve_http", "warehouse",
+              "lint"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -1604,6 +1737,8 @@ def main() -> None:
             result = run_watch(args.scale, args.workdir)
         elif name == "serve_http":
             result = run_serve_http(args.scale, args.workdir)
+        elif name == "warehouse":
+            result = run_warehouse(args.scale, args.workdir)
         elif name == "lint":
             result = run_lint_leg(args.scale, args.workdir)
         else:

@@ -441,6 +441,74 @@ class TestJobTimeoutRoundTrip:
         assert ProfilerConfig(watch_every_s=0).watch_every_s == 0
 
 
+class TestWarehouseConfigRoundTrip:
+    """`warehouse_dir` / `warehouse_format` resolve identically from
+    env, CLI and config (ISSUE 13 satellite — the standard three-way
+    round-trip)."""
+
+    def test_dir_env_cli_config_resolve_identically(self, monkeypatch):
+        from tpuprof.cli import build_parser
+        from tpuprof.config import resolve_warehouse_dir
+        monkeypatch.delenv("TPUPROF_WAREHOUSE_DIR", raising=False)
+        via_config = resolve_warehouse_dir(
+            ProfilerConfig(warehouse_dir="/wh").warehouse_dir)
+        args = build_parser().parse_args(
+            ["profile", "t.parquet", "--warehouse-dir", "/wh"])
+        via_cli = resolve_warehouse_dir(args.warehouse_dir)
+        monkeypatch.setenv("TPUPROF_WAREHOUSE_DIR", "/wh")
+        via_env = resolve_warehouse_dir(None)
+        assert via_config == via_cli == via_env == "/wh"
+        assert resolve_warehouse_dir("/other") == "/other"
+        monkeypatch.delenv("TPUPROF_WAREHOUSE_DIR")
+        # default: no columnar twin for one-shot profiles
+        assert resolve_warehouse_dir(None) is None
+
+    def test_format_env_cli_config_resolve_identically(self,
+                                                       monkeypatch):
+        from tpuprof.cli import build_parser
+        from tpuprof.config import resolve_warehouse_format
+        monkeypatch.delenv("TPUPROF_WAREHOUSE_FORMAT", raising=False)
+        via_config = resolve_warehouse_format(
+            ProfilerConfig(warehouse_format="off").warehouse_format)
+        args = build_parser().parse_args(
+            ["watch", "spool", "s", "--warehouse-format", "off"])
+        via_cli = resolve_warehouse_format(args.warehouse_format)
+        monkeypatch.setenv("TPUPROF_WAREHOUSE_FORMAT", "off")
+        via_env = resolve_warehouse_format(None)
+        assert via_config == via_cli == via_env == "off"
+        # explicit value beats the env twin
+        assert resolve_warehouse_format("parquet") == "parquet"
+        monkeypatch.delenv("TPUPROF_WAREHOUSE_FORMAT")
+        assert resolve_warehouse_format(None) == "parquet"  # default
+
+    def test_validation(self, monkeypatch):
+        with pytest.raises(ValueError, match="warehouse_format"):
+            ProfilerConfig(warehouse_format="orc")
+        monkeypatch.setenv("TPUPROF_WAREHOUSE_FORMAT", "orc")
+        from tpuprof.config import resolve_warehouse_format
+        with pytest.raises(ValueError, match="TPUPROF_WAREHOUSE_FORMAT"):
+            resolve_warehouse_format(None)
+        # argparse rejects unknown formats before config ever sees them
+        from tpuprof.cli import build_parser
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(
+                ["profile", "t.parquet", "--warehouse-format", "orc"])
+
+    def test_history_backtest_parsers(self):
+        from tpuprof.cli import build_parser
+        args = build_parser().parse_args(
+            ["history", "src.parquet", "--spool", "sp", "--col",
+             "price", "--stat", "p95", "--json"])
+        assert (args.col, args.stat, args.as_json) == \
+            ("price", "p95", True)
+        assert args.trend is False
+        args = build_parser().parse_args(
+            ["backtest", "src.parquet", "--spool", "sp",
+             "--psi-threshold", "0.1"])
+        assert args.psi_threshold == 0.1
+        assert args.ks_threshold is None
+
+
 SNAPSHOT_NUM_FIELDS = sorted(schema.NUM_FIELDS)
 
 

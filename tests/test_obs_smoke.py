@@ -148,6 +148,27 @@ EVENT_SCHEMA = {
                     "error": ((str,), False),
                     "columns": ((list,), False),
                     "exit_code": ((int,), False)},
+    # profile warehouse (tpuprof/warehouse, ISSUE 13): one per columnar
+    # generation appended (the watch cycle path and one-shot
+    # --artifact + --warehouse-dir), one per history query answered
+    # (CLI or GET /v1/history/<key>), one per alert backtest replayed
+    "warehouse_write": {"ts": ((int, float), True),
+                        "path": ((str,), True),
+                        "source": ((str, type(None)), False),
+                        "generation": ((int,), True),
+                        "columns": ((int,), True),
+                        "bytes": ((int,), True),
+                        "seconds": ((int, float), True)},
+    "history_query": {"ts": ((int, float), True),
+                      "kind": ((str,), True),
+                      "warehouse": ((str,), True),
+                      "generations": ((int,), True),
+                      "seconds": ((int, float), True)},
+    "backtest": {"ts": ((int, float), True),
+                 "chain": ((str,), True),
+                 "cycles": ((int,), True),
+                 "alerts": ((int,), True),
+                 "seconds": ((int, float), True)},
 }
 
 

@@ -1,0 +1,711 @@
+"""Profile warehouse (tpuprof/warehouse — ISSUE 13): columnar
+round-trip golden (ulp-identical to the JSON artifact), Parquet
+corruption sweeps (typed, never a raw pyarrow traceback), the lazy
+pyarrow gate (typed exit 10, JSON path unaffected), history/trend
+queries over a 50-generation chain (corrupt-generation walk included),
+live-watch-vs-backtest alert-set equivalence, the CLI surfaces, and
+the HTTP history route."""
+
+import json
+import math
+import os
+import shutil
+import struct
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpuprof import ProfileReport, ProfilerConfig
+from tpuprof import warehouse as wh
+from tpuprof.artifact import read_artifact, write_artifact
+from tpuprof.cli import main
+from tpuprof.errors import (CorruptArtifactError, CorruptWarehouseError,
+                            InputError, WarehouseUnavailableError,
+                            exit_code)
+
+pytestmark = pytest.mark.warehouse
+
+
+def _num(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _bits(x: float) -> int:
+    return struct.unpack("<Q", struct.pack("<d", float(x)))[0]
+
+
+# ---------------------------------------------------------------------------
+# golden fixture: one cpu profile, artifact + columnar twin
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def golden(tmp_path_factory):
+    td = tmp_path_factory.mktemp("wh_golden")
+    rng = np.random.default_rng(7)
+    n = 800
+    df = pd.DataFrame({
+        "price": rng.gamma(2.0, 7.5, n),
+        "qty": rng.integers(0, 9, n).astype(np.int64),
+        "cat": rng.choice(["a", "b", "c"], n),
+        "flag": rng.random(n) < 0.3,
+        "const": 1.0,
+    })
+    df.loc[::17, "price"] = np.nan
+    report = ProfileReport(df, backend="cpu")
+    art_path = str(td / "golden.artifact.json")
+    write_artifact(art_path, stats=report.description,
+                   config=ProfilerConfig(), source="golden")
+    art = read_artifact(art_path)
+    pq_path = str(td / "golden.stats.parquet")
+    wh.write_stats_parquet(
+        pq_path, art.stats, art.sketches, source="golden", generation=1,
+        rows=art.rows,
+        config_fingerprint=(art.meta.get("config") or {}).get(
+            "fingerprint"),
+        artifact_crc32=art.crc32)
+    return {"artifact": art, "parquet": pq_path, "dir": str(td)}
+
+
+class TestColumnarRoundTrip:
+    def test_every_numeric_stat_ulp_identical(self, golden):
+        """Acceptance: the Parquet values are the JSON artifact's
+        `variables` numbers bit-for-bit — a Parquet consumer and a
+        JSON consumer can never disagree."""
+        art = golden["artifact"]
+        g = wh.read_stats_parquet(golden["parquet"])
+        assert g.columns == list(art.stats["variables"].keys())
+        checked = 0
+        for name, var in art.stats["variables"].items():
+            row = g.stats[name]
+            for key, val in var.items():
+                if not _num(val):
+                    continue
+                got = row[key]
+                if isinstance(val, float):
+                    assert _bits(got) == _bits(val), (name, key)
+                elif isinstance(got, int):
+                    assert got == val, (name, key)
+                else:
+                    # an int value in a stat column typed float64
+                    # (mixed int/float across columns — e.g. `mode`):
+                    # exact as long as it fits the 53-bit mantissa
+                    assert got == val and _bits(got) == _bits(float(val)), \
+                        (name, key)
+                checked += 1
+        assert checked > 40      # the golden df exercises a real spread
+
+    def test_histogram_sketches_ride_along(self, golden):
+        art = golden["artifact"]
+        g = wh.read_stats_parquet(golden["parquet"])
+        hists = art.sketches["histograms"]
+        for name, h in hists.items():
+            assert g.stats[name]["hist_counts"] == h["counts"]
+            assert g.stats[name]["hist_edges"] == h["edges"]
+        # a column with no histogram stores null, not an empty list
+        no_hist = set(g.columns) - set(hists)
+        for name in no_hist:
+            assert g.stats[name]["hist_counts"] is None
+
+    def test_column_pruned_read(self, golden):
+        g = wh.read_stats_parquet(golden["parquet"],
+                                  columns=["price"], stats=["mean"])
+        assert g.columns == ["price"]
+        assert set(g.stats) == {"price"}
+        # ONLY the requested stat column materialized
+        assert set(g.stats["price"]) == {"mean"}
+        full = wh.read_stats_parquet(golden["parquet"])
+        assert g.stats["price"]["mean"] == full.stats["price"]["mean"]
+
+    def test_pruned_read_unknown_stat_is_absent_not_fatal(self, golden):
+        g = wh.read_stats_parquet(golden["parquet"],
+                                  stats=["no_such_stat"])
+        assert all(set(v) == set() for v in g.stats.values())
+
+    def test_metadata_provenance(self, golden):
+        art = golden["artifact"]
+        g = wh.read_stats_parquet(golden["parquet"])
+        assert g.meta["schema"] == wh.STATS_PARQUET_SCHEMA
+        assert g.generation == 1
+        assert g.meta["rows"] == art.rows
+        assert g.meta["artifact_crc32"] == art.crc32
+        assert g.meta["config_fingerprint"] == \
+            (art.meta.get("config") or {}).get("fingerprint")
+
+    def test_int_stats_stay_int(self, golden):
+        g = wh.read_stats_parquet(golden["parquet"])
+        assert isinstance(g.stats["qty"]["count"], int)
+        assert isinstance(g.stats["qty"]["n_missing"], int)
+
+
+# ---------------------------------------------------------------------------
+# corruption: typed, never a raw pyarrow traceback
+# ---------------------------------------------------------------------------
+
+class TestCorruption:
+    def test_truncation_at_every_offset_is_typed(self, golden,
+                                                 tmp_path):
+        with open(golden["parquet"], "rb") as fh:
+            data = fh.read()
+        victim = str(tmp_path / "torn.stats.parquet")
+        step = max(1, len(data) // 97)   # every offset for small files,
+        offsets = list(range(0, len(data), step))   # dense sweep always
+        offsets += [len(data) - 1, len(data) - 4, 4]
+        for cut in sorted(set(o for o in offsets if 0 <= o < len(data))):
+            with open(victim, "wb") as fh:
+                fh.write(data[:cut])
+            with pytest.raises(CorruptWarehouseError):
+                wh.read_stats_parquet(victim)
+
+    def test_bit_flip_in_footer_is_typed(self, golden, tmp_path):
+        with open(golden["parquet"], "rb") as fh:
+            data = bytearray(fh.read())
+        data[-5] ^= 0xFF                 # inside the footer length/magic
+        victim = str(tmp_path / "flipped.stats.parquet")
+        with open(victim, "wb") as fh:
+            fh.write(bytes(data))
+        with pytest.raises(CorruptWarehouseError):
+            wh.read_stats_parquet(victim)
+
+    def test_junk_is_typed(self, tmp_path):
+        victim = str(tmp_path / "junk.stats.parquet")
+        with open(victim, "wb") as fh:
+            fh.write(b"definitely not parquet" * 10)
+        with pytest.raises(CorruptWarehouseError):
+            wh.read_stats_parquet(victim)
+
+    def test_foreign_parquet_rejected(self, tmp_path):
+        """A valid Parquet file WITHOUT the tpuprof schema metadata is
+        a foreign product, not a warehouse generation."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        victim = str(tmp_path / "foreign.stats.parquet")
+        pq.write_table(pa.table({"x": [1, 2, 3]}), victim)
+        with pytest.raises(CorruptWarehouseError, match="schema"):
+            wh.read_stats_parquet(victim)
+
+    def test_missing_file_is_file_not_found(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            wh.read_stats_parquet(str(tmp_path / "never_written"))
+
+    def test_corrupt_shares_artifact_exit_code(self):
+        exc = CorruptWarehouseError("x")
+        assert isinstance(exc, CorruptArtifactError)
+        assert exit_code(exc) == 6
+
+
+# ---------------------------------------------------------------------------
+# the pyarrow gate (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def no_pyarrow(monkeypatch):
+    """Make `import pyarrow` fail inside the gate: None in sys.modules
+    raises ImportError on re-import, exactly like an uninstalled dep."""
+    monkeypatch.setitem(sys.modules, "pyarrow", None)
+    monkeypatch.delitem(sys.modules, "pyarrow.parquet", raising=False)
+
+
+class TestPyarrowGate:
+    def test_write_raises_typed_with_exit_10(self, no_pyarrow,
+                                             tmp_path):
+        with pytest.raises(WarehouseUnavailableError,
+                           match="pyarrow") as ei:
+            wh.write_stats_parquet(str(tmp_path / "g.parquet"),
+                                   {"variables": {}})
+        assert exit_code(ei.value) == 10
+        assert "warehouse_format=off" in str(ei.value)
+        assert not os.listdir(tmp_path)   # nothing half-written
+
+    def test_read_raises_typed(self, no_pyarrow, golden):
+        with pytest.raises(WarehouseUnavailableError):
+            wh.read_stats_parquet(golden["parquet"])
+
+    def test_json_artifact_path_unaffected(self, no_pyarrow, tmp_path,
+                                           taxi_like_df):
+        """The satellite's core promise: no pyarrow still profiles,
+        exports and reads JSON artifacts exactly as before."""
+        report = ProfileReport(taxi_like_df.head(300), backend="cpu")
+        path = str(tmp_path / "a.json")
+        write_artifact(path, stats=report.description,
+                       config=ProfilerConfig(), source="t")
+        assert read_artifact(path).rows == 300
+
+    def test_watch_degrades_to_off_without_failing(self, no_pyarrow,
+                                                   golden, tmp_path):
+        """A watch daemon on a pyarrow-less box keeps cycling: the
+        first append disables the warehouse, loudly, and never raises
+        into the cycle."""
+        from tpuprof.serve import DriftWatcher
+        spool = str(tmp_path / "spool")
+        w = DriftWatcher(spool, ["src.parquet"], scheduler=object(),
+                         every_s=0, keep=2)
+        assert w.warehouse_dir is not None
+        w._warehouse_append(w.watches[0], golden["artifact"], 1)
+        assert w.warehouse_dir is None      # degraded to off
+        # and the warehouse dir gained nothing
+        assert not os.path.isdir(os.path.join(spool, "warehouse",
+                                              w.watches[0].key))
+
+    def test_watch_format_off_disables(self, tmp_path):
+        from tpuprof.serve import DriftWatcher
+        w = DriftWatcher(str(tmp_path / "spool"), ["s"],
+                         scheduler=object(), every_s=0,
+                         warehouse_format="off")
+        assert w.warehouse_dir is None
+
+
+# ---------------------------------------------------------------------------
+# the 50-generation chain fixture (ISSUE 13 satellite): shared by
+# history / trend / backtest
+# ---------------------------------------------------------------------------
+
+N_GENS = 50
+JUMP_AT = 25            # generation where column "a" jumps +3 sigma
+STEP = 0.02             # per-generation creep on "a", in sigma
+
+
+def _gen_frame(g: int, n: int = 240) -> pd.DataFrame:
+    """Deterministic base data + a per-generation shift on column
+    ``a``: tiny creep each generation plus one hard +3σ jump at
+    JUMP_AT, so default thresholds alert exactly once while a
+    tightened PSI threshold alerts on the creep too."""
+    rng = np.random.default_rng(11)          # SAME base every gen
+    base = rng.normal(0.0, 1.0, n)
+    shift = STEP * g + (3.0 if g >= JUMP_AT else 0.0)
+    return pd.DataFrame({
+        "a": base * 2.0 + 10.0 + shift * 2.0,   # sigma = 2
+        "b": rng.exponential(1.0, n),
+        "c": rng.choice(["x", "y", "z"], n),
+    })
+
+
+@pytest.fixture(scope="module")
+def chain50(tmp_path_factory):
+    """50 retained generations of one drifting source, as BOTH chains:
+    the JSON artifact chain (watch layout — the backtest substrate)
+    and the columnar warehouse (the history/trend substrate)."""
+    td = tmp_path_factory.mktemp("wh_chain50")
+    spool = str(td / "spool")
+    source = str(td / "drifting.parquet")
+    from tpuprof.serve.watch import source_key
+    key = source_key(source)
+    watch_dir = os.path.join(spool, "watch", key)
+    os.makedirs(watch_dir, exist_ok=True)
+    whroot = os.path.join(spool, "warehouse")
+    cfg = ProfilerConfig()
+    means = {}
+    for g in range(1, N_GENS + 1):
+        report = ProfileReport(_gen_frame(g), backend="cpu")
+        art_path = os.path.join(watch_dir,
+                                f"cycle_{g:08d}.artifact.json")
+        write_artifact(art_path, stats=report.description, config=cfg,
+                       source=source)
+        art = read_artifact(art_path)
+        wh.append_artifact(whroot, art, source=source, generation=g)
+        means[g] = art.stats["variables"]["a"]["mean"]
+    return {"spool": spool, "source": source, "key": key,
+            "watch_dir": watch_dir, "warehouse": whroot,
+            "dir": os.path.join(whroot, key), "means": means}
+
+
+class TestHistory:
+    def test_stat_series_over_50_generations(self, chain50):
+        doc = wh.query_stat(chain50["dir"], "a", "mean")
+        assert doc["schema"] == wh.HISTORY_SCHEMA
+        assert doc["generations"] == N_GENS
+        assert doc["skipped_corrupt"] == []
+        gens = [e["generation"] for e in doc["series"]]
+        assert gens == list(range(1, N_GENS + 1))
+        for e in doc["series"]:
+            assert e["value"] == chain50["means"][e["generation"]]
+        # the series actually shows the story: creep + jump
+        vals = [e["value"] for e in doc["series"]]
+        assert vals[JUMP_AT - 1] - vals[JUMP_AT - 2] > 5.0
+        assert all(b > a for a, b in zip(vals, vals[1:]))
+
+    def test_any_stat_column_answers(self, chain50):
+        doc = wh.query_stat(chain50["dir"], "b", "p_missing")
+        assert all(e["value"] == 0.0 for e in doc["series"])
+        doc = wh.query_stat(chain50["dir"], "c", "distinct_count")
+        assert all(e["value"] == 3 for e in doc["series"])
+
+    def test_unknown_column_yields_nulls(self, chain50):
+        doc = wh.query_stat(chain50["dir"], "nope", "mean")
+        assert all(e["value"] is None for e in doc["series"])
+
+    def test_trend_psi_spikes_at_the_jump(self, chain50):
+        doc = wh.query_trend(chain50["dir"], col="a")
+        assert doc["generations"] == N_GENS - 1
+        by_gen = {e["generation"]: e["columns"]["a"]
+                  for e in doc["series"]}
+        jump = by_gen[JUMP_AT]
+        steady = [m["psi"] for g, m in by_gen.items()
+                  if g != JUMP_AT and m["psi"] is not None]
+        assert jump["psi"] > 1.0                 # a 3σ jump screams
+        assert jump["ks"] > 0.5
+        assert max(steady) < 0.1                 # creep whispers
+        # pairs are CONSECUTIVE generations
+        assert all(e["baseline_generation"] == e["generation"] - 1
+                   for e in doc["series"])
+
+    def test_corrupt_generation_walked_past(self, chain50, tmp_path):
+        victim_dir = str(tmp_path / "chain")
+        shutil.copytree(chain50["dir"], victim_dir)
+        victim = wh.generation_path(victim_dir, 30)
+        with open(victim, "rb") as fh:
+            data = fh.read()
+        with open(victim, "wb") as fh:
+            fh.write(data[: len(data) // 2])
+        doc = wh.query_stat(victim_dir, "a", "mean")
+        assert doc["generations"] == N_GENS - 1
+        assert doc["skipped_corrupt"] == [30]
+        assert 30 not in [e["generation"] for e in doc["series"]]
+        # trend: the broken pair re-anchors on the last readable gen
+        trend = wh.query_trend(victim_dir, col="a")
+        assert trend["skipped_corrupt"] == [30]
+        pairs = [(e["baseline_generation"], e["generation"])
+                 for e in trend["series"]]
+        assert (29, 31) in pairs
+        assert all(30 not in p for p in pairs)
+
+    def test_empty_warehouse_is_input_error(self, tmp_path):
+        d = str(tmp_path / "empty")
+        os.makedirs(d)
+        with pytest.raises(InputError):
+            wh.query_stat(d, "a", "mean")
+
+
+class TestBacktest:
+    def test_default_thresholds_alert_exactly_the_jump(self, chain50):
+        from tpuprof.artifact import DriftThresholds
+        doc = wh.backtest(chain50["watch_dir"], DriftThresholds())
+        assert doc["schema"] == wh.BACKTEST_SCHEMA
+        assert doc["summary"]["cycles"] == N_GENS
+        assert [a["cycle"] for a in doc["alerts"]] == [JUMP_AT]
+        assert doc["alerts"][0]["severity"] == "drift"
+        assert doc["alerts"][0]["columns"] == ["a"]
+
+    def test_tightened_threshold_changes_the_alert_set(self, chain50):
+        """The tentpole's reason to exist: replaying a changed PSI
+        threshold reports MORE alerting cycles than the live bands
+        did — and the episode dedup still compresses an unchanged
+        ongoing shape."""
+        from tpuprof.artifact import DriftThresholds
+        # the fixture's creep runs PSI ≈ 4e-4 per pair, the jump ≈ 14:
+        # a 5e-4 drift band puts the creep in the warn band, so the
+        # creep episode alerts once (cycle 2), the jump escalates
+        # (cycle 25), and the post-jump return to creep re-alerts
+        tight = DriftThresholds.from_cli(psi=0.0005)
+        doc = wh.backtest(chain50["watch_dir"], tight)
+        alerted = [a["cycle"] for a in doc["alerts"]]
+        assert JUMP_AT in alerted
+        assert len(alerted) > 1          # the creep now alerts too
+        # and a LOOSENED threshold still catches only the jump (via
+        # the non-PSI bands: 3σ mean shift)
+        loose = DriftThresholds.from_cli(psi=50.0, ks=50.0)
+        doc2 = wh.backtest(chain50["watch_dir"], loose)
+        assert [a["cycle"] for a in doc2["alerts"]] == [JUMP_AT]
+
+    def test_unreadable_cycle_is_reported(self, chain50, tmp_path):
+        from tpuprof.artifact import DriftThresholds
+        victim_dir = str(tmp_path / "chain")
+        shutil.copytree(chain50["watch_dir"], victim_dir)
+        victim = os.path.join(victim_dir, f"cycle_{10:08d}.artifact.json")
+        with open(victim, "wb") as fh:
+            fh.write(b"torn")
+        doc = wh.backtest(victim_dir, DriftThresholds())
+        assert doc["summary"]["unreadable"] == 1
+        rec = [c for c in doc["cycles"] if c["cycle"] == 10][0]
+        assert rec["status"] == "unreadable"
+        # the jump alert is unaffected
+        assert [a["cycle"] for a in doc["alerts"]] == [JUMP_AT]
+
+    def test_empty_chain_is_input_error(self, tmp_path):
+        from tpuprof.artifact import DriftThresholds
+        d = str(tmp_path / "empty")
+        os.makedirs(d)
+        with pytest.raises(InputError):
+            wh.backtest(d, DriftThresholds())
+
+
+# ---------------------------------------------------------------------------
+# live watch vs backtest: the exact-replay acceptance
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def live_run(tmp_path_factory):
+    """A REAL DriftWatcher (tpu engine through the scheduler, like
+    production) over 4 cycles with a mild 1σ shift at cycle 3: enough
+    signal to alert at default bands but NOT at raised PSI/KS bands —
+    the case where a threshold change genuinely changes the answer."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from tpuprof.serve import DriftWatcher, ProfileScheduler
+
+    td = tmp_path_factory.mktemp("wh_live")
+    src = str(td / "watched.parquet")
+
+    def publish(shift):
+        rng = np.random.default_rng(3)
+        n = 2000
+        df = pd.DataFrame({
+            "a": rng.normal(0, 1, n) * 2.0 + 10.0 + shift * 2.0,
+            "b": rng.exponential(1.0, n),
+            "c": rng.choice(["x", "y", "z"], n),
+        })
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                       src + ".new")
+        os.replace(src + ".new", src)
+
+    publish(0.0)
+    spool = str(td / "spool")
+    sched = ProfileScheduler(workers=1)
+    watcher = DriftWatcher(spool, [src], sched, every_s=0, keep=10,
+                           config_kwargs={"batch_rows": 1024})
+    w = watcher.watches[0]
+    statuses = [watcher.run_cycle(w)["status"]]
+    statuses.append(watcher.run_cycle(w)["status"])
+    publish(1.0)                       # the mild shift
+    statuses.append(watcher.run_cycle(w)["status"])
+    statuses.append(watcher.run_cycle(w)["status"])
+    sched.shutdown()
+    return {"spool": spool, "source": src, "watcher": watcher,
+            "watch": w, "statuses": statuses}
+
+
+class TestLiveVsBacktest:
+    def test_live_cycles_behaved(self, live_run):
+        s = live_run["statuses"]
+        assert s[0] == "ok" and s[3] == "ok"
+        assert s[2] == "drift"          # the shift cycle
+
+    def test_backtest_at_live_thresholds_reproduces_live_alerts(
+            self, live_run):
+        """Acceptance: replay at the thresholds the watch ran with ==
+        the alert set the watch raised, field for field."""
+        from tpuprof.artifact import DriftThresholds
+        live = [(a["cycle"], a["severity"], tuple(a["columns"]))
+                for a in live_run["watch"].alerts
+                if a["kind"] == "drift"]
+        doc = wh.backtest(
+            wh.chain_dir(live_run["spool"], live_run["source"]),
+            DriftThresholds())
+        replayed = [(a["cycle"], a["severity"], tuple(a["columns"]))
+                    for a in doc["alerts"]]
+        assert replayed == live and live   # non-empty AND identical
+
+    def test_changed_thresholds_change_the_answer(self, live_run):
+        from tpuprof.artifact import DriftThresholds
+        raised = DriftThresholds.from_cli(psi=20.0, ks=20.0)
+        doc = wh.backtest(
+            wh.chain_dir(live_run["spool"], live_run["source"]), raised)
+        live = [(a["cycle"], a["severity"])
+                for a in live_run["watch"].alerts
+                if a["kind"] == "drift"]
+        replayed = [(a["cycle"], a["severity"]) for a in doc["alerts"]]
+        assert replayed != live
+        # the 1σ mean shift still warns — raised PSI/KS demotes, not
+        # silences
+        assert all(sev == "warn" for _c, sev in replayed)
+
+    def test_watch_fed_the_warehouse(self, live_run):
+        """Every successful cycle appended a columnar generation that
+        agrees with its JSON artifact."""
+        d = wh.source_dir(os.path.join(live_run["spool"], "warehouse"),
+                          live_run["source"])
+        gens = wh.chain(d)
+        assert [g for g, _p in gens] == [1, 2, 3, 4]
+        doc = wh.query_stat(d, "a", "mean")
+        vals = [e["value"] for e in doc["series"]]
+        assert vals[0] == vals[1]
+        assert vals[2] == vals[3]
+        assert math.isclose(vals[2] - vals[0], 2.0, rel_tol=0.2)
+        # generation 4 agrees with the newest retained JSON artifact
+        art = read_artifact(live_run["watch"].last_artifact)
+        assert vals[3] == art.stats["variables"]["a"]["mean"]
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_history_human_and_json(self, chain50, capsys):
+        rc = main(["history", chain50["source"], "--spool",
+                   chain50["spool"], "--col", "a", "--stat", "mean"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "generation" in out
+        rc = main(["history", chain50["source"], "--spool",
+                   chain50["spool"], "--col", "a", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["schema"] == wh.HISTORY_SCHEMA
+        assert doc["generations"] == N_GENS
+
+    def test_history_trend_json(self, chain50, capsys):
+        rc = main(["history", chain50["source"], "--spool",
+                   chain50["spool"], "--trend", "--col", "a",
+                   "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and doc["kind"] == "trend"
+        assert doc["generations"] == N_GENS - 1
+
+    def test_history_direct_dir_no_spool(self, chain50, capsys):
+        rc = main(["history", chain50["dir"], "--col", "a"])
+        assert rc == 0
+
+    def test_history_missing_col_is_usage_error(self, chain50, capsys):
+        rc = main(["history", chain50["source"], "--spool",
+                   chain50["spool"]])
+        assert rc == 2
+        assert "--col" in capsys.readouterr().err
+
+    def test_history_no_warehouse_is_input_error(self, tmp_path,
+                                                 capsys, monkeypatch):
+        monkeypatch.delenv("TPUPROF_WAREHOUSE_DIR", raising=False)
+        rc = main(["history", str(tmp_path / "nope.parquet"),
+                   "--col", "a"])
+        assert rc == 2
+
+    def test_history_without_pyarrow_exits_10(self, chain50, capsys,
+                                              no_pyarrow):
+        rc = main(["history", chain50["dir"], "--col", "a"])
+        assert rc == 10
+        assert "pyarrow" in capsys.readouterr().err
+
+    def test_backtest_human_and_json(self, chain50, capsys):
+        rc = main(["backtest", chain50["source"], "--spool",
+                   chain50["spool"]])
+        err = capsys.readouterr().err
+        assert rc == 0 and "1 alert(s)" in err
+        rc = main(["backtest", chain50["source"], "--spool",
+                   chain50["spool"], "--psi-threshold", "0.0005",
+                   "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and doc["schema"] == wh.BACKTEST_SCHEMA
+        assert len(doc["alerts"]) > 1
+
+    def test_backtest_needs_spool_or_chain_dir(self, tmp_path, capsys):
+        rc = main(["backtest", str(tmp_path / "nope.parquet")])
+        assert rc == 2
+        assert "--spool" in capsys.readouterr().err
+
+    def test_profile_artifact_feeds_warehouse(self, tmp_path, capsys):
+        """The one-shot path: --artifact + --warehouse-dir appends a
+        generation whose numbers equal the sealed artifact's."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        rng = np.random.default_rng(0)
+        src = str(tmp_path / "t.parquet")
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "x": rng.normal(0, 1, 500)}), preserve_index=False), src)
+        art_path = str(tmp_path / "a.json")
+        whroot = str(tmp_path / "wh")
+        rc = main(["profile", src, "-o", str(tmp_path / "r.html"),
+                   "--backend", "cpu", "--artifact", art_path,
+                   "--warehouse-dir", whroot])
+        assert rc == 0
+        d = wh.source_dir(whroot, src)
+        gens = wh.chain(d)
+        assert [g for g, _p in gens] == [1]
+        art = read_artifact(art_path)
+        g = wh.read_stats_parquet(gens[0][1])
+        assert g.stats["x"]["mean"] == \
+            art.stats["variables"]["x"]["mean"]
+        assert g.meta["artifact_crc32"] == art.crc32
+        # a second run appends generation 2, never overwrites
+        rc = main(["profile", src, "-o", str(tmp_path / "r.html"),
+                   "--backend", "cpu", "--artifact", art_path,
+                   "--warehouse-dir", whroot])
+        assert rc == 0
+        assert [g for g, _p in wh.chain(d)] == [1, 2]
+
+    def test_profile_warehouse_format_off_writes_nothing(self,
+                                                         tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        rng = np.random.default_rng(0)
+        src = str(tmp_path / "t.parquet")
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "x": rng.normal(0, 1, 300)}), preserve_index=False), src)
+        whroot = str(tmp_path / "wh")
+        rc = main(["profile", src, "-o", str(tmp_path / "r.html"),
+                   "--backend", "cpu",
+                   "--artifact", str(tmp_path / "a.json"),
+                   "--warehouse-dir", whroot,
+                   "--warehouse-format", "off"])
+        assert rc == 0
+        assert not os.path.isdir(whroot)
+
+
+# ---------------------------------------------------------------------------
+# the HTTP history route (ISSUE 13 (c))
+# ---------------------------------------------------------------------------
+
+class TestHttpHistory:
+    @pytest.fixture
+    def edge(self, chain50, tmp_path):
+        from tpuprof.serve import HttpEdge, ServeDaemon
+        # the route reads the spool's warehouse from disk — no daemon
+        # poll loop needed; the chain50 spool already holds one
+        daemon = ServeDaemon(chain50["spool"], workers=1)
+        e = HttpEdge(daemon, port=0).start()
+        yield e
+        e.close()
+        daemon.close(timeout=5)
+
+    def _get(self, url):
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            return exc.code, json.loads(exc.read())
+
+    def test_stat_series(self, edge, chain50):
+        code, doc = self._get(
+            f"{edge.url}/v1/history/{chain50['key']}?col=a&stat=mean")
+        assert code == 200
+        assert doc["schema"] == wh.HISTORY_SCHEMA
+        assert doc["generations"] == N_GENS
+        assert doc["series"][-1]["value"] == chain50["means"][N_GENS]
+
+    def test_trend(self, edge, chain50):
+        code, doc = self._get(
+            f"{edge.url}/v1/history/{chain50['key']}?trend=1&col=a")
+        assert code == 200 and doc["kind"] == "trend"
+        assert doc["generations"] == N_GENS - 1
+
+    def test_unknown_key_404(self, edge):
+        code, doc = self._get(f"{edge.url}/v1/history/no-such-key")
+        assert code == 404
+
+    def test_missing_col_400(self, edge, chain50):
+        code, doc = self._get(
+            f"{edge.url}/v1/history/{chain50['key']}")
+        assert code == 400 and "col" in doc["error"]
+
+    def test_traversal_rejected(self, edge):
+        code, _doc = self._get(f"{edge.url}/v1/history/..")
+        assert code in (400, 404)
+
+
+# ---------------------------------------------------------------------------
+# fault injection: the warehouse_write site mangles bytes -> typed read
+# ---------------------------------------------------------------------------
+
+class TestFaultSite:
+    def test_mangled_write_reads_typed(self, golden, tmp_path):
+        from tpuprof.testing import faults
+        art = golden["artifact"]
+        path = str(tmp_path / "mangled.stats.parquet")
+        faults.configure("warehouse_write:truncate@1")
+        try:
+            wh.write_stats_parquet(path, art.stats, art.sketches,
+                                   generation=1)
+        finally:
+            faults.reset()
+        with pytest.raises(CorruptWarehouseError):
+            wh.read_stats_parquet(path)

@@ -49,6 +49,8 @@ DURABLE_MODULES = (
     "serve/watch.py",
     "serve/http.py",
     "obs/fleet.py",
+    "warehouse/columnar.py",
+    "warehouse/store.py",
 )
 
 _WRITE_CHARS = set("wax+")

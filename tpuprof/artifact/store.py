@@ -87,6 +87,10 @@ class Artifact:
     sketches: Dict[str, Any]
     state_bytes: Optional[bytes] = None
     path: Optional[str] = None
+    crc32: Optional[int] = None     # the verified integrity envelope's
+                                    # CRC — the provenance token the
+                                    # columnar warehouse stamps into
+                                    # its Parquet metadata
 
     @property
     def foldable(self) -> bool:
@@ -267,7 +271,12 @@ def write_artifact(path: str, stats: Optional[Dict[str, Any]] = None,
         from tpuprof.obs import events
         events.emit("artifact_write", path=path, rows=meta["rows"],
                     bytes=len(data), foldable=meta["foldable"])
-    return meta
+    # a COPY carrying the sealed document's CRC (the warehouse
+    # provenance token) — the doc's own meta section must stay exactly
+    # what the CRC covered
+    out = dict(meta)
+    out["crc32"] = doc["integrity"]["crc32"]
+    return out
 
 
 def read_artifact(path: str) -> Artifact:
@@ -335,7 +344,8 @@ def read_artifact(path: str) -> Artifact:
     art = Artifact(schema=doc["schema"], meta=doc.get("meta") or {},
                    stats=doc.get("stats") or {},
                    sketches=doc.get("sketches") or {},
-                   state_bytes=state_bytes, path=path)
+                   state_bytes=state_bytes, path=path,
+                   crc32=int(integrity["crc32"]))
     if _obs_metrics.enabled():
         _READS.inc()
         _READ_SECONDS.observe(time.perf_counter() - t0)

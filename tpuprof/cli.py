@@ -90,6 +90,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "One-shot profiles write stats-only artifacts; "
                         "fold-able (incremental-resumable) ones come "
                         "from the StreamingProfiler API")
+    p.add_argument("--warehouse-dir", metavar="DIR",
+                   help="with --artifact: ALSO append a columnar "
+                        "tpuprof-stats-parquet-v1 generation under "
+                        "DIR/<source-key>/ (the profile warehouse — "
+                        "one row per column, stats as typed Parquet "
+                        "columns, column-pruned reads; ARTIFACTS.md).  "
+                        "Default: TPUPROF_WAREHOUSE_DIR, else off")
+    p.add_argument("--warehouse-format", default=None,
+                   choices=("parquet", "off"),
+                   help="columnar warehouse encoding, or 'off' to "
+                        "never write one even with a warehouse dir "
+                        "configured (the pyarrow-free opt-out; "
+                        "default: TPUPROF_WAREHOUSE_FORMAT, else "
+                        "parquet)")
     p.add_argument("--trace", metavar="DIR",
                    help="capture a jax.profiler trace into DIR")
     p.add_argument("--metrics-json", metavar="PATH",
@@ -409,6 +423,18 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--serve-auth-file", metavar="PATH",
                    help="bearer-token file for the HTTP edge "
                         "(default: TPUPROF_SERVE_AUTH_FILE, else open)")
+    w.add_argument("--warehouse-dir", metavar="DIR",
+                   help="columnar profile-warehouse root the watch "
+                        "loop appends one tpuprof-stats-parquet-v1 "
+                        "generation per cycle into (default: "
+                        "TPUPROF_WAREHOUSE_DIR, else SPOOL/warehouse "
+                        "— the history `tpuprof history` and "
+                        "GET /v1/history/<key> answer from)")
+    w.add_argument("--warehouse-format", default=None,
+                   choices=("parquet", "off"),
+                   help="'off' disables the columnar twin (cycles are "
+                        "unaffected; default: "
+                        "TPUPROF_WAREHOUSE_FORMAT, else parquet)")
     w.add_argument("--config-json", metavar="JSON|@FILE",
                    help="ProfilerConfig kwargs applied to every watch "
                         "cycle's profile job, as inline JSON or "
@@ -497,6 +523,69 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exit 1 when any column reaches drift severity "
                         "(CI gate); corrupt artifacts exit 6 either way")
 
+    hi = sub.add_parser(
+        "history", help="query the columnar profile warehouse: one "
+                        "column's stat across every profiled "
+                        "generation (`--stat mean --col price`), or "
+                        "the PSI/KS drift trend between consecutive "
+                        "generations (`--trend`) — column-pruned "
+                        "Parquet reads, corrupt generations walked "
+                        "past (ARTIFACTS.md 'Profile warehouse')")
+    hi.add_argument("source",
+                    help="the watched/profiled source path (resolved "
+                         "to its warehouse key), a warehouse key, or "
+                         "a per-source warehouse directory")
+    hi.add_argument("--warehouse-dir", metavar="DIR", default=None,
+                    help="warehouse root (default: "
+                         "TPUPROF_WAREHOUSE_DIR; see also --spool)")
+    hi.add_argument("--spool", metavar="DIR", default=None,
+                    help="a watch daemon's spool — shorthand for "
+                         "--warehouse-dir SPOOL/warehouse")
+    hi.add_argument("--col", metavar="NAME", default=None,
+                    help="the profiled column to query (required "
+                         "unless --trend, where it is an optional "
+                         "filter)")
+    hi.add_argument("--stat", metavar="STAT", default="mean",
+                    help="which stat column to read (default: mean; "
+                         "any tpuprof-stats-v1 numeric stat — std, "
+                         "p_missing, distinct_count, p95, ...)")
+    hi.add_argument("--trend", action="store_true",
+                    help="PSI/KS between every consecutive pair of "
+                         "generations instead of a stat series "
+                         "(computed from the stored histogram "
+                         "sketches by the tpuprof-drift-v1 engine)")
+    hi.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the machine-readable "
+                         "tpuprof-history-v1 document to stdout "
+                         "instead of the human table")
+
+    b = sub.add_parser(
+        "backtest", help="replay changed alert thresholds against a "
+                         "watched source's retained artifact chain: "
+                         "which cycles WOULD have alerted under "
+                         "--psi-threshold X?  Uses the live watch "
+                         "loop's own drift/dedup rules, so the replay "
+                         "at the live thresholds reproduces the live "
+                         "alert set exactly")
+    b.add_argument("source",
+                   help="the watched source path (resolved to its "
+                        "chain under SPOOL/watch/<key>/), or a "
+                        "directory of cycle_*.artifact.json files")
+    b.add_argument("--spool", metavar="DIR", default=None,
+                   help="the watch daemon's spool directory holding "
+                        "the retained chain")
+    b.add_argument("--psi-threshold", type=float, default=None,
+                   metavar="X",
+                   help="PSI at or above X alerts at drift severity "
+                        "(default 0.25; warn band at half)")
+    b.add_argument("--ks-threshold", type=float, default=None,
+                   metavar="X",
+                   help="KS distance at or above X alerts at drift "
+                        "severity (default 0.2; warn band at half)")
+    b.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the machine-readable "
+                        "tpuprof-backtest-v1 document to stdout")
+
     l = sub.add_parser(
         "lint", help="run the AST-enforced invariant suite over the "
                      "source tree (tpuprof/analysis; ANALYSIS.md): "
@@ -555,6 +644,103 @@ def cmd_diff(args: argparse.Namespace) -> int:
           file=sys.stderr)
     if args.fail_on_drift and s["n_drift"]:
         return 1
+    return 0
+
+
+def _resolve_history_dir(args: argparse.Namespace) -> str:
+    """The per-source warehouse directory a history query reads:
+    ``--warehouse-dir``/env (or ``--spool``'s SPOOL/warehouse) plus the
+    source key — or the source itself when it already IS a per-source
+    warehouse directory."""
+    from tpuprof.config import resolve_warehouse_dir
+    from tpuprof.errors import InputError
+    from tpuprof.warehouse import source_dir
+    root = resolve_warehouse_dir(args.warehouse_dir) \
+        or (os.path.join(args.spool, "warehouse") if args.spool else None)
+    if root is None:
+        from tpuprof.warehouse.store import _has_generations
+        if os.path.isdir(args.source) and _has_generations(args.source):
+            return args.source
+        raise InputError(
+            "history needs the warehouse root: pass --warehouse-dir "
+            "(or TPUPROF_WAREHOUSE_DIR), --spool SPOOL for a watch "
+            "daemon's SPOOL/warehouse, or point SOURCE at a "
+            "per-source warehouse directory directly")
+    return source_dir(root, args.source)
+
+
+def cmd_history(args: argparse.Namespace) -> int:
+    from tpuprof.errors import TYPED_ERRORS, exit_code
+    from tpuprof.warehouse import query_stat, query_trend
+    try:
+        dirpath = _resolve_history_dir(args)
+        if args.trend:
+            doc = query_trend(dirpath, col=args.col)
+        else:
+            if not args.col:
+                print("tpuprof: error: history needs --col NAME (or "
+                      "--trend for the drift series)", file=sys.stderr)
+                return 2
+            doc = query_stat(dirpath, args.col, args.stat)
+    except TYPED_ERRORS as exc:
+        print(f"tpuprof: error: {exc}", file=sys.stderr)
+        return exit_code(exc)
+    if args.as_json:
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+    elif args.trend:
+        print(f"# trend over {doc['generations']} generation pair(s) "
+              f"in {doc['warehouse']}"
+              + (f" (skipped corrupt: {doc['skipped_corrupt']})"
+                 if doc["skipped_corrupt"] else ""))
+        print("generation  baseline  column  psi  ks")
+        for entry in doc["series"]:
+            for name, m in sorted(entry["columns"].items()):
+                print(f"{entry['generation']:>10}  "
+                      f"{entry['baseline_generation']:>8}  {name}  "
+                      f"{m['psi']}  {m['ks']}")
+    else:
+        print(f"# {args.stat}({args.col}) over {doc['generations']} "
+              f"generation(s) in {doc['warehouse']}"
+              + (f" (skipped corrupt: {doc['skipped_corrupt']})"
+                 if doc["skipped_corrupt"] else ""))
+        print("generation  rows  value")
+        for entry in doc["series"]:
+            print(f"{entry['generation']:>10}  "
+                  f"{entry['rows'] if entry['rows'] is not None else '?':>4}"
+                  f"  {entry['value']}")
+    return 0
+
+
+def cmd_backtest(args: argparse.Namespace) -> int:
+    from tpuprof.artifact import DriftThresholds
+    from tpuprof.errors import TYPED_ERRORS, exit_code
+    from tpuprof.warehouse import backtest as _backtest
+    from tpuprof.warehouse import chain_dir
+    thresholds = DriftThresholds.from_cli(psi=args.psi_threshold,
+                                          ks=args.ks_threshold)
+    try:
+        dirpath = chain_dir(args.spool, args.source)
+        doc = _backtest(dirpath, thresholds)
+    except TYPED_ERRORS as exc:
+        print(f"tpuprof: error: {exc}", file=sys.stderr)
+        return exit_code(exc)
+    if args.as_json:
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+        return 0
+    s = doc["summary"]
+    print(f"tpuprof: backtest {doc['chain']}: {s['alerts']} alert(s) "
+          f"over {s['cycles']} retained cycle(s) "
+          f"({s['drift_cycles']} drift, {s['warn_cycles']} warn"
+          + (f", {s['unreadable']} unreadable" if s["unreadable"]
+             else "") + ")", file=sys.stderr)
+    for a in doc["alerts"]:
+        cols = ",".join(a["columns"][:6]) + \
+            ("…" if len(a["columns"]) > 6 else "")
+        print(f"cycle {a['cycle']:>6}  {a['severity']:<6} "
+              f"{a['n_drift']} drifting / {a['n_warn']} warning  "
+              f"[{cols}]")
     return 0
 
 
@@ -793,7 +979,9 @@ def cmd_watch(args: argparse.Namespace) -> int:
         every_s=args.watch_every_s, keep=args.artifact_keep,
         thresholds=DriftThresholds.from_cli(psi=args.psi_threshold,
                                             ks=args.ks_threshold),
-        job_timeout_s=args.job_timeout_s, config_kwargs=config_kwargs)
+        job_timeout_s=args.job_timeout_s, config_kwargs=config_kwargs,
+        warehouse_dir=args.warehouse_dir,
+        warehouse_format=args.warehouse_format)
     blackbox.set_context(watch_sources=[w.source
                                         for w in watcher.watches])
 
@@ -1072,6 +1260,8 @@ def cmd_profile(args: argparse.Namespace) -> int:
             metrics_interval=args.metrics_interval,
             metrics_max_bytes=args.metrics_max_bytes,
             artifact_path=args.artifact,
+            warehouse_dir=args.warehouse_dir,
+            warehouse_format=args.warehouse_format,
             compile_cache_dir=cache_dir)
     except ValueError as exc:
         # config validation (duplicate --columns, bad thresholds, ...)
@@ -1144,6 +1334,30 @@ def cmd_profile(args: argparse.Namespace) -> int:
                 write_artifact(config.artifact_path,
                                stats=report.description, config=config,
                                source=str(args.source))
+                from tpuprof.config import (resolve_warehouse_dir,
+                                            resolve_warehouse_format)
+                whd = resolve_warehouse_dir(config.warehouse_dir)
+                if whd and resolve_warehouse_format(
+                        config.warehouse_format) == "parquet":
+                    # the columnar twin appends a generation derived
+                    # from the artifact JUST sealed, so the Parquet
+                    # rows and the JSON document carry the same bits
+                    # (and the file metadata the document's CRC).  The
+                    # user asked for the warehouse explicitly here, so
+                    # its typed failures (exit 10 without pyarrow) are
+                    # the command's failures.
+                    from tpuprof.artifact import read_artifact
+                    from tpuprof.errors import TYPED_ERRORS, exit_code
+                    from tpuprof.warehouse import append_artifact
+                    try:
+                        append_artifact(whd,
+                                        read_artifact(
+                                            config.artifact_path),
+                                        source=str(args.source))
+                    except TYPED_ERRORS as exc:
+                        print(f"tpuprof: error: {exc}",
+                              file=sys.stderr)
+                        return exit_code(exc)
     elapsed = time.perf_counter() - t0
 
     if ticker is not None:
@@ -1181,6 +1395,10 @@ def main(argv=None) -> int:
         return cmd_submit(args)
     if args.command == "diff":
         return cmd_diff(args)
+    if args.command == "history":
+        return cmd_history(args)
+    if args.command == "backtest":
+        return cmd_backtest(args)
     if args.command == "lint":
         return cmd_lint(args)
     raise AssertionError(args.command)

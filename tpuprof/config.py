@@ -350,6 +350,41 @@ def resolve_artifact_keep(value: Optional[int] = None) -> int:
     return max(env, 1) if env is not None else 3
 
 
+def resolve_warehouse_dir(value: Optional[str] = None) -> Optional[str]:
+    """Columnar profile-warehouse root (``warehouse_dir`` —
+    tpuprof/warehouse, ARTIFACTS.md): per-source generation directories
+    of ``tpuprof-stats-parquet-v1`` files accumulate under it.
+    Explicit config value, else ``TPUPROF_WAREHOUSE_DIR``, else None —
+    for one-shot profiles None means "no columnar twin" (the JSON
+    artifact path is byte-unchanged); the watch daemon defaults its
+    warehouse to ``SPOOL/warehouse`` instead, because the watch loop IS
+    the feeder the history engine exists for."""
+    if value:
+        return str(value)
+    return os.environ.get("TPUPROF_WAREHOUSE_DIR") or None
+
+
+WAREHOUSE_FORMATS = ("parquet", "off")
+
+
+def resolve_warehouse_format(value: Optional[str] = None) -> str:
+    """Columnar-warehouse format switch (``warehouse_format``):
+    ``parquet`` (the only columnar encoding) or ``off`` (never write a
+    columnar twin, even when a warehouse dir is configured — the
+    rollback knob, and the byte-exact opt-out on boxes without
+    pyarrow).  Explicit config value, else
+    ``TPUPROF_WAREHOUSE_FORMAT``, else ``parquet``."""
+    for cand, origin in ((value, "warehouse_format"),
+                         (os.environ.get("TPUPROF_WAREHOUSE_FORMAT"),
+                          "TPUPROF_WAREHOUSE_FORMAT")):
+        if cand:
+            if cand not in WAREHOUSE_FORMATS:
+                raise ValueError(
+                    f"{origin}={cand!r} — use one of {WAREHOUSE_FORMATS}")
+            return cand
+    return "parquet"
+
+
 PASS_B_KERNELS = ("cumulative", "legacy")
 
 
@@ -723,6 +758,29 @@ class ProfilerConfig:
                                             # watch --every`).  None =
                                             # auto: TPUPROF_WATCH_
                                             # EVERY_S env, else 300
+    warehouse_dir: Optional[str] = None     # columnar profile-warehouse
+                                            # root (tpuprof/warehouse):
+                                            # each artifact-writing
+                                            # profile ALSO appends a
+                                            # tpuprof-stats-parquet-v1
+                                            # generation under
+                                            # <dir>/<source-key>/ for
+                                            # column-pruned history
+                                            # queries.  None = auto:
+                                            # TPUPROF_WAREHOUSE_DIR
+                                            # env, else off for one-
+                                            # shot profiles (the watch
+                                            # daemon defaults to
+                                            # SPOOL/warehouse).  CLI:
+                                            # --warehouse-dir
+    warehouse_format: Optional[str] = None  # "parquet" | "off": the
+                                            # columnar twin's encoding,
+                                            # or the opt-out that keeps
+                                            # every path pyarrow-free.
+                                            # None = auto: TPUPROF_
+                                            # WAREHOUSE_FORMAT env,
+                                            # else "parquet".  CLI:
+                                            # --warehouse-format
     artifact_keep: Optional[int] = None     # watch-cycle artifact
                                             # retention per source
                                             # (`tpuprof watch --keep`):
@@ -883,6 +941,12 @@ class ProfilerConfig:
                 "or None)")
         if self.artifact_keep is not None and self.artifact_keep < 1:
             raise ValueError("artifact_keep must be >= 1 (or None)")
+        if self.warehouse_format is not None \
+                and self.warehouse_format not in WAREHOUSE_FORMATS:
+            raise ValueError(
+                f"warehouse_format={self.warehouse_format!r} — use one "
+                f"of {WAREHOUSE_FORMATS} (or None for the "
+                "TPUPROF_WAREHOUSE_FORMAT/default resolution)")
         if self.serve_workers is not None and self.serve_workers < 1:
             raise ValueError("serve_workers must be >= 1 (or None)")
         if self.serve_queue_depth is not None \

@@ -60,6 +60,19 @@ The static-analysis suite (tpuprof/analysis — ANALYSIS.md) adds:
 
 * ``LintFindingsError`` (InputError) — `tpuprof lint` found
   unsuppressed invariant violations; shares InputError's exit code 2.
+
+The profile warehouse (tpuprof/warehouse — ARTIFACTS.md) adds two:
+
+* ``WarehouseUnavailableError`` (RuntimeError) — a columnar warehouse
+  operation was requested but pyarrow is not importable in this
+  environment.  The JSON artifact path is deliberately unaffected (it
+  has no pyarrow dependency); the CLI maps this to exit code 10 so a
+  wrapper can tell "install pyarrow" from every other failure shape.
+* ``CorruptWarehouseError`` (CorruptArtifactError) — a columnar stats
+  file (``tpuprof-stats-parquet-v1``) failed its integrity checks:
+  truncated/undecodable Parquet bytes, a missing or foreign schema id
+  in the file metadata.  Never a raw pyarrow traceback; shares
+  CorruptArtifactError's exit code 6 ("a persisted product rotted").
 """
 
 from typing import Any, Dict, List, Optional
@@ -135,6 +148,25 @@ class ServeUnavailableError(OSError):
     same or another edge; the CLI maps it to exit code 9."""
 
 
+class WarehouseUnavailableError(RuntimeError):
+    """A columnar-warehouse operation (tpuprof/warehouse) needs pyarrow
+    and this environment cannot import it.  Carries no partial state:
+    nothing was written, and the JSON artifact path (which has no
+    pyarrow dependency) is unaffected.  The CLI maps this to exit code
+    10 — "install pyarrow or set warehouse_format=off" is an
+    environment problem, distinct from every data-integrity shape."""
+
+
+class CorruptWarehouseError(CorruptArtifactError):
+    """A columnar stats file (``tpuprof-stats-parquet-v1`` —
+    tpuprof/warehouse/columnar.py) failed integrity validation:
+    truncated or undecodable Parquet bytes, or a missing/foreign schema
+    id in the file metadata.  Never a raw ``pyarrow.lib.ArrowInvalid``;
+    history queries walk past a corrupt generation the way checkpoint
+    restore walks its chain.  Subclasses :class:`CorruptArtifactError`,
+    so it shares exit code 6 ("a persisted product rotted")."""
+
+
 class LintFindingsError(InputError):
     """`tpuprof lint` found unsuppressed invariant violations
     (tpuprof/analysis; ANALYSIS.md).  Subclasses :class:`InputError`
@@ -162,7 +194,8 @@ class WatchdogTimeout(TimeoutError):
 # shapes": one-line message + distinct exit code, no traceback
 TYPED_ERRORS = (InputError, CorruptCheckpointError, CorruptArtifactError,
                 CorruptManifestError, PoisonBatchError, WatchdogTimeout,
-                HostDeathError, ServeUnavailableError, LintFindingsError)
+                HostDeathError, ServeUnavailableError, LintFindingsError,
+                WarehouseUnavailableError)
 
 _EXIT_CODES = (
     # order matters: InputError, CorruptCheckpointError,
@@ -175,6 +208,7 @@ _EXIT_CODES = (
     (PoisonBatchError, 5),
     (HostDeathError, 8),
     (ServeUnavailableError, 9),
+    (WarehouseUnavailableError, 10),
     (InputError, 2),
 )
 

@@ -228,7 +228,7 @@ class HttpEdge:
         """(status, body, route-pattern) for one request.  ``body`` as
         bytes passes through verbatim (the /metrics exposition);
         anything else is JSON-encoded by the handler."""
-        path = path.split("?", 1)[0]
+        path, _, query = path.partition("?")
         if method == "GET" and path == "/metrics":
             return (200,
                     _obs_metrics.registry().render_text().encode(),
@@ -258,6 +258,9 @@ class HttpEdge:
             m = re.match(r"^/v1/watch/([^/]+)/alerts$", path)
             if m:
                 return self._get_alerts(m.group(1))
+            m = re.match(r"^/v1/history/([^/]+)$", path)
+            if m:
+                return self._get_history(m.group(1), query)
         return 404, {"error": f"no route {method} {path!r}"}, "other"
 
     def _post_job(self, body: Optional[bytes],
@@ -374,6 +377,49 @@ class HttpEdge:
         # the feed is written atomically (watch.py _atomic_write) and
         # is already JSON — stream the bytes; no parse, no copy drift
         return 200, data or b"[]", route
+
+    def _get_history(self, key: str, query: str) -> Tuple[int, Any, str]:
+        """The warehouse history feed off the edge (ISSUE 13 (c)):
+        ``GET /v1/history/<key>?col=price&stat=mean`` answers the stat
+        series, ``?trend=1[&col=price]`` the PSI/KS-over-time series —
+        both the same ``tpuprof-history-v1`` document `tpuprof history`
+        prints, read from the spool's warehouse the watch loop feeds."""
+        from urllib.parse import parse_qs
+        route = "/v1/history/<key>"
+        if not _ID_RE.match(key) or set(key) <= {"."}:
+            return (400, {"error": f"malformed warehouse key {key!r}"},
+                    route)
+        params = parse_qs(query or "")
+
+        def one(name, default=None):
+            vals = params.get(name)
+            return vals[0] if vals else default
+
+        dirpath = os.path.join(self.daemon.spool, "warehouse", key)
+        if not os.path.isdir(dirpath):
+            return (404, {"error": f"no warehouse history for key "
+                                   f"{key!r}"}, route)
+        from tpuprof.errors import (CorruptWarehouseError, InputError,
+                                    WarehouseUnavailableError)
+        from tpuprof.warehouse import query_stat, query_trend
+        try:
+            if one("trend") in ("1", "true", "yes"):
+                doc = query_trend(dirpath, col=one("col"))
+            else:
+                col = one("col")
+                if not col:
+                    return (400, {"error": "history needs ?col=<name> "
+                                          "(or ?trend=1)"}, route)
+                doc = query_stat(dirpath, col, one("stat", "mean"))
+        except InputError as exc:
+            return 404, {"error": str(exc)}, route
+        except WarehouseUnavailableError as exc:
+            # the daemon's own environment lacks pyarrow: the edge is
+            # honest about it — 501 "not implemented here", not a 500
+            return 501, {"error": str(exc)}, route
+        except CorruptWarehouseError as exc:
+            return 500, {"error": f"{type(exc).__name__}: {exc}"}, route
+        return 200, doc, route
 
 
 # ---------------------------------------------------------------------------

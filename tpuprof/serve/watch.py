@@ -62,7 +62,8 @@ import zlib
 from typing import Any, Dict, List, Optional, Sequence
 
 from tpuprof.errors import (CorruptArtifactError, CorruptManifestError,
-                            TYPED_ERRORS, exit_code)
+                            TYPED_ERRORS, WarehouseUnavailableError,
+                            exit_code)
 from tpuprof.obs import blackbox
 from tpuprof.obs import events as _obs_events
 from tpuprof.obs import metrics as _obs_metrics
@@ -94,6 +95,29 @@ _FALLBACKS = _obs_metrics.counter(
 _CANON = {"sort_keys": True, "separators": (",", ":")}
 
 _CYCLE_RE = re.compile(r"cycle_(\d{8})\.artifact\.json$")
+
+#: how many flagged column names ride one drift alert (and its episode
+#: dedup key) — the feed is an operator surface, not a column dump
+ALERT_COLUMNS_CAP = 16
+
+
+def drift_alert_shape(drift: Dict[str, Any]):
+    """One drift report -> ``(status, flagged_columns)``: the verdict
+    plus the capped, sorted column list an alert (and its episode dedup
+    key) carries.  The ONE definition the live watch loop and the
+    warehouse backtester (tpuprof/warehouse/backtest.py) both speak —
+    a replay that derived the shape its own way could never promise to
+    reproduce the live alert set exactly."""
+    status = drift["summary"]["verdict"]
+    flagged = sorted(c for c, e in drift["columns"].items()
+                     if e["status"] != "ok")
+    return status, flagged[:ALERT_COLUMNS_CAP]
+
+
+def drift_episode_key(severity: str, columns) -> List[Any]:
+    """The episode dedup key: the SAME ongoing drift (same severity,
+    same flagged set) alerts once, not every cycle."""
+    return ["drift", severity, list(columns or [])]
 
 
 def source_key(source: Any) -> str:
@@ -320,10 +344,14 @@ class DriftWatcher:
                  thresholds=None,
                  job_timeout_s: Optional[float] = None,
                  config_kwargs: Optional[Dict[str, Any]] = None,
-                 tenant: str = "watch"):
+                 tenant: str = "watch",
+                 warehouse_dir: Optional[str] = None,
+                 warehouse_format: Optional[str] = None):
         from tpuprof.artifact import DriftThresholds
         from tpuprof.config import (resolve_artifact_keep,
                                     resolve_job_timeout,
+                                    resolve_warehouse_dir,
+                                    resolve_warehouse_format,
                                     resolve_watch_every)
         if not sources:
             raise ValueError("watch needs at least one source")
@@ -337,6 +365,16 @@ class DriftWatcher:
         self.job_timeout_s = resolve_job_timeout(job_timeout_s)
         self.config_kwargs = dict(config_kwargs or {})
         self.tenant = str(tenant)
+        # the columnar warehouse (tpuprof/warehouse): the watch loop is
+        # its primary feeder, so — unlike the one-shot CLI — the dir
+        # defaults ON, under the spool.  warehouse_format=off is the
+        # opt-out (and the pyarrow-free mode); a missing pyarrow
+        # degrades to off at first use, loudly, without failing cycles.
+        if resolve_warehouse_format(warehouse_format) == "off":
+            self.warehouse_dir: Optional[str] = None
+        else:
+            self.warehouse_dir = resolve_warehouse_dir(warehouse_dir) \
+                or os.path.join(spool, "warehouse")
         self.stop_event = threading.Event()
         self.counts = {"ok": 0, "warn": 0, "drift": 0, "failed": 0}
         self.watches: List[SourceWatch] = []
@@ -401,26 +439,30 @@ class DriftWatcher:
             if baseline is not None:
                 drift = compute_drift(baseline, current, self.thresholds)
                 s = drift["summary"]
-                status = s["verdict"]            # ok | warn | drift
+                # the alert shape (verdict + capped flagged set) is the
+                # shared definition the warehouse backtester replays
+                status, flagged = drift_alert_shape(drift)
                 extra = {"n_drift": s["n_drift"], "n_warn": s["n_warn"],
                          "row_delta": s["row_delta"]}
                 if status == "ok":
                     # drift cleared: the next episode re-alerts
                     w.last_alert_key = None
                 else:
-                    flagged = sorted(
-                        c for c, e in drift["columns"].items()
-                        if e["status"] != "ok")
                     self._alert(w, kind="drift", severity=status,
                                 cycle=cycle, verdict=status,
                                 n_drift=s["n_drift"],
                                 n_warn=s["n_warn"],
-                                columns=flagged[:16],
+                                columns=flagged,
                                 baseline=baseline.path,
                                 artifact=art_path)
             w.cycle = cycle
             w.last_artifact = art_path
             w.rotate()
+            # append the columnar generation AFTER the JSON artifact is
+            # admitted: the warehouse is derived truth — advisory to
+            # the cycle (its failure can never fail a cycle), but the
+            # JSON chain rotates at `keep` while this history only grows
+            self._warehouse_append(w, current, cycle)
         except Exception as exc:        # noqa: BLE001 — a watch survives
             status = "failed"
             # the failed cycle's .part (absent, partial, or torn) is
@@ -453,11 +495,31 @@ class DriftWatcher:
         return {"source": w.source, "cycle": cycle, "status": status,
                 "seconds": seconds, **extra}
 
+    def _warehouse_append(self, w: SourceWatch, artifact,
+                          cycle: int) -> None:
+        """Feed the columnar warehouse (never raises — the cycle's
+        truth is the JSON chain; the warehouse is the queryable twin)."""
+        if self.warehouse_dir is None:
+            return
+        try:
+            from tpuprof.warehouse import append_artifact
+            append_artifact(self.warehouse_dir, artifact,
+                            source=w.source, generation=cycle)
+        except WarehouseUnavailableError as exc:
+            # no pyarrow on this box: degrade to warehouse_format=off
+            # for the rest of the run — once, loudly, cycles unharmed
+            blackbox.record("warehouse_unavailable", error=str(exc))
+            self.warehouse_dir = None
+        except Exception as exc:    # noqa: BLE001 — a watch survives
+            blackbox.record("warehouse_write_failed", source=w.source,
+                            cycle=cycle,
+                            error=f"{type(exc).__name__}: {exc}")
+
     # -- alerts -------------------------------------------------------------
 
     def _alert(self, w: SourceWatch, *, kind: str, severity: str,
                cycle: int, **fields) -> Optional[Dict[str, Any]]:
-        key = [kind, severity, list(fields.get("columns") or [])]
+        key = drift_episode_key(severity, fields.get("columns"))
         if kind == "drift" and w.last_alert_key == key:
             # dedup: the SAME ongoing drift episode (same severity, same
             # column set) does not re-alert every cycle — the cycle

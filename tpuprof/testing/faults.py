@@ -16,6 +16,9 @@ Sites wired today (grep ``faults.hit`` / ``faults.mangle``):
 ``fold``                  per-batch fold into device/host state (quarantinable)
 ``checkpoint_write``      inside ``checkpoint.save``'s tmp-file write
 ``artifact_write``        inside the stats-artifact store's tmp-file write
+``warehouse_write``       inside the columnar warehouse's tmp-file write
+                          (tpuprof/warehouse/columnar.py; ``mangle``
+                          truncates/flips the Parquet bytes)
 ``device_wait``           the watched device drain (``block_until_ready``)
 ``barrier``               the watched multi-host resume barrier
 ``host_death``            per-batch fleet-participation kill switch
@@ -90,7 +93,7 @@ SITES = frozenset({
     # ingest / fold (retry + quarantine rungs)
     "prep", "fold",
     # durable writes (truncation-capable byte sites)
-    "checkpoint_write", "artifact_write",
+    "checkpoint_write", "artifact_write", "warehouse_write",
     # watchdogs (guard.watched / Deadline)
     "device_wait", "device_drain", "resume_barrier", "barrier",
     "fleet_publish", "fleet_finish",

@@ -1,0 +1,52 @@
+"""tpuprof/warehouse — the profile warehouse (ISSUE 13; ROADMAP item 2).
+
+Turns profile artifacts from single documents into a queryable
+time-series with four pillars:
+
+* warehouse/columnar.py  — ``tpuprof-stats-parquet-v1``: one row per
+                           profiled column per generation, stats as
+                           typed Parquet columns, histogram sketches as
+                           list columns, schema/CRC provenance in the
+                           file metadata; column-pruned reads are the
+                           10k-column win the JSON document cannot give.
+* warehouse/store.py     — the append-only per-source generation
+                           directory (``<warehouse_dir>/<source-key>/
+                           gen_<n>.stats.parquet``) the watch loop
+                           feeds and ``--artifact`` writes alongside.
+* warehouse/history.py   — ``tpuprof history SOURCE --stat mean --col
+                           price`` / ``--trend``: stat series and
+                           PSI/KS-over-time from the columnar chain,
+                           corrupt generations walked past; also served
+                           as ``GET /v1/history/<key>`` off the HTTP
+                           edge.
+* warehouse/backtest.py  — ``tpuprof backtest SOURCE --psi-threshold
+                           X``: replay changed alert bands against the
+                           retained JSON chain with the live watch
+                           loop's own decision rules.
+
+pyarrow is imported lazily (columnar.import_pyarrow): an environment
+without it gets the typed
+:class:`~tpuprof.errors.WarehouseUnavailableError` (CLI exit code 10)
+and the JSON artifact path is unaffected.  See ARTIFACTS.md "Profile
+warehouse" for the schema and layout, OBSERVABILITY.md for the
+``tpuprof_warehouse_*`` / ``tpuprof_history_*`` series.
+"""
+
+from tpuprof.warehouse.backtest import (BACKTEST_SCHEMA, backtest,
+                                        chain_dir)
+from tpuprof.warehouse.columnar import (STATS_PARQUET_SCHEMA, Generation,
+                                        import_pyarrow,
+                                        read_stats_parquet,
+                                        write_stats_parquet)
+from tpuprof.warehouse.history import (HISTORY_SCHEMA, query_stat,
+                                       query_trend)
+from tpuprof.warehouse.store import (append_artifact, append_generation,
+                                     chain, generation_path, source_dir)
+
+__all__ = [
+    "BACKTEST_SCHEMA", "Generation", "HISTORY_SCHEMA",
+    "STATS_PARQUET_SCHEMA", "append_artifact", "append_generation",
+    "backtest", "chain", "chain_dir", "generation_path",
+    "import_pyarrow", "query_stat", "query_trend", "read_stats_parquet",
+    "source_dir", "write_stats_parquet",
+]
