@@ -320,6 +320,21 @@ def _measure_warehouse() -> dict:
                                  cols=200 if _SMOKE else 400)
 
 
+def _measure_singlepass() -> dict:
+    """Single-pass fused-vs-two-pass A/B (ISSUE 14): warm-edge fused
+    profile speedup over the two-pass structure at the tpch shape plus
+    the warm-watch edge hit rate — the `singlepass` scenario
+    (benchmarks/run.py) tracks the full methodology; these keys put a
+    fused-path regression (or an identity break — the measure FAILS on
+    divergent stats) in the headline BENCH line."""
+    import sys
+    import tempfile
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.run import measure_singlepass
+    with tempfile.TemporaryDirectory() as td:
+        return measure_singlepass(1 << 14 if _SMOKE else 1 << 16, td)
+
+
 def _measure_guardrail() -> dict:
     """Clean-path cost of the fault-tolerance plumbing (ISSUE 4): the
     retry-guard wrapper on the serial prepare loop, A/B'd in the same
@@ -355,6 +370,7 @@ def main() -> None:
     serve = _measure_serve()              # warm-mesh daemon envelope
     watch = _measure_watch()              # continuous-drift watch loop
     wh = _measure_warehouse()             # columnar warehouse IO
+    sp = _measure_singlepass()            # fused-vs-two-pass A/B
     render_s = _measure_render()          # host-only, before the device
 
     devices = jax.devices()[:1]           # single-chip measurement
@@ -482,6 +498,13 @@ def main() -> None:
         "warehouse_pruned_read_speedup":
             wh["warehouse_pruned_read_speedup"],
         "history_query_s": wh["history_query_s"],
+        # single-pass profiles (ISSUE 14): warm-edge fused e2e over the
+        # two-pass structure (the measure FAILS if fused stats diverge
+        # from two-pass's) and the warm-watch edge hit rate (enforced
+        # == 1.0 on the undrifted lane)
+        "singlepass_speedup_x": sp["singlepass_speedup_x"],
+        "singlepass_wide_speedup_x": sp["singlepass_wide_speedup_x"],
+        "edge_hit_rate": sp["edge_hit_rate"],
         "device_mem_in_use_bytes": int(device_mem_in_use),
         # per-stage breakdown (obs spans; NEW keys only — existing keys
         # above keep their names so BENCH_r* comparisons stay valid)

@@ -509,6 +509,66 @@ class TestWarehouseConfigRoundTrip:
         assert args.ks_threshold is None
 
 
+class TestProfilePassesRoundTrip:
+    """`profile_passes` / `seed_edges` resolve identically from env,
+    CLI and config (ISSUE 14 satellite — the standard three-way
+    round-trip)."""
+
+    def test_passes_env_cli_config_resolve_identically(self,
+                                                       monkeypatch):
+        from tpuprof.cli import build_parser
+        from tpuprof.config import resolve_profile_passes
+        monkeypatch.delenv("TPUPROF_PROFILE_PASSES", raising=False)
+        via_config = resolve_profile_passes(
+            ProfilerConfig(profile_passes="fused").profile_passes)
+        args = build_parser().parse_args(
+            ["profile", "t.parquet", "--profile-passes", "fused"])
+        via_cli = resolve_profile_passes(args.profile_passes)
+        monkeypatch.setenv("TPUPROF_PROFILE_PASSES", "fused")
+        via_env = resolve_profile_passes(None)
+        assert via_config == via_cli == via_env == "fused"
+        # explicit value beats the env twin
+        assert resolve_profile_passes("two_pass") == "two_pass"
+        monkeypatch.delenv("TPUPROF_PROFILE_PASSES")
+        # default: the historical two-pass structure
+        assert resolve_profile_passes(None) == "two_pass"
+
+    def test_seed_edges_env_cli_config_resolve_identically(
+            self, monkeypatch):
+        from tpuprof.cli import build_parser
+        from tpuprof.config import resolve_seed_edges
+        monkeypatch.delenv("TPUPROF_SEED_EDGES", raising=False)
+        via_config = resolve_seed_edges(
+            ProfilerConfig(seed_edges="/a.json").seed_edges)
+        args = build_parser().parse_args(
+            ["profile", "t.parquet", "--seed-edges", "/a.json"])
+        via_cli = resolve_seed_edges(args.seed_edges)
+        monkeypatch.setenv("TPUPROF_SEED_EDGES", "/a.json")
+        via_env = resolve_seed_edges(None)
+        assert via_config == via_cli == via_env == "/a.json"
+        assert resolve_seed_edges("/b.json") == "/b.json"
+        monkeypatch.delenv("TPUPROF_SEED_EDGES")
+        assert resolve_seed_edges(None) is None  # first-batch sketch
+
+    def test_watch_parser_and_validation(self):
+        from tpuprof.cli import build_parser
+        args = build_parser().parse_args(
+            ["watch", "spool", "s", "--profile-passes", "fused"])
+        assert args.profile_passes == "fused"
+        with pytest.raises(ValueError, match="profile_passes"):
+            ProfilerConfig(profile_passes="three_pass")
+        # argparse rejects unknown structures before config sees them
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(
+                ["profile", "t.parquet", "--profile-passes", "both"])
+
+    def test_env_validation(self, monkeypatch):
+        from tpuprof.config import resolve_profile_passes
+        monkeypatch.setenv("TPUPROF_PROFILE_PASSES", "sideways")
+        with pytest.raises(ValueError, match="TPUPROF_PROFILE_PASSES"):
+            resolve_profile_passes(None)
+
+
 SNAPSHOT_NUM_FIELDS = sorted(schema.NUM_FIELDS)
 
 

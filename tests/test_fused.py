@@ -230,3 +230,59 @@ def test_spearman_wide_tier_matches_narrow(cols):
     np.testing.assert_allclose(
         corr.finalize(jax.device_get(narrow)),
         corr.finalize(jax.device_get(wide)), atol=1e-5, equal_nan=True)
+
+
+@pytest.mark.parametrize("rows,cols,bins", [(512, 5, 10), (1024, 40, 32)])
+@pytest.mark.parametrize("hist_kernel", ["cumulative", "legacy"])
+def test_combined_single_pass_kernel_matches_separate(rows, cols, bins,
+                                                      hist_kernel):
+    """The ISSUE-14 combined kernel (moments + Gram + provisional-edge
+    histogram in ONE pallas read, interpret mode) must equal the
+    separate narrow pass-A kernel + the standalone pallas histogram
+    BIT FOR BIT — counts exactly, the accumulated f32 sums to the last
+    ulp (same tile math, same reduction shapes)."""
+    from tpuprof.kernels import histogram as khistogram
+    from tpuprof.kernels import pallas_hist
+
+    x, rv = _mk_batch(rows, cols)
+    xt = jnp.asarray(np.ascontiguousarray(x.T))
+    rvj = jnp.asarray(rv)
+    shift = np.full(cols, 50.0, dtype=np.float32)
+    # provisional edges deliberately NOT the data's true range: the
+    # kernel must bin whatever edges it is given, hit or miss
+    lo = jnp.asarray(np.full(cols, 20.0, dtype=np.float32))
+    hi = jnp.asarray(np.full(cols, 80.0, dtype=np.float32))
+    mean = jnp.asarray(np.full(cols, 49.0, dtype=np.float32))
+    mom0, co0 = _init(cols, shift)
+    hist0 = khistogram.init(cols, bins)
+
+    mom_c, co_c, hist_c = fused.update_with_hist(
+        mom0, co0, hist0, xt, rvj, lo, hi, mean,
+        hist_kernel=hist_kernel, interpret=True)
+    mom_s, co_s = fused.update(mom0, co0, xt, rvj, interpret=True)
+    counts_s, dev_s = pallas_hist.histogram_batch(
+        xt, rvj, lo, hi, mean, bins, interpret=True,
+        kernel=hist_kernel)
+
+    for k in mom_s:
+        np.testing.assert_array_equal(
+            np.asarray(mom_c[k]), np.asarray(mom_s[k]), err_msg=k)
+    for k in co_s:
+        np.testing.assert_array_equal(
+            np.asarray(co_c[k]), np.asarray(co_s[k]), err_msg=k)
+    np.testing.assert_array_equal(np.asarray(hist_c["counts"]),
+                                  np.asarray(counts_s))
+    np.testing.assert_array_equal(np.asarray(hist_c["abs_dev"]),
+                                  np.asarray(dev_s))
+    # and the XLA twin equals ITS separate formulations exactly
+    mom_x, co_x, hist_x = fused.update_with_hist_xla(
+        mom0, co0, hist0, xt, rvj, jnp.asarray(lo), jnp.asarray(hi),
+        jnp.asarray(mean), hist_kernel=hist_kernel)
+    upd = khistogram.update_cumulative if hist_kernel == "cumulative" \
+        else khistogram.update
+    hist_ref = upd(hist0, xt.T, rvj, jnp.asarray(lo), jnp.asarray(hi),
+                   jnp.asarray(mean))
+    np.testing.assert_array_equal(np.asarray(hist_x["counts"]),
+                                  np.asarray(hist_ref["counts"]))
+    np.testing.assert_array_equal(np.asarray(hist_x["abs_dev"]),
+                                  np.asarray(hist_ref["abs_dev"]))

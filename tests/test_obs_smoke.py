@@ -169,6 +169,15 @@ EVENT_SCHEMA = {
                  "cycles": ((int,), True),
                  "alerts": ((int,), True),
                  "seconds": ((int, float), True)},
+    # single-pass profiles (runtime/singlepass.py, ISSUE 14): one per
+    # targeted pass-B re-bin a fused profile fell back to (edge
+    # misses); warm-edge profiles that skip the second scan emit
+    # nothing — absence is the steady-state signal
+    "singlepass_rebin": {"ts": ((int, float), True),
+                         "n_miss": ((int,), True),
+                         "columns": ((list,), True),
+                         "seconds": ((int, float), True),
+                         "origin": ((str,), True)},
 }
 
 

@@ -1,0 +1,453 @@
+"""Single-pass profiles (ISSUE 14 — runtime/singlepass.py).
+
+The identity contract under test: with ``profile_passes=fused`` every
+reported statistic is IDENTICAL to the two-pass structure's —
+edge-HIT columns byte-identical by construction (the fused counts ARE
+the pass-B counts), edge-MISS columns identical after the targeted
+re-bin.  Plus the mechanics around it: artifact seeding, the
+first-batch sketch, checkpoint/resume byte-stability, the streaming
+upgrade path, watch-mode hit rate 1.0 on an undrifted source, the
+runner-cache pass-structure key, and the ``singlepass_rebin`` fault
+site / event / metrics surface.
+"""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof import ProfilerConfig, obs
+from tpuprof.artifact import write_artifact
+from tpuprof.backends.tpu import HostAgg, TPUStatsBackend
+from tpuprof.report.export import stats_to_json
+
+pytestmark = pytest.mark.singlepass
+
+ROWS = 3000
+
+
+def _edge_case_df(rows=ROWS, seed=7):
+    """Every edge-miss shape the sweep needs: NaN-heavy, ±inf,
+    constant, all-NaN, int-ish, a bool, plus plain floats."""
+    rng = np.random.default_rng(seed)
+    inf_col = rng.normal(0, 1, rows).astype(np.float32)
+    inf_col[rng.choice(rows, 40, replace=False)] = np.inf
+    inf_col[rng.choice(rows, 40, replace=False)] = -np.inf
+    nan_col = rng.normal(5, 2, rows).astype(np.float32)
+    nan_col[rng.random(rows) < 0.4] = np.nan
+    return pd.DataFrame({
+        "plain": rng.normal(100, 15, rows).astype(np.float32),
+        "ints": rng.integers(0, 50, rows).astype(np.int64),
+        "with_nan": nan_col,
+        "with_inf": inf_col,
+        "const": np.full(rows, 2.5, dtype=np.float32),
+        "all_nan": np.full(rows, np.nan, dtype=np.float32),
+        "flag": rng.random(rows) < 0.3,
+    })
+
+
+@pytest.fixture
+def source(tmp_path):
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(_edge_case_df(),
+                                        preserve_index=False), path)
+    return path
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("batch_rows", 512)
+    return ProfilerConfig(**kw)
+
+
+def _export(stats):
+    return json.dumps(stats_to_json(stats), sort_keys=True, default=str)
+
+
+def _sp_counters():
+    snap = obs.registry().snapshot()["counters"]
+    return (sum(snap.get("tpuprof_singlepass_edge_hits_total",
+                         {}).values()),
+            sum(snap.get("tpuprof_singlepass_edge_misses_total",
+                         {}).values()))
+
+
+# ---------------------------------------------------------------------------
+# parity: fused == two-pass, hit or miss
+# ---------------------------------------------------------------------------
+
+def test_cold_fused_equals_two_pass(source):
+    """Cold start (first-batch sketch): whatever mix of hits (const,
+    all-NaN) and misses (everything else) the sketch produces, the
+    reported stats are byte-identical to two-pass."""
+    two = TPUStatsBackend().collect(source, _cfg())
+    fused = TPUStatsBackend().collect(
+        source, _cfg(profile_passes="fused"))
+    assert _export(two) == _export(fused)
+
+
+def test_warm_seeded_hits_every_lane_and_skips_scan_b(source):
+    """Artifact-seeded re-profile of unchanged data: every numeric
+    lane hits (bin_seeds cover bool/const/all-NaN lanes too), no
+    second scan runs, stats byte-identical."""
+    two = TPUStatsBackend().collect(source, _cfg())
+    art = source + ".artifact.json"
+    write_artifact(art, stats=two, config=_cfg())
+    h0, m0 = _sp_counters()
+    fused = TPUStatsBackend().collect(
+        source, _cfg(profile_passes="fused", seed_edges=art,
+                     metrics_enabled=True))
+    h1, m1 = _sp_counters()
+    assert _export(two) == _export(fused)
+    assert (h1 - h0) == 7 and (m1 - m0) == 0      # all lanes hit
+    assert "scan_b" not in (fused.get("_phases") or {})
+    assert "scan_b" in (two.get("_phases") or {})
+
+
+def test_drifted_seed_rebins_missed_lanes_identically(tmp_path, source):
+    """New-range + first-batch-outlier misses: seed from a DIFFERENT
+    distribution's artifact, profile a source whose global extremes sit
+    in the LAST batch (a sorted column — the cold sketch would miss it
+    too).  Missed lanes re-bin; output still byte-equals two-pass."""
+    df = _edge_case_df(seed=11)
+    # first-batch outlier: ascending column, max only in the last rows
+    df["sorted"] = np.sort(
+        np.random.default_rng(3).normal(0, 50, len(df))
+    ).astype(np.float32)
+    drifted = str(tmp_path / "drifted.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                   drifted)
+    base = TPUStatsBackend().collect(source, _cfg())
+    art = str(tmp_path / "seed.artifact.json")
+    write_artifact(art, stats=base, config=_cfg())
+    two = TPUStatsBackend().collect(drifted, _cfg())
+    h0, m0 = _sp_counters()
+    fused = TPUStatsBackend().collect(
+        drifted, _cfg(profile_passes="fused", seed_edges=art,
+                      metrics_enabled=True))
+    h1, m1 = _sp_counters()
+    assert _export(two) == _export(fused)
+    assert (m1 - m0) > 0                          # something re-binned
+
+
+def test_unusable_seed_degrades_to_sketch(tmp_path, source):
+    """A torn/garbage seed artifact is advisory: warn, sketch, still
+    byte-identical to two-pass."""
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        fh.write("{ not an artifact")
+    two = TPUStatsBackend().collect(source, _cfg())
+    fused = TPUStatsBackend().collect(
+        source, _cfg(profile_passes="fused", seed_edges=bad))
+    assert _export(two) == _export(fused)
+
+
+def test_fused_with_spearman_and_recount_still_identical(tmp_path):
+    """Cat columns (recount) + spearman force a second read even on a
+    full hit — the fused path must keep recount/spearman byte-exact
+    while adopting the hit lanes' counts."""
+    rng = np.random.default_rng(5)
+    df = pd.DataFrame({
+        "x": rng.normal(0, 1, 2000).astype(np.float32),
+        "y": rng.normal(9, 2, 2000).astype(np.float32),
+        "cat": rng.choice(["a", "b", "c", "dd"], 2000),
+    })
+    path = str(tmp_path / "mixed.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+    two = TPUStatsBackend().collect(
+        path, _cfg(spearman=True))
+    art = str(tmp_path / "m.artifact.json")
+    write_artifact(art, stats=two, config=_cfg(spearman=True))
+    fused = TPUStatsBackend().collect(
+        path, _cfg(spearman=True, profile_passes="fused",
+                   seed_edges=art))
+    assert _export(two) == _export(fused)
+
+
+def test_non_rescannable_fused_upgrades_hit_lanes(tmp_path, source):
+    """exact_passes=False (no second scan exists): hit lanes adopt the
+    exact histogram/MAD, miss lanes keep the sample tier — and a
+    two_pass run of the same config is matched exactly on the miss
+    lanes."""
+    two = TPUStatsBackend().collect(source, _cfg())
+    art = str(tmp_path / "s.artifact.json")
+    write_artifact(art, stats=two, config=_cfg())
+    sp_two = TPUStatsBackend().collect(source, _cfg(exact_passes=False))
+    sp_fused = TPUStatsBackend().collect(
+        source, _cfg(exact_passes=False, profile_passes="fused",
+                     seed_edges=art))
+    # warm seed + unchanged data: every lane hits, so the fused
+    # single-pass run reports the EXACT histogram the exact_passes
+    # run computed, where two_pass single-pass only had the sample
+    h_exact = two["variables"]["plain"]["histogram"]
+    h_fused = sp_fused["variables"]["plain"]["histogram"]
+    assert (h_fused[0] == h_exact[0]).all()
+    assert (h_fused[1] == h_exact[1]).all()
+    assert sp_fused["variables"]["plain"]["mad"] \
+        == two["variables"]["plain"]["mad"]
+    # the sample-tier fields not touched by adoption stay identical
+    assert sp_two["variables"]["plain"]["mean"] \
+        == sp_fused["variables"]["plain"]["mean"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+def test_fused_checkpoint_resume_byte_identical(tmp_path, source,
+                                                monkeypatch):
+    cfg_kw = dict(profile_passes="fused",
+                  checkpoint_path=str(tmp_path / "scan.ckpt"),
+                  checkpoint_every_batches=2)
+    control = TPUStatsBackend().collect(source,
+                                        _cfg(profile_passes="fused"))
+    calls = {"n": 0}
+    real_update = HostAgg.update
+
+    def crashing_update(self, hb):
+        calls["n"] += 1
+        if calls["n"] == 4:
+            raise RuntimeError("injected crash mid-scan")
+        return real_update(self, hb)
+
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        TPUStatsBackend().collect(source, _cfg(**cfg_kw))
+    monkeypatch.setattr(HostAgg, "update", real_update)
+    assert (tmp_path / "scan.ckpt").exists()
+    resumed = TPUStatsBackend().collect(source, _cfg(**cfg_kw))
+    assert _export(control) == _export(resumed)
+
+
+def test_fused_checkpoint_rejected_by_two_pass_resume(tmp_path, source,
+                                                      monkeypatch):
+    """profile_passes rides the checkpoint meta: a fused artifact
+    never resumes a two-pass run (the fused histogram fold would be
+    silently dropped)."""
+    from tpuprof.errors import InputError
+    cfg_kw = dict(profile_passes="fused",
+                  checkpoint_path=str(tmp_path / "scan.ckpt"),
+                  checkpoint_every_batches=2)
+    calls = {"n": 0}
+    real_update = HostAgg.update
+
+    def crashing_update(self, hb):
+        calls["n"] += 1
+        if calls["n"] == 4:
+            raise RuntimeError("injected crash mid-scan")
+        return real_update(self, hb)
+
+    monkeypatch.setattr(HostAgg, "update", crashing_update)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        TPUStatsBackend().collect(source, _cfg(**cfg_kw))
+    monkeypatch.setattr(HostAgg, "update", real_update)
+    with pytest.raises(InputError, match="profile_passes"):
+        TPUStatsBackend().collect(
+            source, _cfg(checkpoint_path=str(tmp_path / "scan.ckpt"),
+                         checkpoint_every_batches=2))
+
+
+# ---------------------------------------------------------------------------
+# streaming + incremental
+# ---------------------------------------------------------------------------
+
+def _micro_batches(n_batches=6, rows=700, seed=1):
+    rng = np.random.default_rng(seed)
+    return [pd.DataFrame({
+        "x": rng.normal(5, 2, rows).astype(np.float32),
+        "y": rng.integers(0, 100, rows).astype(np.float32),
+    }) for _ in range(n_batches)]
+
+
+def test_streaming_fused_checkpoint_resume_byte_stable(tmp_path):
+    from tpuprof.runtime.stream import StreamingProfiler
+    chunks = _micro_batches()
+    cfg = ProfilerConfig(batch_rows=512, profile_passes="fused")
+    p = StreamingProfiler.for_example(chunks[0].head(8), config=cfg)
+    for c in chunks[:3]:
+        p.update(c)
+    ck = str(tmp_path / "stream.ckpt")
+    p.checkpoint(ck)
+    for c in chunks[3:]:
+        p.update(c)
+    full = p.stats()
+    r = StreamingProfiler.restore(ck, config=cfg)
+    for c in chunks[3:]:
+        r.update(c)
+    assert _export(full) == _export(r.stats())
+
+
+def test_streaming_two_pass_restore_of_fused_checkpoint_rejected(
+        tmp_path):
+    from tpuprof.runtime.stream import StreamingProfiler
+    chunks = _micro_batches()
+    cfg = ProfilerConfig(batch_rows=512, profile_passes="fused")
+    p = StreamingProfiler.for_example(chunks[0].head(8), config=cfg)
+    for c in chunks[:2]:
+        p.update(c)
+    ck = str(tmp_path / "stream.ckpt")
+    p.checkpoint(ck)
+    with pytest.raises(ValueError, match="fused"):
+        StreamingProfiler.restore(
+            ck, config=ProfilerConfig(batch_rows=512))
+
+
+def test_incremental_resume_fused_matches_full_stream(tmp_path):
+    """resume_profiler(artifact) ⊕ update(delta) == one fused stream
+    over everything: the provisional edges ride the fold state, so the
+    resumed fold bins on the writer's bins."""
+    from tpuprof.artifact import resume_profiler
+    from tpuprof.runtime.stream import StreamingProfiler
+    # 512-row chunks on a 512-row device batch: the artifact write's
+    # force-drain lands exactly on a fold boundary — the alignment the
+    # PR-6 incremental byte-stability contract is defined at
+    chunks = _micro_batches(n_batches=6, rows=512)
+    cfg = ProfilerConfig(batch_rows=512, profile_passes="fused")
+    full = StreamingProfiler.for_example(chunks[0].head(8), config=cfg)
+    for c in chunks:
+        full.update(c)
+    part = StreamingProfiler.for_example(chunks[0].head(8), config=cfg)
+    for c in chunks[:3]:
+        part.update(c)
+    art = str(tmp_path / "stream.artifact.json")
+    write_artifact(art, profiler=part)
+    resumed = resume_profiler(art)
+    assert resumed._fused and resumed._sp_edges is not None
+    for c in chunks[3:]:
+        resumed.update(c)
+    assert _export(full.stats()) == _export(resumed.stats())
+
+
+# ---------------------------------------------------------------------------
+# watch mode: hit rate 1.0 by construction
+# ---------------------------------------------------------------------------
+
+def test_watch_fused_hit_rate_one_on_undrifted_source(tmp_path):
+    from tpuprof.serve import DriftWatcher, ProfileScheduler
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({
+        "qty": rng.integers(1, 51, 4000).astype(np.float32),
+        "price": rng.uniform(900, 2100, 4000).astype(np.float32),
+        "tax": (rng.integers(0, 9, 4000) / 100).astype(np.float32),
+    })
+    src = str(tmp_path / "watched.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), src)
+    sched = ProfileScheduler(workers=1)
+    try:
+        watcher = DriftWatcher(
+            str(tmp_path / "spool"), [src], sched, every_s=0, keep=3,
+            config_kwargs={"batch_rows": 512,
+                           "profile_passes": "fused",
+                           "metrics_enabled": True})
+        w = watcher.watches[0]
+        assert watcher.run_cycle(w)["status"] == "ok"    # cold sketch
+        h0, m0 = _sp_counters()
+        for _ in range(2):                               # warm cycles
+            assert watcher.run_cycle(w)["status"] == "ok"
+        h1, m1 = _sp_counters()
+    finally:
+        sched.shutdown()
+    assert m1 - m0 == 0, "warm watch cycle missed an edge"
+    assert h1 - h0 == 2 * 3                   # 2 cycles x 3 lanes
+    # seed flows cycle-over-cycle: the watcher stamped seed_edges
+    assert w.last_artifact and os.path.exists(w.last_artifact)
+
+
+# ---------------------------------------------------------------------------
+# serve runner-cache key, obs surface, fault site, elastic demotion
+# ---------------------------------------------------------------------------
+
+def test_runner_cache_key_separates_pass_structures():
+    from tpuprof.serve.cache import runner_key
+    two = _cfg()
+    fused = _cfg(profile_passes="fused")
+    k_two = runner_key(two, 4, 4)
+    k_fused = runner_key(fused, 4, 4)
+    assert k_two != k_fused
+    # seeded-edge PATHS must not key (a warm watch daemon's seed path
+    # changes every cycle; edges are runtime inputs, not structure)
+    seeded = _cfg(profile_passes="fused", seed_edges="/a/cycle1.json")
+    seeded2 = _cfg(profile_passes="fused", seed_edges="/a/cycle2.json")
+    assert runner_key(seeded, 4, 4) == k_fused
+    assert runner_key(seeded, 4, 4) == runner_key(seeded2, 4, 4)
+
+
+def test_rebin_event_and_fault_site(tmp_path, source):
+    from tpuprof.testing import faults
+    two = TPUStatsBackend().collect(source, _cfg())
+    art = str(tmp_path / "seed.artifact.json")
+    write_artifact(art, stats=two, config=_cfg())
+    # drifted data so the seed misses -> the re-bin pass runs
+    df = _edge_case_df(seed=99)
+    df["plain"] = df["plain"] * 7 + 1000
+    drifted = str(tmp_path / "d.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                   drifted)
+    sink = str(tmp_path / "events.jsonl")
+    stats = TPUStatsBackend().collect(
+        drifted, _cfg(profile_passes="fused", seed_edges=art,
+                      metrics_enabled=True, metrics_path=sink))
+    assert stats["table"]["n"] == len(df)
+    events = [json.loads(l) for l in open(sink)]
+    rebins = [e for e in events if e.get("kind") == "singlepass_rebin"]
+    assert len(rebins) == 1
+    ev = rebins[0]
+    assert ev["n_miss"] >= 1 and ev["origin"] == "artifact"
+    assert isinstance(ev["columns"], list) and ev["columns"]
+    assert ev["seconds"] >= 0
+    # the fault site: a fatal injection at the re-bin start escapes
+    faults.configure("singlepass_rebin:fatal@1")
+    try:
+        with pytest.raises(RuntimeError, match="injected fatal"):
+            TPUStatsBackend().collect(
+                drifted, _cfg(profile_passes="fused", seed_edges=art))
+    finally:
+        faults.reset()
+    # ...and a warm all-hit profile never reaches the site
+    faults.configure("singlepass_rebin:fatal@1")
+    try:
+        art2 = str(tmp_path / "seed2.artifact.json")
+        two2 = TPUStatsBackend().collect(drifted, _cfg())
+        write_artifact(art2, stats=two2, config=_cfg())
+        warm = TPUStatsBackend().collect(
+            drifted, _cfg(profile_passes="fused", seed_edges=art2))
+        assert _export(warm) == _export(two2)
+    finally:
+        faults.reset()
+
+
+def test_elastic_fused_demotes_to_two_pass(tmp_path, source):
+    """Elastic fleets have no cross-member edge-agreement seam: fused
+    demotes loudly and results equal the elastic two-pass run."""
+    def run(**kw):
+        return TPUStatsBackend().collect(
+            source, _cfg(elastic=True,
+                         fleet_dir=str(tmp_path / "fleet"),
+                         fleet_host_id="m1", **kw))
+    two = run()
+    fused = run(profile_passes="fused")
+    assert _export(two) == _export(fused)
+    assert "scan_b" in (fused.get("_phases") or {})   # really two-pass
+
+
+def test_artifact_sketches_carry_bin_seeds(tmp_path, source):
+    from tpuprof.artifact import read_artifact
+    stats = TPUStatsBackend().collect(source, _cfg())
+    art = str(tmp_path / "a.json")
+    write_artifact(art, stats=stats, config=_cfg())
+    sk = read_artifact(art).sketches
+    seeds = sk.get("bin_seeds")
+    assert seeds and set(seeds) == {
+        "plain", "ints", "with_nan", "with_inf", "const", "all_nan",
+        "flag"}
+    for triple in seeds.values():
+        assert len(triple) == 3
+        assert all(isinstance(v, float) for v in triple)
+    # f32 exactness: the sealed values ARE float32 values
+    for lo, hi, mean in seeds.values():
+        assert np.float32(lo) == lo and np.float32(hi) == hi \
+            and np.float32(mean) == mean

@@ -14,6 +14,14 @@ The restore path is the checkpoint's (stream.from_payload): native-hash
 provenance, sketch-shape and sampler-k mismatches are all rejected with
 the same messages, and a degraded prefix (quarantine manifest in the
 stored state) stays degraded in the incremental result.
+
+Single-pass interplay (ISSUE 14): an artifact written by a
+``profile_passes=fused`` profiler carries its provisional bin edges
+and histogram fold inside the state payload, so the resumed profiler
+keeps binning the delta onto the SAME bins — resume is byte-stable,
+and the artifact itself seals every lane's exact pass-B bounds as
+``sketches["bin_seeds"]`` for the next fused profile to seed from
+(runtime/singlepass.py).
 """
 
 from __future__ import annotations

@@ -159,7 +159,17 @@ def build_sketches(stats: Dict[str, Any]) -> Dict[str, Any]:
         topk[str(col)] = [
             {"value": json_scalar(idx), "count": int(cnt)}
             for idx, cnt in list(vc.items())[:TOPK_SKETCH_ROWS]]
-    return {"histograms": hists, "topk": topk}
+    out = {"histograms": hists, "topk": topk}
+    # pass-B bound seeds (runtime/singlepass.py): every numeric lane's
+    # exact f32 (lo, hi, mean) — the next fused profile of this source
+    # seeds its provisional bins from here, so an undrifted source
+    # skips its second scan entirely.  Absent from pre-singlepass
+    # artifacts (the seeder falls back to the histogram endpoints).
+    seeds = stats.get("_bin_seeds")
+    if seeds:
+        out["bin_seeds"] = {str(k): [float(x) for x in v]
+                            for k, v in seeds.items()}
+    return out
 
 
 def _encode_state(payload: Dict[str, Any]) -> Dict[str, Any]:

@@ -285,13 +285,22 @@ class _CollectCheckpoint:
     _META_KEYS = ("n_num", "n_hash", "batch_rows", "hll_precision",
                   "native_hash", "source_fp", "quantile_sketch_size",
                   "topk_capacity", "seed", "process_id", "process_count",
-                  "batch_enum", "exact_distinct", "nested")
+                  "batch_enum", "exact_distinct", "nested",
+                  "profile_passes")
 
     def __init__(self, config: ProfilerConfig, plan, runner, pshard,
-                 source_fp: str, table_source: bool = False):
+                 source_fp: str, table_source: bool = False,
+                 fused: bool = False):
         from tpuprof.config import resolve_checkpoint_keep
         self.pshard = pshard
         self.table_source = bool(table_source)
+        # single-pass artifacts carry the fused histogram state AND the
+        # provisional edges it was binned with (runtime/singlepass.py):
+        # a resume folding with different edges would mix bin layouts,
+        # so the edges ride the blob and profile_passes rides the meta
+        # (a fused artifact never resumes a two-pass run or vice versa)
+        self.fused = bool(fused)
+        self.extras = lambda: (None, None)      # () -> (hist_state, edges)
         path = config.checkpoint_path
         if pshard[1] > 1:
             path = f"{path}.h{pshard[0]}of{pshard[1]}"
@@ -336,7 +345,10 @@ class _CollectCheckpoint:
                 "exact_distinct": self.config.exact_distinct,
                 # the batch stream's CONTENT differs per policy (opaque
                 # columns carry no value stream) — no cross-policy resume
-                "nested": self.config.nested}
+                "nested": self.config.nested,
+                # fused artifacts carry a histogram state keyed to
+                # provisional edges; the pass structure must match
+                "profile_passes": "fused" if self.fused else "two_pass"}
 
     def save(self, state, sampler, hostagg, host_hll, cursor,
              frag_pos=None, quarantine=None, fleet_done=None) -> None:
@@ -360,6 +372,13 @@ class _CollectCheckpoint:
             # the same CRC envelope as everything else here.  Absent
             # for fixed-membership runs — payload bytes unchanged.
             blob["fleet_done"] = sorted(int(k) for k in fleet_done)
+        hist_state, edges = self.extras()
+        if hist_state is not None:
+            # the fused histogram fold rides the same npz archive as
+            # the pass-A state; the provisional edges ride the blob so
+            # resume folds the remaining stream onto the SAME bins
+            state = {"a": state, "hist": hist_state}
+            blob["singlepass_edges"] = edges.as_blob()
         ckpt.save(self.path, state, blob, cursor, meta=self._meta(),
                   keep=self.keep)
         # the new artifact no longer references runs demoted since the
@@ -393,7 +412,8 @@ class _CollectCheckpoint:
         # enumeration really did differ (window-v2), so absent != "v2"
         # must reject; for parquet sources both sides stamp None anyway.
         absent_defaults = {"process_id": 0, "process_count": 1,
-                           "exact_distinct": False, "nested": "stringify"}
+                           "exact_distinct": False, "nested": "stringify",
+                           "profile_passes": "two_pass"}
         from tpuprof.errors import InputError
         for key in self._META_KEYS:
             if meta.get(key, absent_defaults.get(key)) != mine[key]:
@@ -401,14 +421,25 @@ class _CollectCheckpoint:
                     f"checkpoint {key}={meta.get(key)!r} does not match "
                     f"this run's {mine[key]!r} — the batch stream or "
                     "sketch shapes would diverge from the saved prefix")
-        state = ckpt.materialize(payload, self.runner.init_pass_a())
         blob = payload["host_blob"]
+        sp_blob = blob.get("singlepass_edges")
+        hist_state = None
+        edges = None
+        if sp_blob is not None:
+            from tpuprof.runtime import singlepass as _sp
+            combined = ckpt.materialize(
+                payload, {"a": self.runner.init_pass_a(),
+                          "hist": self.runner.init_pass_b()})
+            state, hist_state = combined["a"], combined["hist"]
+            edges = _sp.ProvisionalEdges.from_blob(sp_blob)
+        else:
+            state = ckpt.materialize(payload, self.runner.init_pass_a())
         self.last_saved = payload["cursor"]
         log_event("collect_resume", cursor=payload["cursor"], path=used)
         return (state, blob["sampler"], blob["hostagg"],
                 blob["host_hll"], payload["cursor"],
                 blob.get("frag_pos"), blob.get("quarantine") or [],
-                blob.get("fleet_done"))
+                blob.get("fleet_done"), hist_state, edges)
 
     def clear(self) -> None:
         from tpuprof.runtime import checkpoint as ckpt
@@ -692,6 +723,26 @@ class TPUStatsBackend:
         plan = ingest.plan
         if not plan.specs:
             return _empty_stats(config)
+        # ---- single-pass profiles (ROADMAP 3(c); runtime/singlepass):
+        # fused mode folds moments AND histogram counts in one read of
+        # every batch, on provisional seeded edges; edge misses re-bin
+        # in a targeted column-subset pass B.  Multi-host and elastic
+        # topologies keep two passes: bin edges must come from the
+        # GLOBALLY merged moments, and cold-start provisional edges
+        # have no cross-member agreement seam — demote loudly.
+        from tpuprof.config import resolve_profile_passes
+        from tpuprof.runtime import singlepass as _sp
+        fused_scan = resolve_profile_passes(
+            getattr(config, "profile_passes", None)) == "fused" \
+            and plan.n_num > 0
+        if fused_scan and (pshard[1] > 1 or elastic):
+            from tpuprof.utils.trace import logger
+            logger.warning(
+                "profile_passes=fused is single-host only (multi-host/"
+                "elastic merges need globally agreed bin edges) — "
+                "running the two-pass structure; results are identical")
+            fused_scan = False
+        sp_seeds = _sp.resolve_seeds(config, plan) if fused_scan else None
         devices = self._devices
         if devices is None and pshard[1] > 1:
             # multi-process: a LOCAL mesh per host — each host scans its
@@ -770,17 +821,26 @@ class TPUStatsBackend:
                     config.liveness_timeout_s))
         resume = _CollectCheckpoint(config, plan, runner, pshard,
                                     ingest.fingerprint(),
-                                    table_source=ingest._table is not None) \
+                                    table_source=ingest._table is not None,
+                                    fused=fused_scan) \
             if config.checkpoint_path else None
         skip = 0
         resume_frag = None
         fleet_ck_done = None
         restored = resume is not None and resume.exists()
         state = None
+        state_h = None          # fused histogram fold (singlepass.py)
+        sp_edges = None         # the provisional edges it bins on
+        if resume is not None:
+            # checkpoint saves snapshot whatever the fused fold holds
+            # at flush time (None before the first real batch)
+            resume.extras = lambda: ((state_h, sp_edges) if fused_scan
+                                     else (None, None))
         if restored:
             try:
                 (state, sampler, hostagg, host_hll, skip,
-                 resume_frag, prior_q, fleet_ck_done) = resume.load()
+                 resume_frag, prior_q, fleet_ck_done,
+                 state_h, sp_edges) = resume.load()
                 # a degraded prefix stays degraded: the restored
                 # manifest keeps riding checkpoints and the final report
                 quarantine.seed(prior_q)
@@ -807,6 +867,7 @@ class TPUStatsBackend:
                     pshard[0], resume.path, exc)
                 restored = False
                 state, skip, resume_frag = None, 0, None
+                state_h, sp_edges = None, None
                 fleet_ck_done = None
                 quarantine.seed([])
                 hostagg = HostAgg(plan, config)
@@ -958,13 +1019,26 @@ class TPUStatsBackend:
                     fold_one(p)
             pending.clear()
 
+        sp_eds_d = None         # (lo, hi, mean) replicated device arrays
+
         def _staged_a(group):
-            nonlocal state
+            nonlocal state, state_h
+            if fused_scan and state_h is not None:
+                state, state_h = runner.scan_ab(
+                    state, state_h,
+                    runner.stage_batches(group, with_hll=with_hll),
+                    *sp_eds_d)
+                return
             state = runner.scan_a(
                 state, runner.stage_batches(group, with_hll=with_hll))
 
         def _one_a(p):
-            nonlocal state
+            nonlocal state, state_h
+            if fused_scan and state_h is not None:
+                state, state_h = runner.step_ab(
+                    state, state_h,
+                    runner.put_batch(p, with_hll=with_hll), *sp_eds_d)
+                return
             state = runner.step_a(
                 state, runner.put_batch(p, with_hll=with_hll))
 
@@ -1028,6 +1102,24 @@ class TPUStatsBackend:
             while isinstance(first_hb, _guard.PoisonBatch):
                 poisoned_head.append(first_hb)
                 first_hb = next(batches, None)
+            if fused_scan and first_hb is not None:
+                if sp_edges is None:
+                    # provisional edges: the artifact seed where one
+                    # resolved, first-batch sketch for the rest (cold
+                    # start, new columns).  A checkpoint restore
+                    # arrives with sp_edges already set — the resumed
+                    # fold MUST keep binning on the same edges.
+                    sp_edges = _sp.sketch_edges(first_hb.x,
+                                                first_hb.nrows,
+                                                into=sp_seeds)
+                if state_h is None:
+                    state_h = runner.init_pass_b()
+                sp_eds_d = (runner.put_replicated(sp_edges.lo,
+                                                  dtype=np.float32),
+                            runner.put_replicated(sp_edges.hi,
+                                                  dtype=np.float32),
+                            runner.put_replicated(sp_edges.mean,
+                                                  dtype=np.float32))
             if state is None:
                 shift = merge_shift_estimates(
                     estimate_shift(first_hb)
@@ -1139,10 +1231,14 @@ class TPUStatsBackend:
         # GLOBALLY merged moments or each host would bin differently.
         bounds_d = None
         if pshard[1] == 1 and fleet_member is None \
-                and config.exact_passes and plan.n_num > 0:
+                and config.exact_passes and plan.n_num > 0 \
+                and not fused_scan:
             # elastic fleets keep the host recipe too: bin edges must
             # come from the FLEET-merged moments or members would bin
-            # differently
+            # differently.  Fused profiles have no pass B to overlap —
+            # the hit check and any targeted re-bin use the host
+            # recipe (singlepass.exact_bounds_f32, the device twin's
+            # parity-pinned equal).
             bounds_d = runner.bounds_b_device(state)
         fleet_regs = None
         fleet_q: Optional[List] = None
@@ -1201,17 +1297,75 @@ class TPUStatsBackend:
         recounter: Optional[Recounter] = None
         rho_spear: Optional[np.ndarray] = None
         spear_approx = False
-        if config.exact_passes and ingest.rescannable and plan.n_num > 0 \
-                and hostagg.n_rows > 0:
-            recounter = Recounter(hostagg)
-            state_b = runner.init_pass_b()
-            if bounds_d is not None:
-                lo_d, hi_d, mean_d = bounds_d
+        exact_lanes: Optional[np.ndarray] = None
+        run_pass_b = config.exact_passes and ingest.rescannable \
+            and plan.n_num > 0 and hostagg.n_rows > 0
+        # fused adoption (runtime/singlepass.py): finalize the fused
+        # histogram fold, compare the provisional edges against the
+        # exact pass-A bounds, and decide what — if anything — a
+        # second scan still owes: a targeted re-bin of the missed
+        # lanes, the top-k recount, the Spearman rank pass, or nothing
+        # (the warm-edge single-pass fast path).
+        res_h = None
+        sp_hits = None
+        sp_exact = None
+        rebin_lanes: Optional[np.ndarray] = None
+        res_b_adopted = None
+        if fused_scan and state_h is not None and hostagg.n_rows > 0:
+            res_h = runner.finalize_b(state_h)
+            sp_hits, sp_exact = _sp.hit_lanes(sp_edges, momf)
+            _sp.record_outcome(sp_hits)
+            need_recount = bool(hostagg.mg)
+            if run_pass_b:
+                if sp_hits.all() and not need_recount \
+                        and not config.spearman:
+                    # every edge held and nothing else needs a second
+                    # read: the profile is complete after ONE scan
+                    run_pass_b = False
+                    res_b_adopted = dict(res_h)
+                else:
+                    rebin_lanes = np.nonzero(~sp_hits)[0]
             else:
-                lo, hi, mean_c = khistogram.pass_b_bounds(momf)
-                lo_d = runner.put_replicated(lo, dtype=np.float32)
-                hi_d = runner.put_replicated(hi, dtype=np.float32)
-                mean_d = runner.put_replicated(mean_c, dtype=np.float32)
+                # no second scan exists (non-rescannable source or
+                # exact_passes=False): adopt the exact histogram/MAD
+                # where the edges held, keep the sample tier elsewhere
+                res_b_adopted = dict(res_h)
+                if not sp_hits.all():
+                    exact_lanes = sp_hits
+        if run_pass_b:
+            recounter = Recounter(hostagg)
+            rebin_names: List[str] = []
+            if rebin_lanes is not None:
+                # fused targeted re-bin: device work only for the
+                # missed columns, with the EXACT bounds the hit check
+                # compared against (subset of the same f32 arrays)
+                state_b = runner.init_pass_b(len(rebin_lanes)) \
+                    if len(rebin_lanes) else None
+                if len(rebin_lanes):
+                    _faults.hit("singlepass_rebin")
+                    lo_e, hi_e, mean_e = sp_exact
+                    lo_d = runner.put_replicated(lo_e[rebin_lanes],
+                                                 dtype=np.float32)
+                    hi_d = runner.put_replicated(hi_e[rebin_lanes],
+                                                 dtype=np.float32)
+                    mean_d = runner.put_replicated(mean_e[rebin_lanes],
+                                                   dtype=np.float32)
+                    lane_names = {s.num_lane: s.name for s in plan.specs
+                                  if s.role == "num"}
+                    rebin_names = [str(lane_names[i])
+                                   for i in rebin_lanes.tolist()]
+                else:
+                    lo_d = hi_d = mean_d = None
+            else:
+                state_b = runner.init_pass_b()
+                if bounds_d is not None:
+                    lo_d, hi_d, mean_d = bounds_d
+                else:
+                    lo, hi, mean_c = khistogram.pass_b_bounds(momf)
+                    lo_d = runner.put_replicated(lo, dtype=np.float32)
+                    hi_d = runner.put_replicated(hi, dtype=np.float32)
+                    mean_d = runner.put_replicated(mean_c,
+                                                   dtype=np.float32)
             spear_state = None
             if config.spearman:
                 spear_state = runner.init_spearman()
@@ -1267,21 +1421,56 @@ class TPUStatsBackend:
                 return runner.step_spearman(st, db_or_sb, sorted_sample,
                                             kept_counts)
 
+            import dataclasses as _dc
+
+            def _hist_view(hb):
+                """The batch the histogram fold consumes: whole for
+                two-pass, the missed-column slice for a fused re-bin
+                (the subset ships instead of the full plane — at small
+                miss counts the transfer shrinks proportionally)."""
+                if rebin_lanes is None:
+                    return hb
+                return _dc.replace(hb, x=hb.x[:, rebin_lanes])
+
             def _staged_b(group):
                 """Full groups take the staged scan_b dispatch, and the
                 Spearman state folds from the SAME staged placement —
-                one transfer feeds both."""
+                one transfer feeds both.  A fused re-bin's hist fold
+                takes its own column-subset placement (Spearman, when
+                on, still needs the full plane)."""
                 nonlocal state_b, spear_state
-                sb = runner.stage_batches(group, with_hll=False)
-                state_b = runner.scan_b(state_b, sb, lo_d, hi_d, mean_d)
+                if rebin_lanes is None:
+                    sb = runner.stage_batches(group, with_hll=False)
+                    state_b = runner.scan_b(state_b, sb, lo_d, hi_d,
+                                            mean_d)
+                    if spear_state is not None:
+                        spear_state = fold_spear(spear_state, sb, True)
+                    return
+                if state_b is not None:
+                    sb_sub = runner.stage_batches(
+                        [_hist_view(p) for p in group], with_hll=False)
+                    state_b = runner.scan_b(state_b, sb_sub, lo_d, hi_d,
+                                            mean_d)
                 if spear_state is not None:
+                    sb = runner.stage_batches(group, with_hll=False)
                     spear_state = fold_spear(spear_state, sb, True)
 
             def _one_b(p):
                 nonlocal state_b, spear_state
-                db = runner.put_batch(p, with_hll=False)
-                state_b = runner.step_b(state_b, db, lo_d, hi_d, mean_d)
+                if rebin_lanes is None:
+                    db = runner.put_batch(p, with_hll=False)
+                    state_b = runner.step_b(state_b, db, lo_d, hi_d,
+                                            mean_d)
+                    if spear_state is not None:
+                        spear_state = fold_spear(spear_state, db, False)
+                    return
+                if state_b is not None:
+                    db_sub = runner.put_batch(_hist_view(p),
+                                              with_hll=False)
+                    state_b = runner.step_b(state_b, db_sub, lo_d, hi_d,
+                                            mean_d)
                 if spear_state is not None:
+                    db = runner.put_batch(p, with_hll=False)
                     spear_state = fold_spear(spear_state, db, False)
 
             def flush_b(pending):
@@ -1321,6 +1510,8 @@ class TPUStatsBackend:
                         "spear": runner.finalize_spearman(sp_st)
                         if sp_st is not None else None}
 
+            import time as _time
+            _t0_b = _time.perf_counter()
             with span("scan_b", spearman=config.spearman):
                 # hashes=False: pass B never reads the HLL plane, so the
                 # host hash loop is skipped on the second scan
@@ -1364,12 +1555,24 @@ class TPUStatsBackend:
                     recounter.counts = counts
                     spear_state = None     # finalized + merged above
                 else:
-                    res_b = merge_pass_b_states(runner.finalize_b(state_b))
+                    res_b = merge_pass_b_states(
+                        runner.finalize_b(state_b)) \
+                        if state_b is not None else None
                     recounter.counts = merge_recount_arrays(
                         recounter.counts)
             if spear_state is not None:
                 rho_spear = kcorr.finalize(merge_corr_states(
                     runner.finalize_spearman(spear_state)))
+            if rebin_lanes is not None:
+                # fused: hit lanes keep their single-scan counts, miss
+                # lanes adopt the exact re-bin — identical to two-pass
+                # lane for lane
+                if len(rebin_lanes) and res_b is not None:
+                    res_b = _sp.merge_rebinned(res_h, res_b, rebin_lanes)
+                    _sp.record_rebin(_time.perf_counter() - _t0_b,
+                                     rebin_names, sp_edges.origin)
+                else:
+                    res_b = dict(res_h)
             hists, mad = khistogram.finalize(
                 res_b, momf["fmin"], momf["fmax"], momf["n"], config.bins)
         elif config.spearman and hostagg.n_rows > 0 and plan.n_num > 1:
@@ -1387,8 +1590,17 @@ class TPUStatsBackend:
                 "%d-row sample (rank error ~%.3f)",
                 min(sampler.values.shape[0], sampler.k),
                 1.0 / np.sqrt(max(sampler.k, 1)))
+        if fused_scan and res_b_adopted is not None:
+            # warm-edge single pass (or the no-second-scan tier):
+            # finalize the adopted counts; exact_lanes (when set) gates
+            # per-lane adoption in _numeric_stats — miss lanes keep the
+            # sample-derived tier exactly as two-pass single-pass would
+            hists, mad = khistogram.finalize(
+                res_b_adopted, momf["fmin"], momf["fmax"], momf["n"],
+                config.bins)
         if recounter is None and config.exact_passes \
-                and ingest.rescannable and hostagg.n_rows > 0:
+                and ingest.rescannable and hostagg.n_rows > 0 \
+                and not (fused_scan and res_b_adopted is not None):
             # no numeric columns — only the top-k recount matters.
             # hashes=False: the recount reads categorical codes only, so
             # the host hash + HLL-packing loop is skipped on this scan.
@@ -1447,7 +1659,8 @@ class TPUStatsBackend:
                           hostagg, momf, rho_all, quants, sample_vals,
                           sample_kept, hll_est, hists, mad, recounter,
                           probes, rho_spear=rho_spear,
-                          spear_approx=spear_approx)
+                          spear_approx=spear_approx,
+                          exact_lanes=exact_lanes)
         q_entries = quarantine.entries
         if fleet_member is not None:
             # the fleet's pass-A skips rode the contribution parts
@@ -1528,7 +1741,8 @@ def _sample_mode(values: np.ndarray, kept: np.ndarray) -> float:
 
 def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
               sample_vals, sample_kept, hll_est, hists, mad, recounter,
-              probes, rho_spear=None, spear_approx=False) -> Dict[str, Any]:
+              probes, rho_spear=None, spear_approx=False,
+              exact_lanes=None) -> Dict[str, Any]:
     n = hostagg.n_rows
     variables: Dict[str, Dict[str, Any]] = {}
     freq: Dict[str, pd.Series] = {}
@@ -1652,7 +1866,8 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
         if kind == schema.NUM:
             stats.update(_numeric_stats(spec.num_lane, spec, momf, quants,
                                         sample_vals, sample_kept, hists,
-                                        mad, probes, config))
+                                        mad, probes, config,
+                                        exact_lanes=exact_lanes))
         elif kind == schema.BOOL:
             # same FIELD SET as the oracle's describe_bool_1d (categorical
             # fields + mean) — the dict contract must not vary by backend
@@ -1706,6 +1921,16 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
         stats["type"] = kind
         variables[name] = stats
 
+    # pass-B bound seeds for the NEXT profile's fused scan: every
+    # numeric lane's exact f32 (lo, hi, mean), sealed into artifacts as
+    # sketches["bin_seeds"] (artifact/store.build_sketches) so an
+    # undrifted source's next fused cycle hits on every lane.  A
+    # private key like _phases/_obs: never exported, never rendered.
+    if plan.n_num > 0:
+        from tpuprof.runtime import singlepass as _sp_seeds
+        bin_seeds = _sp_seeds.bin_seeds(plan, momf)
+    else:
+        bin_seeds = {}
     table = schema.make_table_stats(
         n, variables,
         memorysize=float(sum(hostagg.memorysize(c)
@@ -1721,7 +1946,7 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
         # ~1/sqrt(K) rank error) — .attrs rides pandas copies
         spear_df.attrs["approx"] = bool(spear_approx)
         correlations["spearman"] = spear_df
-    return {
+    out = {
         "table": table,
         "variables": variables,
         "freq": freq,
@@ -1729,10 +1954,14 @@ def _assemble(plan, config, sample_df, hostagg, momf, rho_all, quants,
         "messages": messages,
         "sample": sample_df,
     }
+    if bin_seeds:
+        out["_bin_seeds"] = bin_seeds
+    return out
 
 
 def _numeric_stats(lane, spec, momf, quants, sample_vals, sample_kept,
-                   hists, mad, probes, config) -> Dict[str, Any]:
+                   hists, mad, probes, config,
+                   exact_lanes=None) -> Dict[str, Any]:
     out = {
         "mean": float(momf["mean"][lane]),
         "std": float(momf["std"][lane]),
@@ -1753,12 +1982,18 @@ def _numeric_stats(lane, spec, momf, quants, sample_vals, sample_kept,
     for idx, p in enumerate(probes):
         out[schema.QUANTILE_FIELDS[p]] = float(quants[idx, lane])
     out["iqr"] = out["p75"] - out["p25"]
-    if mad is not None:
+    # a fused profile with no second scan adopts the exact histogram/
+    # MAD only for lanes whose provisional edges held (exact_lanes —
+    # runtime/singlepass.py); miss lanes keep the sample tier exactly
+    # as two-pass single-pass mode would.  None = every lane exact
+    # (the historical meaning of hists/mad being present).
+    lane_exact = exact_lanes is None or bool(exact_lanes[lane])
+    if mad is not None and lane_exact:
         out["mad"] = float(mad[lane])
     else:  # single-pass mode: MAD from the uniform sample
         v = sample_vals[lane][sample_kept[lane]]
         out["mad"] = float(np.abs(v - v.mean()).mean()) if v.size else np.nan
-    if hists is not None:
+    if hists is not None and lane_exact:
         out["histogram"] = hists[lane]
     else:  # single-pass mode: sample-scaled histogram
         v = sample_vals[lane][sample_kept[lane]]

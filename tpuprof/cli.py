@@ -54,6 +54,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "Both are bit-for-bin identical; legacy is the "
                         "rollback if the cumulative kernel regresses on "
                         "a given chip")
+    p.add_argument("--profile-passes", default=None,
+                   choices=("two_pass", "fused"),
+                   help="profile pass structure (default: "
+                        "TPUPROF_PROFILE_PASSES env, else two_pass). "
+                        "fused folds moments AND histogram counts in "
+                        "one read of every batch on provisional seeded "
+                        "bin edges (--seed-edges / watch artifacts; "
+                        "first-batch sketch cold) — edge misses re-bin "
+                        "in a targeted column-subset pass, so results "
+                        "are identical either way; warm edges skip the "
+                        "second scan entirely")
+    p.add_argument("--seed-edges", metavar="ARTIFACT", default=None,
+                   help="seed fused-profile provisional bin edges from "
+                        "this tpuprof-stats-v1 artifact of the same "
+                        "source (default: TPUPROF_SEED_EDGES env, else "
+                        "first-batch sketch).  Advisory: a torn or "
+                        "mismatched artifact degrades to the sketch "
+                        "with a warning")
     p.add_argument("--sketch-size", type=int, default=4096,
                    help="quantile sample-sketch size K")
     p.add_argument("--hll-precision", type=int, default=11)
@@ -401,6 +419,13 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="X",
                    help="KS distance at or above X alerts at drift "
                         "severity (default 0.2; warn band at half)")
+    w.add_argument("--profile-passes", default=None,
+                   choices=("two_pass", "fused"),
+                   help="pass structure for the watch's profile jobs "
+                        "(default: TPUPROF_PROFILE_PASSES env, else "
+                        "two_pass).  fused: each cycle seeds bin edges "
+                        "from the previous cycle's artifact and an "
+                        "undrifted source profiles in ONE scan")
     w.add_argument("--job-timeout", type=float, default=None,
                    dest="job_timeout_s", metavar="SEC",
                    help="per-job watchdog: a hung cycle profile fails "
@@ -940,6 +965,8 @@ def cmd_watch(args: argparse.Namespace) -> int:
     except ValueError as exc:
         print(f"tpuprof: error: --config-json: {exc}", file=sys.stderr)
         return 2
+    if getattr(args, "profile_passes", None):
+        config_kwargs.setdefault("profile_passes", args.profile_passes)
     blackbox.install_signal_handlers()
     cache_dir = _resolve_cache_dir(args)
     if cache_dir:
@@ -1231,6 +1258,8 @@ def cmd_profile(args: argparse.Namespace) -> int:
             prepare_workers=args.prepare_workers,
             prep_workers=args.prep_workers,
             pass_b_kernel=args.pass_b_kernel,
+            profile_passes=args.profile_passes,
+            seed_edges=args.seed_edges,
             quantile_sketch_size=args.sketch_size,
             hll_precision=args.hll_precision,
             exact_passes=not args.single_pass,

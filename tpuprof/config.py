@@ -385,6 +385,47 @@ def resolve_warehouse_format(value: Optional[str] = None) -> str:
     return "parquet"
 
 
+PROFILE_PASSES = ("two_pass", "fused")
+
+
+def resolve_profile_passes(value: Optional[str] = None) -> str:
+    """Profile pass structure: an explicit config value wins; else
+    ``TPUPROF_PROFILE_PASSES``; else ``two_pass`` (the historical
+    scan_a + scan_b structure, byte-identical defaults).  ``fused``
+    folds moments AND histogram counts in a SINGLE read of every batch,
+    binning on *provisional* per-column edges (seeded from a previous
+    ``tpuprof-stats-v1`` artifact — watch cycles, ``resume_profiler``,
+    ``seed_edges`` — or a first-batch sketch on cold starts); columns
+    whose provisional edges match the exact pass-A bounds keep their
+    counts (byte-identical to two_pass by construction), the rest
+    re-bin in a targeted column-subset pass B.  Warm-edge profiles
+    (watch mode, repeat serve jobs) skip the second scan entirely."""
+    for cand, origin in ((value, "profile_passes"),
+                         (os.environ.get("TPUPROF_PROFILE_PASSES"),
+                          "TPUPROF_PROFILE_PASSES")):
+        if cand:
+            if cand not in PROFILE_PASSES:
+                raise ValueError(
+                    f"{origin}={cand!r} — use one of {PROFILE_PASSES}")
+            return cand
+    return "two_pass"
+
+
+def resolve_seed_edges(value: Optional[str] = None) -> Optional[str]:
+    """Provisional-bin-edge seed for ``profile_passes=fused``: path to
+    a previous ``tpuprof-stats-v1`` artifact of the same source whose
+    per-column histogram edges/means seed the fused scan's provisional
+    bins (``tpuprof watch`` sets this automatically to cycle N−1's
+    artifact).  Explicit config value, else ``TPUPROF_SEED_EDGES``,
+    else None = first-batch sketch.  Advisory: an unreadable or
+    column-mismatched seed degrades to the sketch with a warning,
+    never fails the profile (edges are a performance hint — misses
+    re-bin, so results are identical either way)."""
+    if value:
+        return str(value)
+    return os.environ.get("TPUPROF_SEED_EDGES") or None
+
+
 PASS_B_KERNELS = ("cumulative", "legacy")
 
 
@@ -867,6 +908,35 @@ class ProfilerConfig:
                                         # single-read fused pallas pass A
                                         # (kernels/fused.py) vs the
                                         # per-kernel XLA formulation
+    profile_passes: Optional[str] = None  # "two_pass" (scan_a then
+                                          # scan_b — the historical
+                                          # structure) or "fused" (one
+                                          # read of every batch folds
+                                          # moments AND histogram
+                                          # counts on provisional
+                                          # seeded edges; edge misses
+                                          # re-bin in a targeted
+                                          # column-subset pass —
+                                          # runtime/singlepass.py).
+                                          # None = auto: TPUPROF_
+                                          # PROFILE_PASSES env, else
+                                          # two_pass.  Results are
+                                          # identical either way
+                                          # (test-pinned); fused skips
+                                          # the second scan when the
+                                          # seeded edges hit.  CLI:
+                                          # --profile-passes
+    seed_edges: Optional[str] = None    # provisional-edge seed for
+                                        # fused profiles: path to a
+                                        # previous tpuprof-stats-v1
+                                        # artifact of this source
+                                        # (watch sets it to cycle
+                                        # N−1's artifact).  None =
+                                        # auto: TPUPROF_SEED_EDGES
+                                        # env, else first-batch
+                                        # sketch.  Advisory — a bad
+                                        # seed only costs the re-bin
+                                        # pass.  CLI: --seed-edges
 
     # ---- quantiles reported (reference: approxQuantile probes) ------------
     quantile_probes: Sequence[float] = (0.05, 0.25, 0.5, 0.75, 0.95)
@@ -913,6 +983,12 @@ class ProfilerConfig:
             raise ValueError("prepare_workers must be >= 1 (or None)")
         if self.prep_workers is not None and self.prep_workers < 1:
             raise ValueError("prep_workers must be >= 1 (or None)")
+        if self.profile_passes is not None \
+                and self.profile_passes not in PROFILE_PASSES:
+            raise ValueError(
+                f"profile_passes={self.profile_passes!r} — use one of "
+                f"{PROFILE_PASSES} (or None for the "
+                "TPUPROF_PROFILE_PASSES/default resolution)")
         if self.pass_b_kernel is not None \
                 and self.pass_b_kernel not in PASS_B_KERNELS:
             raise ValueError(

@@ -45,6 +45,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from tpuprof.kernels import corr as kcorr
+from tpuprof.kernels import histogram
 from tpuprof.kernels import moments as kmoments
 from tpuprof.obs import blackbox as _blackbox
 from tpuprof.obs import metrics as _obs_metrics
@@ -412,7 +413,165 @@ def update(mom: Dict[str, Array], co: Dict[str, Array], xt: Array,
         else _fused_tiles_wide
     sums, counts, P, S1, S2, N = tiles(
         xt, row_valid, mom["shift"], interpret=interpret)
-    mom_out = {
+    return _fold_mom(mom, sums, counts), _fold_corr(co, P, S1, S2, N)
+
+
+def update_xla(mom: Dict[str, Array], co: Dict[str, Array], xt: Array,
+               row_valid: Array) -> Tuple[Dict[str, Array], Dict[str, Array]]:
+    """The XLA twin (CPU meshes, fallback): the pre-existing per-kernel
+    formulation, same state contract."""
+    x = xt.T
+    return (kmoments.update(mom, x, row_valid),
+            kcorr.update(co, x, row_valid))
+
+
+# ---------------------------------------------------------------------------
+# Single-pass combined kernel: pass A + provisional-edge histogram
+# (profile_passes=fused — runtime/singlepass.py)
+# ---------------------------------------------------------------------------
+#
+# The two-pass structure reads every batch from HBM twice (and, far
+# worse e2e, ingests/preps/ships it from the host twice).  With
+# provisional bin edges known UP FRONT (artifact-seeded or sketched
+# from the first batch), this kernel folds the narrow pass-A state AND
+# the histogram/MAD accumulators in literally one read of the tile:
+# the same _masks/Gram/stats blocks as _kernel, plus the pass-B tile
+# accumulation shared with pallas_hist (hist_tile_* — so both dispatch
+# shapes count bit-for-bin identically).  VMEM adds one (C, nbins)
+# int32 block and a (C, 1) dev block over _kernel's budget; the row
+# tile is halved as margin (conservative pending an on-chip compile
+# probe — the chip tunnel is down this round, PERF.md round 10).
+#
+# Wide tables (cols > MAX_FUSED_AB_COLS) keep two programs: back-to-
+# back pallas calls in one XLA module trip Mosaic's scoped-VMEM
+# accounting (PERF.md), so the mesh runtime dispatches the column-
+# tiled pass-A kernel and the pallas histogram as a PAIRED dispatch
+# over one staged placement instead — still one host
+# read/prep/transfer per batch, and byte-trivially identical to
+# two-pass (the very same compiled programs run).
+
+#: width cap of the combined single-pass kernel.  Starts at the
+#: narrow pass-A kernel's limit (the combined kernel shares its tile
+#: geometry — identity requires it); an on-chip VMEM probe may lower
+#: it independently without touching pass-A behavior.
+MAX_FUSED_AB_COLS = MAX_FUSED_COLS
+
+def _kernel_ab(xt_ref, rv_ref, shift_ref, lo_ref, scale_ref, mean_ref,
+               sums_ref, counts_ref, gram1_ref, gram2_ref, hist_ref,
+               dev_ref, *, nbins: int, hist_kernel: str):
+    from tpuprof.kernels import pallas_hist as ph
+    i = pl.program_id(0)
+    x = xt_ref[...]                       # (C, R)
+    rv = rv_ref[...] > 0                  # (1, R)
+    shift = shift_ref[...]                # (C, 1)
+
+    masks = _masks(x, rv, shift)
+    finite, m, d, d2 = masks[2], masks[3], masks[4], masks[5]
+
+    dm = jnp.concatenate([d, m], axis=0)
+    g1 = jax.lax.dot_general(d, dm, (((1,), (1,)), ((), ())),
+                             precision=_HI,
+                             preferred_element_type=jnp.float32)
+    d2m = jnp.concatenate([d2, m], axis=0)
+    g2 = jax.lax.dot_general(d2m, m, (((1,), (1,)), ((), ())),
+                             precision=_HI,
+                             preferred_element_type=jnp.float32)
+
+    hist = ph.HIST_TILES[hist_kernel](x, finite, lo_ref[...],
+                                      scale_ref[...], nbins)
+    dev = ph.mad_tile(x, finite, mean_ref[...])
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[...] = _stats_identity(sums_ref.shape)
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+        gram1_ref[...] = jnp.zeros_like(gram1_ref)
+        gram2_ref[...] = jnp.zeros_like(gram2_ref)
+        hist_ref[...] = jnp.zeros_like(hist_ref)
+        dev_ref[...] = jnp.zeros_like(dev_ref)
+
+    _accumulate_stats(sums_ref, counts_ref, x, rv, masks)
+    gram1_ref[...] += g1
+    gram2_ref[...] += g2
+    hist_ref[...] += hist
+    dev_ref[...] += dev
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nbins", "hist_kernel", "interpret"))
+def _fused_ab_tiles(xt: Array, row_valid: Array, shift: Array,
+                    lo: Array, hi: Array, mean: Array, nbins: int,
+                    hist_kernel: str = "cumulative",
+                    interpret: bool = False):
+    cols, rows = xt.shape
+    cpad = -cols % C_ALIGN
+    C = cols + cpad
+    # the SAME row tile as the separate pass-A kernel — load-bearing
+    # for the identity contract: a different tile count would regroup
+    # the f32 += accumulation across tiles and the fused moments/Gram
+    # sums would drift a ulp from two-pass's.  The hist block rides on
+    # top of _kernel's VMEM budget; if the on-chip compile probe (chip
+    # tunnel down this round) shows an overflow at the upper widths,
+    # lower MAX_FUSED_AB_COLS — over-cap widths take the mesh's paired
+    # dispatch, which reuses the two-pass programs verbatim
+    r_tile = _pick_r_tile(C)
+    rpad = -rows % r_tile
+    xt_p = jnp.pad(xt, ((0, cpad), (0, rpad)), constant_values=jnp.nan)
+    rv_p = jnp.pad(row_valid.astype(jnp.float32), (0, rpad))[None, :]
+    shift_p = jnp.pad(shift.astype(jnp.float32), (0, cpad))[:, None]
+    lo_p = jnp.pad(lo.astype(jnp.float32), (0, cpad))[:, None]
+    # the SAME scale recipe as pallas_hist.histogram_tiles — bit-equal
+    # inputs are what make fused counts byte-identical to pass B's
+    width = jnp.maximum(hi - lo, 1e-30).astype(jnp.float32)
+    scale_p = jnp.pad(nbins / width, (0, cpad))[:, None]
+    mean_p = jnp.pad(mean.astype(jnp.float32), (0, cpad))[:, None]
+    n_rt = (rows + rpad) // r_tile
+    out = pl.pallas_call(
+        functools.partial(_kernel_ab, nbins=nbins,
+                          hist_kernel=hist_kernel),
+        grid=(n_rt,),
+        in_specs=[
+            pl.BlockSpec((C, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((1, r_tile), lambda i: (0, i)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((C, 8), lambda i: (0, 0)),
+            pl.BlockSpec((C, 8), lambda i: (0, 0)),
+            pl.BlockSpec((C, 2 * C), lambda i: (0, 0)),
+            pl.BlockSpec((2 * C, C), lambda i: (0, 0)),
+            pl.BlockSpec((C, nbins), lambda i: (0, 0)),
+            pl.BlockSpec((C, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((C, 8), jnp.float32),
+            jax.ShapeDtypeStruct((C, 8), jnp.int32),
+            jax.ShapeDtypeStruct((C, 2 * C), jnp.float32),
+            jax.ShapeDtypeStruct((2 * C, C), jnp.float32),
+            jax.ShapeDtypeStruct((C, nbins), jnp.int32),
+            jax.ShapeDtypeStruct((C, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xt_p, rv_p, shift_p, lo_p, scale_p, mean_p)
+    sums, counts, g1, g2, hist, dev = out
+    if hist_kernel == "cumulative":
+        # differenced OUTSIDE the kernel, exactly as histogram_tiles
+        # does for the standalone pass-B program
+        from tpuprof.kernels.histogram import counts_from_cumulative
+        hist = counts_from_cumulative(hist)
+    return ((sums[:cols], counts[:cols])
+            + _slice_grams(g1, g2, cols, C)
+            + (hist[:cols], dev[:cols, 0]))
+
+
+def _fold_mom(mom: Dict[str, Array], sums: Array, counts: Array
+              ) -> Dict[str, Array]:
+    """Fold one batch's (C, 8) sums/counts blocks into a moments.py
+    state — the update()/update_with_hist() shared epilogue."""
+    return {
         "shift": mom["shift"],
         "n": mom["n"] + counts[:, 0],
         "s1": mom["s1"] + sums[:, 0],
@@ -427,16 +586,44 @@ def update(mom: Dict[str, Array], co: Dict[str, Array], xt: Array,
         "n_inf": mom["n_inf"] + counts[:, 2],
         "n_missing": mom["n_missing"] + counts[:, 3],
     }
-    return mom_out, _fold_corr(co, P, S1, S2, N)
 
 
-def update_xla(mom: Dict[str, Array], co: Dict[str, Array], xt: Array,
-               row_valid: Array) -> Tuple[Dict[str, Array], Dict[str, Array]]:
-    """The XLA twin (CPU meshes, fallback): the pre-existing per-kernel
-    formulation, same state contract."""
-    x = xt.T
-    return (kmoments.update(mom, x, row_valid),
-            kcorr.update(co, x, row_valid))
+def update_with_hist(mom: Dict[str, Array], co: Dict[str, Array],
+                     hist: Dict[str, Array], xt: Array, row_valid: Array,
+                     lo: Array, hi: Array, mean: Array,
+                     hist_kernel: str = "cumulative",
+                     interpret: bool = False):
+    """Fold one batch into the moments + corr + histogram states with a
+    SINGLE pallas read of the batch (narrow widths —
+    ``xt.shape[0] <= MAX_FUSED_COLS``; the mesh runtime pairs two
+    dispatches beyond that).  ``lo``/``hi``/``mean`` are the
+    provisional per-column pass-B inputs (runtime/singlepass.py);
+    returns ``(mom, co, hist)``."""
+    nbins = hist["counts"].shape[1]
+    sums, counts, P, S1, S2, N, hcounts, dev = _fused_ab_tiles(
+        xt, row_valid, mom["shift"], lo, hi, mean, nbins,
+        hist_kernel=hist_kernel, interpret=interpret)
+    hist_out = {"counts": hist["counts"] + hcounts,
+                "abs_dev": hist["abs_dev"] + dev}
+    return (_fold_mom(mom, sums, counts),
+            _fold_corr(co, P, S1, S2, N), hist_out)
+
+
+def update_with_hist_xla(mom: Dict[str, Array], co: Dict[str, Array],
+                         hist: Dict[str, Array], xt: Array,
+                         row_valid: Array, lo: Array, hi: Array,
+                         mean: Array, hist_kernel: str = "cumulative"):
+    """The XLA twin of :func:`update_with_hist` (CPU meshes): the SAME
+    per-kernel updates two_pass dispatches, composed into one program —
+    one dispatch, one host read, and bit-identical sub-results because
+    the sub-graphs are the very functions the separate passes jit."""
+    mom_out, co_out = update_xla(mom, co, xt, row_valid)
+    if hist_kernel == "cumulative":
+        hist_out = histogram.update_cumulative(hist, xt.T, row_valid,
+                                               lo, hi, mean)
+    else:
+        hist_out = histogram.update(hist, xt.T, row_valid, lo, hi, mean)
+    return mom_out, co_out, hist_out
 
 
 # ---------------------------------------------------------------------------

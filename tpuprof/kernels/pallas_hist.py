@@ -69,6 +69,47 @@ def _pick_r_tile(C: int) -> int:
     return 1024 if C <= 512 else 256
 
 
+def hist_tile_legacy(x, finite, lo, scale, nbins: int):
+    """(C, R) tile → (C, nbins) per-bin counts, legacy formulation:
+    per-element bin-index materialization then one ``idx == b``
+    compare+lane-reduce per bin.  Shared by the standalone pass-B
+    kernel and the single-pass combined kernel (kernels/fused.py) so
+    the two dispatch shapes count bit-for-bin identically."""
+    idx = jnp.floor((x - lo) * scale)
+    idx = jnp.clip(idx, 0, nbins - 1).astype(jnp.int32)
+    idx = jnp.where(finite, idx, -1)          # -1 never matches a bin id
+    # dense bin counting: one vectorized compare+lane-reduce per bin
+    return jnp.concatenate(
+        [jnp.sum((idx == b).astype(jnp.int32), axis=1, keepdims=True)
+         for b in range(nbins)], axis=1)      # (C, nbins)
+
+
+def hist_tile_cumulative(x, finite, lo, scale, nbins: int):
+    """(C, R) tile → (C, nbins) CUMULATIVE ≥-edge counts (column 0 =
+    the finite count; difference outside the kernel via
+    ``histogram.counts_from_cumulative``).  Shared like
+    :func:`hist_tile_legacy`."""
+    # NaN fails every >= compare, so one select masks invalid elements
+    # out of all nbins-1 edge counts at once
+    t = jnp.where(finite, (x - lo) * scale, jnp.nan)
+    return jnp.concatenate(
+        [jnp.sum(finite.astype(jnp.int32), axis=1, keepdims=True)]
+        + [jnp.sum((t >= float(b)).astype(jnp.int32), axis=1,
+                   keepdims=True)
+           for b in range(1, nbins)], axis=1)  # (C, nbins)
+
+
+def mad_tile(x, finite, mean):
+    """(C, R) tile → (C, 1) Σ|x − mean| over finite elements — the MAD
+    numerator riding the same read."""
+    return jnp.sum(jnp.where(finite, jnp.abs(x - mean), 0.0),
+                   axis=1, keepdims=True)
+
+
+HIST_TILES = {"legacy": hist_tile_legacy,
+              "cumulative": hist_tile_cumulative}
+
+
 def _hist_kernel(xt_ref, rv_ref, lo_ref, scale_ref, mean_ref, out_ref,
                  dev_ref, *, nbins: int):
     i = pl.program_id(0)
@@ -78,17 +119,8 @@ def _hist_kernel(xt_ref, rv_ref, lo_ref, scale_ref, mean_ref, out_ref,
     scale = scale_ref[...]                    # (C, 1)
     mean = mean_ref[...]                      # (C, 1)
     finite = rv & jnp.isfinite(x)
-    idx = jnp.floor((x - lo) * scale)
-    idx = jnp.clip(idx, 0, nbins - 1).astype(jnp.int32)
-    idx = jnp.where(finite, idx, -1)          # -1 never matches a bin id
-
-    # dense bin counting: one vectorized compare+lane-reduce per bin
-    counts = jnp.concatenate(
-        [jnp.sum((idx == b).astype(jnp.int32), axis=1, keepdims=True)
-         for b in range(nbins)], axis=1)      # (C, nbins)
-
-    dev = jnp.sum(jnp.where(finite, jnp.abs(x - mean), 0.0),
-                  axis=1, keepdims=True)      # (C, 1)
+    counts = hist_tile_legacy(x, finite, lo, scale, nbins)
+    dev = mad_tile(x, finite, mean)           # (C, 1)
 
     @pl.when(i == 0)
     def _init():
@@ -116,18 +148,8 @@ def _hist_kernel_cumulative(xt_ref, rv_ref, lo_ref, scale_ref, mean_ref,
     scale = scale_ref[...]                    # (C, 1)
     mean = mean_ref[...]                      # (C, 1)
     finite = rv & jnp.isfinite(x)
-    # NaN fails every >= compare, so one select masks invalid elements
-    # out of all nbins-1 edge counts at once
-    t = jnp.where(finite, (x - lo) * scale, jnp.nan)
-
-    cum = jnp.concatenate(
-        [jnp.sum(finite.astype(jnp.int32), axis=1, keepdims=True)]
-        + [jnp.sum((t >= float(b)).astype(jnp.int32), axis=1,
-                   keepdims=True)
-           for b in range(1, nbins)], axis=1)  # (C, nbins)
-
-    dev = jnp.sum(jnp.where(finite, jnp.abs(x - mean), 0.0),
-                  axis=1, keepdims=True)      # (C, 1)
+    cum = hist_tile_cumulative(x, finite, lo, scale, nbins)
+    dev = mad_tile(x, finite, mean)           # (C, 1)
 
     @pl.when(i == 0)
     def _init():

@@ -149,6 +149,23 @@ class MeshRunner:
         # the Spearman grid tier follows the fused pass (narrow
         # single-pass kernel, or rank-transform + tiled Gram when wide)
         self.spear_grid = self.use_fused
+        # single-pass profile structure (runtime/singlepass.py): fused
+        # runners additionally compile step_ab/scan_ab programs that
+        # fold pass A AND the provisional-edge histogram from one
+        # consumption of the batch.  Resolved here (env-aware) so the
+        # serve cache key and the built program set always agree.
+        from tpuprof.config import resolve_profile_passes
+        self.profile_passes = resolve_profile_passes(
+            getattr(config, "profile_passes", None))
+        # when BOTH sides would be pallas programs, one combined module
+        # is only possible through the merged kernel (two pallas calls
+        # in one XLA module trip Mosaic's scoped-VMEM accounting —
+        # PERF.md); the merged kernel covers narrow widths, wider
+        # tables pair two dispatches over one staged placement instead
+        self._ab_combined_kernel = (self.use_fused and self.use_pallas
+                                    and n_num <= fused.MAX_FUSED_AB_COLS)
+        self._ab_paired = (self.use_fused and self.use_pallas
+                           and n_num > fused.MAX_FUSED_AB_COLS)
         self._sh_rows = NamedSharding(self.mesh, P("data"))
         self._sh_cols_rows = NamedSharding(self.mesh, P(None, "data"))
         self._sh_rep = NamedSharding(self.mesh, P())
@@ -255,10 +272,14 @@ class MeshRunner:
         with _DISPATCH_LOCK:
             return jax.vmap(one_device)(jnp.arange(self.n_dev))
 
-    def init_pass_b(self) -> Pytree:
+    def init_pass_b(self, n_cols: Optional[int] = None) -> Pytree:
+        """``n_cols`` sizes a COLUMN-SUBSET histogram state (the
+        fused-profile targeted re-bin — runtime/singlepass.py); the
+        default is the full numeric plane, byte-identical to before."""
+        cols = self.n_num if n_cols is None else int(n_cols)
         with _DISPATCH_LOCK:
             return jax.vmap(
-                lambda _: histogram.init(self.n_num, self.bins))(
+                lambda _: histogram.init(cols, self.bins))(
                 jnp.arange(self.n_dev))
 
     def place_state(self, state: Pytree) -> Pytree:
@@ -340,6 +361,44 @@ class MeshRunner:
                 return step_b_core(carry, xt, rv, lo, hi, mean), None
             out, _ = jax.lax.scan(body, _unstack(state), (xts, row_valids))
             return _restack(out)
+
+        ab_combined_kernel = self._ab_combined_kernel
+
+        def step_ab_core(s, s_h, xt, row_valid, hllt, lo, hi, mean):
+            """Single-pass fold (profile_passes=fused): pass A's state
+            AND the provisional-edge histogram from ONE consumption of
+            the batch.  On a pallas mesh at narrow widths the merged
+            kernel reads the tile once (kernels/fused.update_with_hist);
+            everywhere else the body composes the EXACT step_a/step_b
+            cores into one program — the sub-graphs are the very
+            functions the two-pass programs jit, which is what makes
+            fused sub-results byte-identical to two-pass's
+            (tests/test_singlepass.py pins it)."""
+            if ab_combined_kernel:
+                mom, co, h = fused.update_with_hist(
+                    s["mom"], s["corr"], s_h, xt, row_valid, lo, hi,
+                    mean, hist_kernel=pass_b_kernel)
+                return ({"mom": mom, "corr": co,
+                         "hll": hll.update(s["hll"], hllt.T)}, h)
+            return (step_a_core(s, xt, row_valid, hllt),
+                    step_b_core(s_h, xt, row_valid, lo, hi, mean))
+
+        def local_step_ab(state, state_h, xt, row_valid, hllt,
+                          lo, hi, mean):
+            out_a, out_h = step_ab_core(_unstack(state), _unstack(state_h),
+                                        xt, row_valid, hllt, lo, hi, mean)
+            return _restack(out_a), _restack(out_h)
+
+        def local_scan_ab(state, state_h, xts, row_valids, hllts,
+                          lo, hi, mean):
+            def body(carry, inp):
+                xt, rv, ht = inp
+                return step_ab_core(carry[0], carry[1], xt, rv, ht,
+                                    lo, hi, mean), None
+            (out_a, out_h), _ = jax.lax.scan(
+                body, (_unstack(state), _unstack(state_h)),
+                (xts, row_valids, hllts))
+            return _restack(out_a), _restack(out_h)
 
         def merge_corr_local(co, common_shift):
             wc = jnp.broadcast_to((co["set"] > 0).astype(jnp.float32),
@@ -464,6 +523,26 @@ class MeshRunner:
                       rep, rep, rep),
             out_specs=state_spec, check_vma=False),
             donate_argnums=(0,))
+        # single-pass programs: built only for fused runners (the serve
+        # cache keys on profile_passes, so a two-pass runner never sees
+        # these dispatches).  A paired mesh (wide pallas) skips them —
+        # scan_ab/step_ab dispatch the A and B programs back to back
+        # over the one staged placement instead.
+        self._step_ab = self._scan_ab = None
+        if self.profile_passes == "fused" and not self._ab_paired:
+            self._step_ab = jax.jit(shard_map(
+                local_step_ab, mesh=mesh,
+                in_specs=(state_spec, state_spec, cols_rows_spec,
+                          rows_spec, cols_rows_spec, rep, rep, rep),
+                out_specs=(state_spec, state_spec), check_vma=False),
+                donate_argnums=(0, 1))
+            self._scan_ab = jax.jit(shard_map(
+                local_scan_ab, mesh=mesh,
+                in_specs=(state_spec, state_spec,
+                          P(None, None, "data"), P(None, "data"),
+                          P(None, None, "data"), rep, rep, rep),
+                out_specs=(state_spec, state_spec), check_vma=False),
+                donate_argnums=(0, 1))
         self._merge_a = jax.jit(shard_map(
             local_merge_a, mesh=mesh, in_specs=(state_spec,),
             out_specs=state_spec, check_vma=False))
@@ -539,6 +618,54 @@ class MeshRunner:
                 self.put_replicated(hi, dtype=jnp.float32),
                 self.put_replicated(mean, dtype=jnp.float32))
         return fused.observe_dispatch("scan_b", out,
+                                      batches=sb.n_batches,
+                                      kernel=self.pass_b_kernel)
+
+    # -- single-pass dispatch (profile_passes=fused) -----------------------
+
+    def step_ab(self, state: Pytree, state_h: Pytree, hb,
+                lo, hi, mean) -> Tuple:
+        """Fold one batch into the pass-A AND provisional-edge
+        histogram states with a single consumption of the batch
+        (runtime/singlepass.py).  Returns ``(state, state_h)``."""
+        with _DISPATCH_LOCK:
+            db = self._as_device(hb)
+            lo_d = self.put_replicated(lo, dtype=jnp.float32)
+            hi_d = self.put_replicated(hi, dtype=jnp.float32)
+            mean_d = self.put_replicated(mean, dtype=jnp.float32)
+            if self._step_ab is not None:
+                out = self._step_ab(state, state_h, db.xt, db.row_valid,
+                                    db.hllt, lo_d, hi_d, mean_d)
+            else:
+                # paired mesh (wide pallas): two dispatches, ONE
+                # placement — the host-side read/prep/transfer is
+                # still single-pass
+                out = (self._step_a(state, db.xt, db.row_valid,
+                                    db.hllt),
+                       self._step_b(state_h, db.xt, db.row_valid,
+                                    lo_d, hi_d, mean_d))
+        return fused.observe_dispatch("step_ab", out,
+                                      kernel=self.pass_b_kernel)
+
+    def scan_ab(self, state: Pytree, state_h: Pytree, sb: "StackedBatch",
+                lo, hi, mean) -> Tuple:
+        """Multi-batch twin of :meth:`step_ab`: fold ``sb.n_batches``
+        staged batches into both states in one compiled dispatch (two
+        on a paired mesh — same single staged placement)."""
+        with _DISPATCH_LOCK:
+            lo_d = self.put_replicated(lo, dtype=jnp.float32)
+            hi_d = self.put_replicated(hi, dtype=jnp.float32)
+            mean_d = self.put_replicated(mean, dtype=jnp.float32)
+            if self._scan_ab is not None:
+                out = self._scan_ab(state, state_h, sb.xts,
+                                    sb.row_valids, sb.hllts,
+                                    lo_d, hi_d, mean_d)
+            else:
+                out = (self._scan_a(state, sb.xts, sb.row_valids,
+                                    sb.hllts),
+                       self._scan_b(state_h, sb.xts, sb.row_valids,
+                                    lo_d, hi_d, mean_d))
+        return fused.observe_dispatch("scan_ab", out,
                                       batches=sb.n_batches,
                                       kernel=self.pass_b_kernel)
 
@@ -636,7 +763,11 @@ class MeshRunner:
         return self._gather_merged("a", self._merge_a, state)
 
     def finalize_b(self, state: Pytree) -> Dict[str, Any]:
-        return self._gather_merged("b", self._merge_b, state)
+        # keyed by shape: the fused profile's column-subset re-bin
+        # finalizes (n_sub, bins) states through the same seam, and the
+        # gather cache's (treedef, spec) is shape-specific
+        key = f"b:{tuple(state['counts'].shape)}"
+        return self._gather_merged(key, self._merge_b, state)
 
     def _gather_merged(self, key: str, merge_fn, state: Pytree):
         """Merge on-device and fetch replica 0 as ONE dispatch + ONE

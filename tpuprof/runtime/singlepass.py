@@ -1,0 +1,287 @@
+"""Single-pass profiles: provisional bin edges + edge-hit adoption
+(ROADMAP item 3(c); PERF.md round 10).
+
+The two-pass structure exists only because pass B's bin edges need
+pass A's exact finite min/max (and the MAD kernel needs the pass-A
+mean).  But most profiles at steady state already KNOW those numbers:
+a watch cycle has cycle N−1's artifact, an incremental resume has the
+fold state it restored, a repeat serve job has the previous result.
+``profile_passes=fused`` exploits this: seed *provisional* per-column
+``(lo, hi, mean)`` from the previous artifact (or a first-batch sketch
+on cold starts), fold moments AND histogram counts in ONE read of
+every batch, and at collect-finish compare the provisional values
+against the exact pass-A bounds:
+
+* **edge hit** — the provisional f32 triple equals, bitwise, the exact
+  triple two-pass would have fed the binning kernel.  The fused counts
+  ARE what pass B would have computed: byte-identical by construction.
+* **edge miss** — any difference (new range, drifted mean, cold-start
+  guess) falls back to a targeted pass-B re-bin over ONLY the missed
+  columns.  Results are then identical to two-pass by the same kernels
+  on the same exact bounds.
+
+Watch mode drives the hit rate to 1.0 by construction: an undrifted
+source reproduces the same moments, so cycle N−1's sealed bounds match
+cycle N's exactly.  The hit comparison (and the re-bin feed) uses the
+HOST bounds recipe (:func:`kernels.histogram.pass_b_bounds` cast f32)
+— the same values an artifact round-trips losslessly through JSON, so
+"undrifted ⇒ hit" is an identity, not a tolerance.
+
+This module owns the shared plumbing: edge seeding (artifact →
+provisional arrays, first-batch sketch), the hit reduction, the count
+merge, and the observability surface (OBSERVABILITY.md "Single-pass
+profiles").  The fused device programs live in runtime/mesh.py +
+kernels/fused.py; the collect/stream drivers are backends/tpu.py and
+runtime/stream.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpuprof.obs import metrics as _obs_metrics
+
+_EDGE_HITS = _obs_metrics.counter(
+    "tpuprof_singlepass_edge_hits_total",
+    "fused-profile numeric lanes whose provisional bin edges matched "
+    "the exact pass-A bounds bitwise (counts adopted, no re-bin)")
+_EDGE_MISSES = _obs_metrics.counter(
+    "tpuprof_singlepass_edge_misses_total",
+    "fused-profile numeric lanes whose provisional edges missed "
+    "(re-binned in the targeted pass-B fallback)")
+_REBIN_SECONDS = _obs_metrics.histogram(
+    "tpuprof_singlepass_rebin_seconds",
+    "wall seconds per targeted pass-B re-bin scan (edge-miss fallback)")
+
+#: how many missed column names ride one singlepass_rebin event — an
+#: operator surface, not a column dump (the watch alert convention)
+REBIN_COLUMNS_CAP = 16
+
+
+@dataclasses.dataclass
+class ProvisionalEdges:
+    """Per-numeric-lane provisional pass-B inputs for the fused scan —
+    ``(lo, hi, mean)`` float32 arrays in lane order, plus which lanes
+    were actually seeded (unseeded lanes fill from the first-batch
+    sketch) and where the seed came from (telemetry + checkpoint
+    provenance)."""
+
+    lo: np.ndarray            # (n_num,) float32
+    hi: np.ndarray            # (n_num,) float32
+    mean: np.ndarray          # (n_num,) float32
+    seeded: np.ndarray        # (n_num,) bool — True = artifact-seeded
+    origin: str = "sketch"    # "artifact" | "sketch" | "checkpoint"
+
+    def signature(self) -> int:
+        """Stable CRC of the provisional f32 bytes — the seeded-edge
+        signature stamped into events/checkpoints so a resume can name
+        the edges it adopted."""
+        return zlib.crc32(
+            self.lo.tobytes() + self.hi.tobytes() + self.mean.tobytes()
+        ) & 0xFFFFFFFF
+
+    def as_blob(self) -> Dict[str, Any]:
+        """Checkpoint form (runtime/stream.export_payload, the collect
+        checkpoint blob): resume must fold with the SAME provisional
+        edges or the restored counts would mix bin layouts."""
+        return {"lo": self.lo, "hi": self.hi, "mean": self.mean,
+                "seeded": self.seeded, "origin": self.origin}
+
+    @classmethod
+    def from_blob(cls, blob: Dict[str, Any]) -> "ProvisionalEdges":
+        return cls(lo=np.asarray(blob["lo"], dtype=np.float32),
+                   hi=np.asarray(blob["hi"], dtype=np.float32),
+                   mean=np.asarray(blob["mean"], dtype=np.float32),
+                   seeded=np.asarray(blob["seeded"], dtype=bool),
+                   origin="checkpoint")
+
+
+def _empty_edges(n_num: int) -> ProvisionalEdges:
+    z = np.zeros((n_num,), dtype=np.float32)
+    return ProvisionalEdges(lo=z.copy(), hi=z.copy(), mean=z.copy(),
+                            seeded=np.zeros((n_num,), dtype=bool))
+
+
+def exact_bounds_f32(momf) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The exact pass-B inputs as the f32 values the binning kernel
+    receives — the ONE recipe fused mode compares against and re-bins
+    with (the host twin of the device bounds; parity-pinned).  Also
+    what :func:`bin_seeds` seals into artifacts, so "same moments ⇒
+    edge hit" is bitwise."""
+    from tpuprof.kernels import histogram as khistogram
+    lo, hi, mean = khistogram.pass_b_bounds(momf)
+    return (np.asarray(lo, dtype=np.float32),
+            np.asarray(hi, dtype=np.float32),
+            np.asarray(mean, dtype=np.float32))
+
+
+def bin_seeds(plan, momf) -> Dict[str, List[float]]:
+    """Per-column ``[lo, hi, mean]`` seeds for the artifact's sketches
+    section (``sketches["bin_seeds"]``): the exact f32 pass-B bounds
+    this profile derived, for EVERY numeric lane — including lanes the
+    report never bins (bool/const/corr-rejected columns), so the next
+    fused cycle can seed the whole x-plane and an undrifted source
+    hits on every lane.  f32 values survive the f64 JSON round trip
+    exactly."""
+    lo, hi, mean = exact_bounds_f32(momf)
+    out: Dict[str, List[float]] = {}
+    for spec in plan.specs:
+        if spec.role != "num":
+            continue
+        lane = spec.num_lane
+        out[str(spec.name)] = [float(lo[lane]), float(hi[lane]),
+                               float(mean[lane])]
+    return out
+
+
+def seed_from_artifact(path: str, plan) -> Optional[ProvisionalEdges]:
+    """Provisional edges from a previous ``tpuprof-stats-v1`` artifact.
+
+    Preferred source: the ``sketches["bin_seeds"]`` map this build
+    writes (every numeric lane, exact f32 bounds).  Artifacts from
+    before the map fall back to what their sketches do carry: the
+    histogram's first/last edge (``np.linspace`` endpoints are exactly
+    the f32 bounds) plus the raw ``variables`` mean — which covers NUM
+    columns and leaves bool/const/corr lanes to the sketch fill.
+
+    Advisory by contract: any failure (missing file, corrupt artifact,
+    foreign columns) returns None with a warning — a bad seed may only
+    cost the re-bin pass, never the profile."""
+    from tpuprof.utils.trace import logger
+    try:
+        from tpuprof.artifact.store import read_artifact
+        art = read_artifact(path)
+    except Exception as exc:    # noqa: BLE001 — advisory seam
+        logger.warning(
+            "seed_edges: artifact %r unusable (%s: %s) — falling back "
+            "to the first-batch sketch", path, type(exc).__name__, exc)
+        return None
+    edges = _empty_edges(plan.n_num)
+    edges.origin = "artifact"
+    seeds = (art.sketches or {}).get("bin_seeds") or {}
+    hists = (art.sketches or {}).get("histograms") or {}
+    variables = (art.stats or {}).get("variables") or {}
+    for spec in plan.specs:
+        if spec.role != "num":
+            continue
+        lane, name = spec.num_lane, str(spec.name)
+        triple = seeds.get(name)
+        if triple is not None and len(triple) == 3:
+            edges.lo[lane] = np.float32(triple[0])
+            edges.hi[lane] = np.float32(triple[1])
+            edges.mean[lane] = np.float32(triple[2])
+            edges.seeded[lane] = True
+            continue
+        # pre-bin_seeds artifact: histogram endpoints + raw mean
+        h = hists.get(name)
+        mean = (variables.get(name) or {}).get("mean")
+        if h and h.get("edges") and mean is not None:
+            edges.lo[lane] = np.float32(h["edges"][0])
+            edges.hi[lane] = np.float32(h["edges"][-1])
+            edges.mean[lane] = np.float32(mean)
+            edges.seeded[lane] = True
+    if not edges.seeded.any():
+        logger.warning(
+            "seed_edges: artifact %r shares no numeric column with "
+            "this source — falling back to the first-batch sketch",
+            path)
+        return None
+    return edges
+
+
+def sketch_edges(x: np.ndarray, nrows: int,
+                 into: Optional[ProvisionalEdges] = None
+                 ) -> ProvisionalEdges:
+    """Cold-start provisional edges from the first batch: per-column
+    finite min/max/mean (f64 accumulation, cast f32 — so a constant
+    column's sketch mean equals its exact mean bitwise and constant
+    columns HIT cold).  Columns with no finite value sketch (0, 0, 0),
+    which is exactly the exact-bounds clamp for all-missing columns —
+    another by-construction hit.  ``into`` fills only the unseeded
+    lanes of a partially artifact-seeded set."""
+    edges = into if into is not None else _empty_edges(x.shape[1])
+    prefix = x[:nrows]
+    if prefix.shape[0] == 0:
+        return edges            # empty first batch: all lanes (0, 0, 0)
+    finite = np.isfinite(prefix)
+    cnt = finite.sum(axis=0)
+    lo = np.where(cnt > 0,
+                  np.where(finite, prefix, np.inf).min(axis=0), 0.0)
+    hi = np.where(cnt > 0,
+                  np.where(finite, prefix, -np.inf).max(axis=0), 0.0)
+    mean = np.where(
+        cnt > 0,
+        np.where(finite, prefix, 0.0).astype(np.float64).sum(axis=0)
+        / np.maximum(cnt, 1), 0.0)
+    fill = ~edges.seeded
+    edges.lo[fill] = lo.astype(np.float32)[fill]
+    edges.hi[fill] = hi.astype(np.float32)[fill]
+    edges.mean[fill] = mean.astype(np.float32)[fill]
+    return edges
+
+
+def resolve_seeds(config, plan) -> Optional[ProvisionalEdges]:
+    """The config-driven half of seeding: a ``seed_edges`` artifact
+    path (explicit field or ``TPUPROF_SEED_EDGES``) resolves to
+    artifact edges, else None (callers sketch from the first batch)."""
+    from tpuprof.config import resolve_seed_edges
+    path = resolve_seed_edges(getattr(config, "seed_edges", None))
+    if path is None:
+        return None
+    return seed_from_artifact(path, plan)
+
+
+def hit_lanes(edges: ProvisionalEdges, momf
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """(hits, (lo, hi, mean)) — the edge-validity reduction: per lane,
+    did the provisional f32 triple match the exact one bitwise?  Also
+    returns the exact f32 bounds so the caller re-bins with the very
+    values it compared against."""
+    lo, hi, mean = exact_bounds_f32(momf)
+    hits = (edges.lo == lo) & (edges.hi == hi) & (edges.mean == mean)
+    return hits, (lo, hi, mean)
+
+
+def record_outcome(hits: np.ndarray) -> None:
+    """Feed the hit/miss counters (one increment per lane, so the
+    watch-mode hit rate is ``hits / (hits + misses)`` over any
+    window)."""
+    if not _obs_metrics.enabled():
+        return
+    n_hit = int(hits.sum())
+    n_miss = int(hits.size - n_hit)
+    if n_hit:
+        _EDGE_HITS.inc(n_hit)
+    if n_miss:
+        _EDGE_MISSES.inc(n_miss)
+
+
+def record_rebin(seconds: float, miss_names: List[str],
+                 origin: str) -> None:
+    """One targeted re-bin ran: histogram + ``singlepass_rebin`` event
+    (EVENT_SCHEMA) naming up to :data:`REBIN_COLUMNS_CAP` missed
+    columns."""
+    if not _obs_metrics.enabled():
+        return
+    _REBIN_SECONDS.observe(seconds)
+    from tpuprof.obs import events
+    events.emit("singlepass_rebin", n_miss=len(miss_names),
+                columns=sorted(miss_names)[:REBIN_COLUMNS_CAP],
+                seconds=round(seconds, 4), origin=origin)
+
+
+def merge_rebinned(res_fused: Dict[str, np.ndarray],
+                   res_sub: Dict[str, np.ndarray],
+                   miss: np.ndarray) -> Dict[str, np.ndarray]:
+    """Full pass-B state from the fused counts plus the re-binned
+    subset: hit lanes keep their (byte-identical) fused counts, miss
+    lanes adopt the exact re-bin."""
+    counts = np.array(res_fused["counts"], copy=True)
+    abs_dev = np.array(res_fused["abs_dev"], copy=True)
+    counts[miss] = res_sub["counts"]
+    abs_dev[miss] = res_sub["abs_dev"]
+    return {"counts": counts, "abs_dev": abs_dev}

@@ -147,6 +147,24 @@ class StreamingProfiler:
         # kernel's centering shift can come from real data
         self.state = None
         self.cursor = 0                      # device batches folded in
+        # single-pass histogram fold (profile_passes=fused —
+        # runtime/singlepass.py): a stream has no second pass at all,
+        # so fused mode UPGRADES streaming histograms/MAD from
+        # sample-derived to exact for every lane whose provisional
+        # edges hold at snapshot time; edges seed from config.
+        # seed_edges (resume_profiler carries them in the fold state)
+        # or the first folded batch.  two_pass keeps the historical
+        # byte-identical behavior.
+        from tpuprof.config import resolve_profile_passes
+        self._fused = resolve_profile_passes(
+            getattr(self.config, "profile_passes", None)) == "fused" \
+            and self.plan.n_num > 0
+        self._hist_state = None
+        self._sp_edges = None
+        self._sp_eds_d = None
+        if self._fused:
+            from tpuprof.runtime import singlepass as _sp
+            self._sp_edges = _sp.resolve_seeds(self.config, self.plan)
         self._sample: Optional[pd.DataFrame] = None
         # micro-batch coalescing (BASELINE config 5 is 10k-row
         # micro-batches against a 64k-row device batch): buffered rows
@@ -268,7 +286,21 @@ class StreamingProfiler:
             from tpuprof.backends.tpu import estimate_shift
             self.state = self.runner.init_pass_a(estimate_shift(hb))
         db = self.runner.put_batch(hb, with_hll=self.host_hll is None)
-        self.state = self.runner.step_a(self.state, db, self.cursor)
+        if self._fused:
+            from tpuprof.runtime import singlepass as _sp
+            if self._hist_state is None:
+                self._sp_edges = _sp.sketch_edges(hb.x, hb.nrows,
+                                                  into=self._sp_edges)
+                self._hist_state = self.runner.init_pass_b()
+            if self._sp_eds_d is None:
+                self._sp_eds_d = tuple(
+                    self.runner.put_replicated(a, dtype=np.float32)
+                    for a in (self._sp_edges.lo, self._sp_edges.hi,
+                              self._sp_edges.mean))
+            self.state, self._hist_state = self.runner.step_ab(
+                self.state, self._hist_state, db, *self._sp_eds_d)
+        else:
+            self.state = self.runner.step_a(self.state, db, self.cursor)
         self.sampler.update(hb.x, hb.nrows)
         if self.host_hll is not None:
             self.host_hll.update(hb.hll, hb.nrows)
@@ -432,13 +464,30 @@ class StreamingProfiler:
             # matrix comes from the K-row sample (~1/sqrt(K) rank
             # error), flagged via .attrs["approx"]
             rho_spear = self.sampler.spearman()
+        # fused streams: adopt the exact histogram/MAD for every lane
+        # whose provisional edges match the exact pass-A bounds at
+        # THIS snapshot (runtime/singlepass.py); the rest keep the
+        # sample tier — exactly the two_pass stream's behavior
+        hists = mad = exact_lanes = None
+        if self._fused and self._hist_state is not None \
+                and self.hostagg.n_rows > 0:
+            from tpuprof.kernels import histogram as khistogram
+            from tpuprof.runtime import singlepass as _sp
+            res_h = self.runner.finalize_b(self._hist_state)
+            hits, _ = _sp.hit_lanes(self._sp_edges, momf)
+            if hits.any():
+                hists, mad = khistogram.finalize(
+                    res_h, momf["fmin"], momf["fmax"], momf["n"],
+                    self.config.bins)
+                exact_lanes = None if hits.all() else hits
         stats = _assemble(
             self.plan, self.config,
             self._sample if self._sample is not None else pd.DataFrame(),
             self.hostagg, momf, kcorr.finalize(res["corr"]),
             self.sampler.quantiles(probes), sample_vals, sample_kept,
-            khll.finalize(hll_regs), None, None, None, probes,
-            rho_spear=rho_spear, spear_approx=True)
+            khll.finalize(hll_regs), hists, mad, None, probes,
+            rho_spear=rho_spear, spear_approx=True,
+            exact_lanes=exact_lanes)
         from tpuprof.schema import VariablesView
         stats["variables"] = VariablesView(stats["variables"])
         if self._quarantine.entries:
@@ -479,6 +528,18 @@ class StreamingProfiler:
             # degraded streams stay degraded across restore; clean-run
             # payloads keep the pre-quarantine byte layout
             host_blob["quarantine"] = list(self._quarantine.entries)
+        if self._fused:
+            # the fused histogram fold + the provisional edges it bins
+            # on: a resume folding the delta onto different edges would
+            # mix bin layouts, so the edges ARE part of the durable
+            # state (byte-stable resume; two_pass payloads unchanged)
+            import jax
+            host_blob["singlepass"] = {
+                "hist": jax.device_get(self._hist_state)
+                if self._hist_state is not None else None,
+                "edges": self._sp_edges.as_blob()
+                if self._sp_edges is not None else None,
+            }
         from tpuprof import native
         return {
             "state": self.state,
@@ -615,6 +676,29 @@ class StreamingProfiler:
                     "planes of different widths cannot merge")
         prof.host_hll = saved_hll
         prof._sample = host_blob["sample"]
+        sp = host_blob.get("singlepass")
+        cursor = int(payload.get("cursor") or 0)
+        if sp is not None and not prof._fused and cursor > 0:
+            raise ValueError(
+                "checkpoint was written by a fused (single-pass) "
+                "profiler but this config resolves "
+                "profile_passes=two_pass — the fused histogram state "
+                "cannot continue without its provisional edges")
+        if sp is None and prof._fused and cursor > 0:
+            raise ValueError(
+                "profile_passes=fused cannot resume a two-pass "
+                "checkpoint with rows already folded — the fused "
+                "histogram would be missing the restored prefix")
+        if sp is not None and prof._fused:
+            from tpuprof.runtime import singlepass as _sp_mod
+            if sp.get("edges") is not None:
+                prof._sp_edges = _sp_mod.ProvisionalEdges.from_blob(
+                    sp["edges"])
+            if sp.get("hist") is not None:
+                # same placement discipline as the pass-A state: the
+                # first post-restore fold must reuse the steady-state
+                # executable for byte-stability
+                prof._hist_state = prof.runner.place_state(sp["hist"])
         prof.cursor = payload["cursor"]
         # a degraded stream stays flagged after restore (absent key =
         # clean run, the historical layout)

@@ -73,11 +73,23 @@ def runner_key(config, n_num: int, n_hash: int,
     read — nothing more (so a job differing only in paths/telemetry/
     budgets still hits) and nothing less (so two keys never share a
     runner whose programs would differ).  Env-resolved knobs
-    (``pass_b_kernel``) are resolved NOW: the key must capture what a
-    build at this moment would produce, not the raw field."""
+    (``pass_b_kernel``, ``profile_passes``) are resolved NOW: the key
+    must capture what a build at this moment would produce, not the
+    raw field.
+
+    ``profile_passes`` is the pass-STRUCTURE field (ISSUE 14): a fused
+    runner compiles step_ab/scan_ab programs a two-pass runner never
+    builds, so the two must never share a cache slot.  The seeded-edge
+    values themselves are deliberately NOT keyed: provisional edges
+    are runtime ``put_replicated`` inputs to the compiled programs,
+    never compiled structure — keying them (or the ``seed_edges``
+    artifact path, which changes every watch cycle) would rebuild the
+    warm mesh per cycle and destroy exactly the steady state fused
+    mode exists to serve."""
     import jax
 
-    from tpuprof.config import resolve_pass_b_kernel
+    from tpuprof.config import (resolve_pass_b_kernel,
+                                resolve_profile_passes)
     devs = list(devices) if devices is not None else jax.devices()
     if config.mesh_devices:
         devs = devs[: config.mesh_devices]
@@ -91,6 +103,7 @@ def runner_key(config, n_num: int, n_hash: int,
         config.use_pallas,
         resolve_pass_b_kernel(getattr(config, "pass_b_kernel", None)),
         config.use_fused,
+        resolve_profile_passes(getattr(config, "profile_passes", None)),
     )
 
 

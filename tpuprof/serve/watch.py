@@ -418,6 +418,14 @@ class DriftWatcher:
             kwargs = dict(self.config_kwargs)
             if self.job_timeout_s is not None:
                 kwargs.setdefault("job_timeout_s", self.job_timeout_s)
+            seed = self._seed_artifact(w)
+            if seed is not None:
+                # cycle N seeds its provisional bin edges from cycle
+                # N−1's artifact (runtime/singlepass.py): with
+                # profile_passes=fused an undrifted source's cycle is
+                # ONE scan — the watch loop is the hit-rate-1.0 case
+                # by construction.  Harmless under two_pass (ignored).
+                kwargs.setdefault("seed_edges", seed)
             job = self.scheduler.submit(
                 source=w.source, tenant=self.tenant, artifact=part_path,
                 config_kwargs=kwargs)
@@ -494,6 +502,17 @@ class DriftWatcher:
         self._save(w)
         return {"source": w.source, "cycle": cycle, "status": status,
                 "seconds": seconds, **extra}
+
+    def _seed_artifact(self, w: SourceWatch) -> Optional[str]:
+        """The newest retained artifact path — the edge seed for the
+        next cycle's fused profile.  Path-level only (no read here):
+        the profile's seeder validates and degrades to the first-batch
+        sketch if the file is torn, so a corrupt head can never fail a
+        cycle through this seam."""
+        if w.last_artifact and os.path.exists(w.last_artifact):
+            return w.last_artifact
+        chain = w.chain()
+        return chain[0][1] if chain else None
 
     def _warehouse_append(self, w: SourceWatch, artifact,
                           cycle: int) -> None:

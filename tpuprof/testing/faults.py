@@ -29,6 +29,9 @@ Sites wired today (grep ``faults.hit`` / ``faults.mangle``):
 ``watch_cycle``           per-cycle drift watch (serve/watch.py — a
                           raising cycle records a failed-cycle alert
                           and the watch continues)
+``singlepass_rebin``      start of a fused profile's targeted pass-B
+                          re-bin (backends/tpu.py edge-miss fallback —
+                          runtime/singlepass.py)
 ========================  ==================================================
 
 Spec grammar (config/env-driven; ``TPUPROF_FAULTS`` +
@@ -99,6 +102,9 @@ SITES = frozenset({
     "fleet_publish", "fleet_finish",
     # fleet / serve lifecycles
     "host_death", "serve_job", "watch_cycle",
+    # single-pass profiles (runtime/singlepass.py): the targeted
+    # pass-B re-bin a fused profile runs on edge misses
+    "singlepass_rebin",
 })
 
 
