@@ -335,6 +335,21 @@ def _measure_singlepass() -> dict:
         return measure_singlepass(1 << 14 if _SMOKE else 1 << 16, td)
 
 
+def _measure_aot() -> dict:
+    """AOT executable cache (ISSUE 15): compile-vs-deserialize A/B of
+    one runner's core programs through the real acquire seam — the
+    `restart` scenario (benchmarks/run.py) adds the full daemon
+    restart lane; these keys put a restart-to-warm regression (or an
+    adoption break — the measure FAILS if the load adopts nothing or
+    lands under 5x) in the headline BENCH line."""
+    import sys
+    import tempfile
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.run import measure_aot_roundtrip
+    with tempfile.TemporaryDirectory() as td:
+        return measure_aot_roundtrip(1 << 13 if _SMOKE else 1 << 14, td)
+
+
 def _measure_guardrail() -> dict:
     """Clean-path cost of the fault-tolerance plumbing (ISSUE 4): the
     retry-guard wrapper on the serial prepare loop, A/B'd in the same
@@ -371,6 +386,7 @@ def main() -> None:
     watch = _measure_watch()              # continuous-drift watch loop
     wh = _measure_warehouse()             # columnar warehouse IO
     sp = _measure_singlepass()            # fused-vs-two-pass A/B
+    aot = _measure_aot()                  # AOT compile-vs-deserialize
     render_s = _measure_render()          # host-only, before the device
 
     devices = jax.devices()[:1]           # single-chip measurement
@@ -505,6 +521,13 @@ def main() -> None:
         "singlepass_speedup_x": sp["singlepass_speedup_x"],
         "singlepass_wide_speedup_x": sp["singlepass_wide_speedup_x"],
         "edge_hit_rate": sp["edge_hit_rate"],
+        # AOT executable cache (ISSUE 15): deserializing a restart's
+        # compiled programs vs re-compiling them (the measure FAILS
+        # under the 5x acceptance), and the store entry's weight
+        "aot_compile_s": aot["aot_compile_s"],
+        "aot_load_s": aot["aot_load_s"],
+        "aot_deserialize_speedup_x": aot["aot_deserialize_speedup_x"],
+        "aot_entry_bytes": aot["aot_entry_bytes"],
         "device_mem_in_use_bytes": int(device_mem_in_use),
         # per-stage breakdown (obs spans; NEW keys only — existing keys
         # above keep their names so BENCH_r* comparisons stay valid)

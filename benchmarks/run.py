@@ -717,7 +717,8 @@ def run_passb(scale: float, workdir: str) -> dict:
             "default_kernel": resolve_pass_b_kernel(None)}
 
 
-def measure_drift(rows: int, batch_rows: int = 1 << 12) -> dict:
+def measure_drift(rows: int, batch_rows: int = 1 << 12,
+                  aot_dir: "str | None" = None) -> dict:
     """Artifact + incremental + diff costs (ISSUE 6): write/read seconds
     for a fold-able stats artifact, the incremental-vs-full speedup
     (resume(artifact) + profile(delta) vs re-profiling the whole
@@ -739,7 +740,7 @@ def measure_drift(rows: int, batch_rows: int = 1 << 12) -> dict:
         return [scenarios.taxi_batch(rng, per_batch)
                 for _ in range(n_batches)]
 
-    cfg = ProfilerConfig(batch_rows=batch_rows)
+    cfg = ProfilerConfig(batch_rows=batch_rows, aot_cache_dir=aot_dir)
     probe = StreamingProfiler.for_example(
         scenarios.taxi_batch(np.random.default_rng(0), 64), config=cfg)
     per_batch = probe.runner.rows          # aligned micro-batches
@@ -815,16 +816,21 @@ def run_drift(scale: float, workdir: str) -> dict:
     # mutexes ("Mutex corrupt: both reader and writer lock held") with
     # the cache enabled during this streaming+npz-shaped leg even with
     # a single build.  In-process warm starts come from the runner
-    # cache anyway, so disabling the disk cache costs this leg nothing.
+    # cache anyway; CROSS-round warm starts come from the app-level
+    # AOT executable store under --workdir (ISSUE 15) — which never
+    # touches the jaxlib persistent-cache code path, so it restores
+    # the restart warmth this leg lost without re-arming the aborts.
     from tpuprof.backends.tpu import disable_compile_cache
     disable_compile_cache()
+    os.makedirs(workdir, exist_ok=True)
     rows = max(int(20_000_000 * scale), 100_000)
-    out = measure_drift(rows)
+    out = measure_drift(rows, aot_dir=os.path.join(workdir, "aot"))
     out["scenario"] = "drift"
     return out
 
 
-def measure_rebalance(rows: int, n_frags: int = 6) -> dict:
+def measure_rebalance(rows: int, n_frags: int = 6,
+                      aot_dir: "str | None" = None) -> dict:
     """Elastic fleet cost envelope (ISSUE 7).  Two figures:
 
     * ``steal_overhead_pct`` — clean-path cost of running the SAME
@@ -871,6 +877,7 @@ def measure_rebalance(rows: int, n_frags: int = 6) -> dict:
         def run(elastic: bool, tag: str) -> float:
             cfg = ProfilerConfig(
                 backend="tpu", batch_rows=1 << 12, elastic=elastic,
+                aot_cache_dir=aot_dir,
                 fleet_dir=os.path.join(td, f"fleet_{tag}")
                 if elastic else None,
                 fleet_host_id="bench" if elastic else None)
@@ -926,8 +933,13 @@ def measure_rebalance(rows: int, n_frags: int = 6) -> dict:
 
 
 def run_rebalance(scale: float, workdir: str) -> dict:
+    # cross-round restart warmth through the AOT store (the run_drift
+    # rationale — the jaxlib disk cache stays off, the app-level store
+    # replaces what it used to provide)
+    os.makedirs(workdir, exist_ok=True)
     rows = max(int(5_000_000 * scale), 20_000)
-    out = measure_rebalance(rows)
+    out = measure_rebalance(rows,
+                            aot_dir=os.path.join(workdir, "aot"))
     out["scenario"] = "rebalance"
     return out
 
@@ -1610,6 +1622,172 @@ def run_singlepass(scale: float, workdir: str) -> dict:
     return out
 
 
+def measure_aot_roundtrip(rows: int, workdir: str) -> dict:
+    """AOT compile-vs-deserialize A/B (ISSUE 15), in-process: AOT-
+    compile + serialize one runner's core programs into a fresh store
+    (timing the compile half), then load them into a SECOND, cold
+    runner through the real acquire seam and time the deserialize.
+    The leg FAILS unless the load adopted the programs and ran ≥5x
+    faster than the compile it replaces — the tentpole's reason to
+    exist, enforced rather than recorded."""
+    import dataclasses
+    import shutil
+
+    from tpuprof import ProfilerConfig
+    from tpuprof.backends.tpu import disable_compile_cache
+    from tpuprof.ingest.arrow import ArrowIngest
+    from tpuprof.runtime import aot as aotrt
+    from tpuprof.serve import cache as serve_cache
+
+    disable_compile_cache()
+    fixture = _ensure_fixture("taxi", rows, workdir)
+    ab_dir = os.path.join(workdir, "restart_ab_aot")
+    shutil.rmtree(ab_dir, ignore_errors=True)
+    cfg = ProfilerConfig(backend="tpu", batch_rows=1 << 12)
+    plan = ArrowIngest(fixture, cfg.batch_rows).plan
+    runner = serve_cache.acquire_runner(cfg, plan.n_num, plan.n_hash)
+    key = serve_cache.runner_key(cfg, plan.n_num, plan.n_hash)
+    store = aotrt.AotStore(ab_dir)
+    meta = store.save_runner(key, runner, cfg)
+    store.touch_manifest(key, cfg, plan.n_num, plan.n_hash)
+
+    # a fresh RunnerCache = a fresh process's first acquire, minus the
+    # interpreter/jax import wall (the daemon lane measures that half)
+    cfg_aot = dataclasses.replace(cfg, aot_cache_dir=ab_dir)
+    rc = serve_cache.RunnerCache(2)
+    t0 = time.perf_counter()
+    warm = rc.get(cfg_aot, plan.n_num, plan.n_hash)
+    load_s = time.perf_counter() - t0
+    if not hasattr(warm._scan_a, "_aot_fallback"):
+        raise RuntimeError(
+            "restart leg: AOT load did not adopt the scan programs — "
+            "the store answered nothing")
+    speedup = meta["compile_s"] / load_s
+    if speedup < 5.0:
+        raise RuntimeError(
+            f"restart leg: AOT deserialize ({load_s:.3f}s) is only "
+            f"{speedup:.1f}x faster than the compile it replaces "
+            f"({meta['compile_s']:.3f}s) — acceptance is >= 5x")
+    return {
+        "rows": rows,
+        "aot_programs": meta["programs"],
+        "aot_entry_bytes": meta["bytes"],
+        "aot_compile_s": round(meta["compile_s"], 3),
+        "aot_save_write_s": round(meta["write_s"], 4),
+        "aot_load_s": round(load_s, 4),
+        "aot_deserialize_speedup_x": round(speedup, 1),
+    }
+
+
+def measure_restart(rows: int, workdir: str) -> dict:
+    """Restart-to-warm (ISSUE 15 acceptance): the in-process
+    compile-vs-deserialize A/B above PLUS a real `tpuprof serve`
+    daemon restart on one spool —
+
+    * daemon 1 answers a cold job (pays the compile) and its
+      background save publishes the AOT entry + manifest under
+      SPOOL/aot (the CLI default);
+    * daemon 2 starts on the same spool with a job already waiting;
+      ``restart_to_warm_s`` is Popen -> first-job-done wall, which
+      must land under the 5 s ROADMAP bar;
+    * the restarted daemon's stats export must be byte-identical to
+      the cold daemon's (in-leg enforcement — a wrong warm answer is
+      a correctness bug, not a slow round)."""
+    import shutil
+    import subprocess
+
+    from tpuprof.serve import wait_result, write_job
+
+    out = measure_aot_roundtrip(rows, workdir)
+    fixture = _ensure_fixture("taxi", rows, workdir)
+    spool = os.path.join(workdir, "restart_spool")
+    shutil.rmtree(spool, ignore_errors=True)
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = {"batch_rows": 1 << 12}
+
+    def spawn():
+        return subprocess.Popen(
+            [sys.executable, "-m", "tpuprof", "serve", spool,
+             "--daemon-id", "r0", "--serve-workers", "1",
+             "--no-compile-cache"],
+            cwd=here, stderr=subprocess.DEVNULL)
+
+    from tpuprof.runtime import aot as aotrt
+    cold_stats = os.path.join(workdir, "restart_cold.json")
+    proc = spawn()
+    try:
+        jid = write_job(spool, fixture, stats_json=cold_stats,
+                        config_kwargs=dict(cfg))
+        res = wait_result(spool, jid, timeout=1800)
+        if res["status"] != "done":
+            raise RuntimeError(f"restart leg: cold job failed: {res}")
+        cold_job_s = float(res["seconds"])
+        # the save is a background thread — wait for the entry to
+        # publish before killing the daemon
+        store = aotrt.AotStore(os.path.join(spool, "aot"))
+        deadline = time.monotonic() + 600
+        while not (store.entries()
+                   and os.path.exists(store.manifest_path)):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "restart leg: daemon never published its AOT "
+                    "entry")
+            time.sleep(0.2)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=300)
+
+    # the restart: a job is already waiting when the daemon comes up,
+    # so Popen -> result-landed IS the operator's restart-to-warm
+    warm_stats = os.path.join(workdir, "restart_warm.json")
+    jid = write_job(spool, fixture, stats_json=warm_stats,
+                    config_kwargs=dict(cfg))
+    t0 = time.perf_counter()
+    proc = spawn()
+    try:
+        res = wait_result(spool, jid, timeout=1800)
+        restart_to_warm_s = time.perf_counter() - t0
+        if res["status"] != "done":
+            raise RuntimeError(f"restart leg: warm job failed: {res}")
+        warm_job_s = float(res["seconds"])
+    finally:
+        proc.terminate()
+        proc.wait(timeout=300)
+
+    with open(cold_stats) as fh:
+        cold_doc = json.load(fh)
+    with open(warm_stats) as fh:
+        warm_doc = json.load(fh)
+    if cold_doc != warm_doc:
+        raise RuntimeError(
+            "restart leg: AOT-warmed stats differ from the "
+            "cold-compiled stats — the never-wrong contract is broken")
+    if restart_to_warm_s >= 5.0:
+        raise RuntimeError(
+            f"restart leg: restart-to-warm {restart_to_warm_s:.2f}s "
+            "missed the < 5 s bar (ROADMAP 3(d))")
+    out.update({
+        "restart_cold_job_s": round(cold_job_s, 3),
+        "restart_warm_job_s": round(warm_job_s, 3),
+        "restart_warm_vs_cold_x": round(cold_job_s
+                                        / max(warm_job_s, 1e-9), 1),
+        "restart_to_warm_s": round(restart_to_warm_s, 3),
+        "rows_per_sec": round(rows / restart_to_warm_s, 1),
+    })
+    return out
+
+
+def run_restart(scale: float, workdir: str) -> dict:
+    # small fixture on purpose (the serve-leg rationale): the tracked
+    # signals are the deserialize:compile ratio and the restart wall,
+    # not scan throughput
+    os.makedirs(workdir, exist_ok=True)
+    rows = max(int(1_000_000 * scale), 10_000)
+    out = measure_restart(rows, workdir)
+    out["scenario"] = "restart"
+    return out
+
+
 def run_serve(scale: float, workdir: str) -> dict:
     # small fixtures on purpose: the tracked signal is the cold:warm
     # RATIO (compile amortization), which a big scan denominator would
@@ -1623,7 +1801,7 @@ def run_serve(scale: float, workdir: str) -> dict:
 REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
                         "hostfed", "prepare", "passb", "faults", "drift",
                         "rebalance", "serve", "watch", "serve_http",
-                        "warehouse", "lint", "singlepass")
+                        "warehouse", "lint", "singlepass", "restart")
 
 
 def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
@@ -1832,6 +2010,10 @@ def run_regression(scale: float, workdir: str,
             notes = (f"fused:two {r['singlepass_speedup_x']}x, wide "
                      f"{r['singlepass_wide_speedup_x']}x, hit "
                      f"{r['edge_hit_rate']}")
+        if "restart_to_warm_s" in r:
+            notes = (f"warm in {r['restart_to_warm_s']}s, "
+                     f"deser {r['aot_deserialize_speedup_x']}x, "
+                     f"job {r['restart_warm_vs_cold_x']}x")
         rate = r.get("rows_per_sec",
                      r.get("prepare_rows_per_sec", float("nan")))
         rows = r.get("rows")
@@ -1854,6 +2036,7 @@ def main() -> None:
                                              "serve", "watch",
                                              "serve_http", "warehouse",
                                              "lint", "singlepass",
+                                             "restart",
                                              "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
@@ -1891,7 +2074,7 @@ def main() -> None:
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
               "prepare", "passb", "faults", "drift", "rebalance",
               "wideexact", "serve", "watch", "serve_http", "warehouse",
-              "lint", "singlepass"]
+              "lint", "singlepass", "restart"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -1926,6 +2109,8 @@ def main() -> None:
             result = run_lint_leg(args.scale, args.workdir)
         elif name == "singlepass":
             result = run_singlepass(args.scale, args.workdir)
+        elif name == "restart":
+            result = run_restart(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

@@ -1,0 +1,487 @@
+"""AOT executable cache (tpuprof/runtime/aot.py — ISSUE 15).
+
+The contract under test: *restarts can be slow again but never wrong*.
+
+* round-trip — a runner warmed from the store produces stats
+  BYTE-identical to a cold-compiled run, and its core programs are
+  adopted (not silently recompiled);
+* corruption — truncation at every byte offset of an entry, a footer
+  bit flip, a forged fingerprint (jaxlib version mutated in place),
+  and a payload the deserializer rejects ALL surface as the typed
+  :class:`CorruptAotCacheError` at the store layer and demote to a
+  fresh compile (byte-identical stats) at the acquire seam;
+* durability — a SIGKILL at any point during a save can never leave a
+  loadable torn entry (atomic dot-tmp+fsync+rename publication);
+* prewarm — a restarted daemon's Prewarmer loads manifest-hot keys
+  into the process runner cache, and ``GET /v1/healthz`` reports
+  draining/warming/ready for the fleet balancer.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+import zlib
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof import ProfileReport, ProfilerConfig
+from tpuprof.errors import (TYPED_ERRORS, CorruptAotCacheError,
+                            exit_code)
+from tpuprof.report.export import stats_to_json
+from tpuprof.runtime import aot as aotrt
+from tpuprof.serve import cache as serve_cache
+
+pytestmark = pytest.mark.aot
+
+BATCH_ROWS = 1024
+
+
+def _stats_str(report) -> str:
+    return json.dumps(stats_to_json(report.description), sort_keys=True,
+                      default=str)
+
+
+def _profile(src, **kw):
+    cfg = ProfilerConfig(backend="tpu", batch_rows=BATCH_ROWS, **kw)
+    return ProfileReport(src, config=cfg)
+
+
+@pytest.fixture(scope="module")
+def fixture_parquet(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("aot_data") / "data.parquet")
+    rng = np.random.default_rng(7)
+    df = pd.DataFrame({
+        "price": rng.normal(10.0, 3.0, 4000),
+        "qty": rng.integers(0, 50, 4000).astype(np.float64),
+        "tag": rng.choice(["a", "b", "c"], 4000),
+    })
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def populated(fixture_parquet, tmp_path_factory):
+    """One populated store + the cold-run baseline everything diffs
+    against: cold stats (aot off), the resolved runner key, and the
+    entry path the digest addressing produced."""
+    aot_dir = str(tmp_path_factory.mktemp("aot_store"))
+    serve_cache.process_cache().clear()
+    cold = _stats_str(_profile(fixture_parquet))
+
+    serve_cache.process_cache().clear()
+    rep = _profile(fixture_parquet, aot_cache_dir=aot_dir)
+    aotrt.wait_pending_saves(300)
+    assert _stats_str(rep) == cold
+
+    from tpuprof.ingest.arrow import ArrowIngest
+    cfg = ProfilerConfig(backend="tpu", batch_rows=BATCH_ROWS,
+                         aot_cache_dir=aot_dir)
+    plan = ArrowIngest(fixture_parquet, BATCH_ROWS).plan
+    key = serve_cache.runner_key(cfg, plan.n_num, plan.n_hash)
+    store = aotrt.AotStore(aot_dir)
+    entry = store.entry_path(key)
+    assert os.path.exists(entry), "background save never published"
+    return {"aot_dir": aot_dir, "cold": cold, "key": key,
+            "entry": entry, "store": store,
+            "n_num": plan.n_num, "n_hash": plan.n_hash}
+
+
+# ---------------------------------------------------------------------------
+# round-trip
+# ---------------------------------------------------------------------------
+
+class TestRoundTrip:
+
+    def test_warm_load_adopts_programs_and_is_byte_identical(
+            self, fixture_parquet, populated):
+        serve_cache.process_cache().clear()
+        from tpuprof.obs import metrics as om
+        hits0 = om.registry().counter(
+            "tpuprof_aot_cache_hits_total").total()
+        rep = _profile(fixture_parquet,
+                       aot_cache_dir=populated["aot_dir"],
+                       metrics_enabled=True)
+        assert _stats_str(rep) == populated["cold"]
+        assert om.registry().counter(
+            "tpuprof_aot_cache_hits_total").total() == hits0 + 1
+        runner = next(iter(serve_cache.process_cache()
+                           ._runners.values()))
+        # the core dispatch programs route through adopted executables
+        for attr in ("_step_a", "_scan_a", "_step_b", "_scan_b",
+                     "_bounds_b"):
+            assert hasattr(getattr(runner, attr), "_aot_fallback"), attr
+        assert any(fn is not None and hasattr(fn, "_aot_fallback")
+                   for fn, _t, _s in runner._gather_cache.values())
+
+    def test_scan_batches_mismatch_falls_back_byte_identical(
+            self, fixture_parquet, populated):
+        """The entry was saved at the default scan_batches; a config
+        with a different S finds the same runner key, adopts, and the
+        multi-batch scans FALL BACK to the jit wrapper on the aval
+        mismatch — results stay byte-identical to a cold run at that
+        same S."""
+        serve_cache.process_cache().clear()
+        cold = _stats_str(_profile(fixture_parquet, scan_batches=2))
+        serve_cache.process_cache().clear()
+        warm = _stats_str(_profile(fixture_parquet, scan_batches=2,
+                                   aot_cache_dir=populated["aot_dir"]))
+        assert warm == cold
+
+    def test_off_by_default_and_off_switch(self, fixture_parquet,
+                                           populated, monkeypatch):
+        monkeypatch.delenv("TPUPROF_AOT_CACHE_DIR", raising=False)
+        assert aotrt.store_from_config(
+            ProfilerConfig(backend="tpu")) is None
+        # aot_cache=off keeps a configured dir dark
+        assert aotrt.store_from_config(ProfilerConfig(
+            backend="tpu", aot_cache_dir=populated["aot_dir"],
+            aot_cache="off")) is None
+
+    def test_runner_key_ignores_aot_fields(self, populated):
+        """aot_* fields change which store warms a build, never which
+        runner answers the job — two configs differing only in them
+        MUST share a runner-cache slot."""
+        cfg_a = ProfilerConfig(backend="tpu", batch_rows=BATCH_ROWS)
+        cfg_b = ProfilerConfig(backend="tpu", batch_rows=BATCH_ROWS,
+                               aot_cache_dir="/elsewhere",
+                               aot_cache="off", aot_prewarm=9)
+        assert serve_cache.runner_key(cfg_a, 2, 1) \
+            == serve_cache.runner_key(cfg_b, 2, 1)
+
+
+# ---------------------------------------------------------------------------
+# corruption / skew
+# ---------------------------------------------------------------------------
+
+def _small_entry(tmp_path):
+    """A tiny synthetic entry (the store layer does not interpret
+    program bytes — corruption detection is envelope CRC/fingerprint,
+    so the every-offset sweep runs on a fast small file)."""
+    import jax
+    tree = jax.tree_util.tree_structure((1, 2))
+    fp = aotrt.env_fingerprint()
+    path = str(tmp_path / "entry.aot")
+    aotrt.write_entry(path, "key", fp, {"p": (b"x" * 64, tree, tree)})
+    return path, fp
+
+
+class TestCorruption:
+
+    def test_truncation_at_every_offset(self, tmp_path):
+        path, fp = _small_entry(tmp_path)
+        with open(path, "rb") as fh:
+            data = fh.read()
+        assert aotrt.read_entry(path, fp, "key")     # sanity: intact
+        for offset in range(len(data)):
+            with open(path, "wb") as fh:
+                fh.write(data[:offset])
+            with pytest.raises(CorruptAotCacheError):
+                aotrt.read_entry(path, fp, "key")
+        # restore and confirm the sweep never false-positived
+        with open(path, "wb") as fh:
+            fh.write(data)
+        assert aotrt.read_entry(path, fp, "key")
+
+    def test_bit_flips(self, tmp_path):
+        path, fp = _small_entry(tmp_path)
+        with open(path, "rb") as fh:
+            data = fh.read()
+        for offset in (len(data) - 1,            # footer byte
+                       len(data) - 17,           # inside the payload
+                       len(aotrt._MAGIC) + 4):   # inside the header
+            flipped = bytearray(data)
+            flipped[offset] ^= 0x40
+            with open(path, "wb") as fh:
+                fh.write(bytes(flipped))
+            with pytest.raises(CorruptAotCacheError):
+                aotrt.read_entry(path, fp, "key")
+
+    def test_forged_fingerprint_never_loads(self, tmp_path):
+        """An entry whose INTERNAL fingerprint was doctored (jaxlib
+        version string mutated, CRC left valid) must raise typed: the
+        digest-addressed filename covers the fingerprint, so a
+        mismatch under the right name is forgery or rot, never a
+        legitimate skew (skew lands on a different filename)."""
+        path, fp = _small_entry(tmp_path)
+        forged = dict(fp, jaxlib="9.9.9-forged")
+        import jax
+        tree = jax.tree_util.tree_structure((1, 2))
+        aotrt.write_entry(path, "key", forged,
+                          {"p": (b"x" * 64, tree, tree)})
+        with pytest.raises(CorruptAotCacheError,
+                           match="fingerprint"):
+            aotrt.read_entry(path, fp, "key")
+        # ... and honest skew IS a different filename
+        key = ("k",)
+        assert aotrt.entry_digest(key, fp) \
+            != aotrt.entry_digest(key, forged)
+
+    def test_wrong_key_never_loads(self, tmp_path):
+        path, fp = _small_entry(tmp_path)
+        with pytest.raises(CorruptAotCacheError, match="key"):
+            aotrt.read_entry(path, fp, "other-key")
+
+    def test_deserializer_raise_demotes_byte_identical(
+            self, fixture_parquet, populated, tmp_path_factory):
+        """A valid envelope around garbage executables (deserialize
+        raises) demotes to a fresh compile with byte-identical stats,
+        and the rotten entry is unlinked so the next restart is not
+        haunted."""
+        import jax
+        aot_dir = str(tmp_path_factory.mktemp("aot_garbage"))
+        store = aotrt.AotStore(aot_dir)
+        key = serve_cache.runner_key(
+            ProfilerConfig(backend="tpu", batch_rows=BATCH_ROWS),
+            populated["n_num"], populated["n_hash"])
+        tree = jax.tree_util.tree_structure((1, 2))
+        entry = store.entry_path(key)
+        aotrt.write_entry(entry, repr(tuple(key)), store.fingerprint,
+                          {"scan_a": (b"not-an-executable", tree,
+                                      tree)})
+        serve_cache.process_cache().clear()
+        rep = _profile(fixture_parquet, aot_cache_dir=aot_dir)
+        assert _stats_str(rep) == populated["cold"]
+        # the rot is purged: by the time the miss's background save
+        # lands, the path holds a FRESH valid entry (or nothing yet) —
+        # never the garbage
+        aotrt.wait_pending_saves(300)
+        assert aotrt.read_entry(entry, store.fingerprint,
+                                repr(tuple(key)))
+
+    def test_truncated_real_entry_demotes_byte_identical(
+            self, fixture_parquet, populated, tmp_path_factory):
+        aot_dir = str(tmp_path_factory.mktemp("aot_torn"))
+        store = aotrt.AotStore(aot_dir)
+        with open(populated["entry"], "rb") as fh:
+            data = fh.read()
+        key = serve_cache.runner_key(
+            ProfilerConfig(backend="tpu", batch_rows=BATCH_ROWS),
+            populated["n_num"], populated["n_hash"])
+        entry = store.entry_path(key)
+        with open(entry, "wb") as fh:
+            fh.write(data[: len(data) * 2 // 3])
+        serve_cache.process_cache().clear()
+        rep = _profile(fixture_parquet, aot_cache_dir=aot_dir)
+        assert _stats_str(rep) == populated["cold"]
+        aotrt.wait_pending_saves(300)
+        assert aotrt.read_entry(entry, store.fingerprint,
+                                repr(tuple(key)))
+
+    def test_taxonomy(self):
+        exc = CorruptAotCacheError("x")
+        assert exit_code(exc) == 6
+        assert isinstance(exc, TYPED_ERRORS)
+
+    def test_fault_site_demotes_and_counts(self, fixture_parquet,
+                                           populated):
+        from tpuprof.testing import faults
+        faults.configure("aot_load:1@1")
+        try:
+            serve_cache.process_cache().clear()
+            rep = _profile(fixture_parquet,
+                           aot_cache_dir=populated["aot_dir"])
+            assert _stats_str(rep) == populated["cold"]
+            assert faults.injected("aot_load") == 1
+        finally:
+            faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# durability: SIGKILL during save never leaves a loadable torn entry
+# ---------------------------------------------------------------------------
+
+_KILL_WRITER = textwrap.dedent("""
+    import os, sys
+    import jax
+    from tpuprof.runtime import aot
+    tree = jax.tree_util.tree_structure((1, 2))
+    fp = aot.env_fingerprint()
+    root = sys.argv[1]
+    blob = os.urandom(1 << 20)
+    i = 0
+    while True:
+        aot.write_entry(os.path.join(root, f"{i:032x}.aot"),
+                        "key", fp, {"p": (blob, tree, tree)})
+        if i == 0:
+            print("GO", flush=True)
+        i += 1
+""")
+
+
+class TestKillDuringSave:
+
+    @pytest.mark.parametrize("delay", [0.0, 0.02, 0.08])
+    def test_sigkill_mid_save_no_torn_entry(self, tmp_path, delay):
+        root = str(tmp_path / "store")
+        os.makedirs(root)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _KILL_WRITER, root],
+            stdout=subprocess.PIPE, env=env, text=True)
+        try:
+            assert proc.stdout.readline().strip() == "GO"
+            time.sleep(delay)
+        finally:
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=60)
+        fp = aotrt.env_fingerprint()
+        sealed = [n for n in os.listdir(root) if n.endswith(".aot")]
+        assert sealed, "writer never published an entry"
+        for name in sealed:
+            # atomic publication: every non-dot entry loads cleanly
+            programs = aotrt.read_entry(os.path.join(root, name), fp,
+                                        "key")
+            assert set(programs) == {"p"}
+        # in-flight dot-tmps are invisible to the store's own scans
+        store = aotrt.AotStore(root)
+        assert all(not d.startswith(".") for d in store.entries())
+
+
+# ---------------------------------------------------------------------------
+# prewarm + healthz
+# ---------------------------------------------------------------------------
+
+class TestPrewarm:
+
+    def test_prewarmer_loads_manifest_hot_keys(self, populated):
+        serve_cache.process_cache().clear()
+        pw = aotrt.Prewarmer(populated["aot_dir"], 4).start()
+        assert pw.wait(300)
+        st = pw.status()
+        assert st["done"] and st["loaded"] >= 1 and st["failed"] == 0
+        assert populated["key"] in serve_cache.process_cache()._runners
+        runner = serve_cache.process_cache()._runners[populated["key"]]
+        assert hasattr(runner._scan_a, "_aot_fallback")
+
+    def test_prewarm_never_compiles_on_miss(self, tmp_path):
+        """An empty store prewarm must not schedule background saves
+        (prewarm only ever LOADS)."""
+        before = len(aotrt._save_threads)
+        pw = aotrt.Prewarmer(str(tmp_path / "empty"), 4).start()
+        assert pw.wait(60)
+        assert pw.status() == {"root": str(tmp_path / "empty"),
+                               "top_k": 4, "loaded": 0, "pending": 0,
+                               "failed": 0, "done": True}
+        assert len(aotrt._save_threads) == before
+
+    def test_corrupt_manifest_degrades_to_empty(self, tmp_path):
+        root = str(tmp_path / "store")
+        store = aotrt.AotStore(root)
+        store.touch_manifest(("k",), ProfilerConfig(backend="tpu"),
+                             2, 1)
+        assert len(store.read_manifest()["entries"]) == 1
+        with open(store.manifest_path, "r+b") as fh:
+            fh.seek(10)
+            fh.write(b"\x00\x00")
+        assert store.read_manifest() == {"entries": {}}
+
+
+class TestHealthz:
+
+    def _edge(self, tmp_path, **daemon_kwargs):
+        from tpuprof.serve import HttpEdge, ServeDaemon
+        daemon = ServeDaemon(str(tmp_path / "spool"), **daemon_kwargs)
+        edge = HttpEdge(daemon, port=0).start()
+        return daemon, edge
+
+    def _get(self, edge, path):
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(edge.url + path,
+                                        timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            return exc.code, json.loads(exc.read())
+
+    def test_ready_then_draining(self, tmp_path):
+        daemon, edge = self._edge(tmp_path)
+        try:
+            code, doc = self._get(edge, "/v1/healthz")
+            assert (code, doc["status"]) == (200, "ready")
+            assert doc["prewarm"] is None       # no AOT store -> no gate
+            daemon.stop_event.set()
+            code, doc = self._get(edge, "/v1/healthz")
+            assert (code, doc["status"]) == (503, "draining")
+        finally:
+            edge.close()
+            daemon.close(timeout=10)
+
+    def test_warming_503_until_prewarm_done(self, tmp_path, populated):
+        daemon, edge = self._edge(
+            tmp_path, aot_cache_dir=populated["aot_dir"])
+        try:
+            class _Stuck:
+                def status(self):
+                    return {"loaded": 0, "pending": 3, "failed": 0,
+                            "done": False}
+            real = daemon.prewarmer
+            daemon.prewarmer = _Stuck()
+            code, doc = self._get(edge, "/v1/healthz")
+            assert (code, doc["status"]) == (503, "warming")
+            assert doc["prewarm"]["pending"] == 3
+            daemon.prewarmer = real
+            assert real.wait(300)
+            code, doc = self._get(edge, "/v1/healthz")
+            assert (code, doc["status"]) == (200, "ready")
+            assert doc["prewarm"]["done"] is True
+            assert doc["aot_cache_dir"] == populated["aot_dir"]
+        finally:
+            edge.close()
+            daemon.close(timeout=10)
+
+    def test_healthz_needs_no_token_on_auth_edge(self, tmp_path):
+        from tpuprof.serve import HttpEdge, ServeDaemon
+        auth = tmp_path / "tokens"
+        auth.write_text("tok1 tenant1\n")
+        daemon = ServeDaemon(str(tmp_path / "spool"))
+        edge = HttpEdge(daemon, port=0, auth_file=str(auth)).start()
+        try:
+            code, doc = self._get(edge, "/v1/healthz")
+            assert (code, doc["status"]) == (200, "ready")
+            # ... while the job routes still 401 without the token
+            code, _doc = self._get(edge, "/v1/jobs/nope")
+            assert code == 401
+        finally:
+            edge.close()
+            daemon.close(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# store plumbing details
+# ---------------------------------------------------------------------------
+
+class TestStore:
+
+    def test_manifest_rows_rebuild_runner_configs(self, populated):
+        rows = populated["store"].read_manifest()["entries"]
+        assert rows
+        row = max(rows.values(), key=lambda r: r["last_used"])
+        assert row["n_num"] == populated["n_num"]
+        assert row["n_hash"] == populated["n_hash"]
+        cfg = ProfilerConfig(backend="tpu", **row["config"])
+        key = serve_cache.runner_key(cfg, row["n_num"], row["n_hash"])
+        assert tuple(key) == tuple(populated["key"])
+
+    def test_entry_names_core_programs(self, populated):
+        programs = aotrt.read_entry(populated["entry"],
+                                    populated["store"].fingerprint,
+                                    repr(tuple(populated["key"])))
+        assert {"step_a", "scan_a", "step_b", "scan_b",
+                "bounds_b"} <= set(programs)
+        assert any(n.startswith("gather:") for n in programs)
+
+    def test_unwritable_store_dir_is_off_not_down(self, tmp_path):
+        blocked = tmp_path / "blocked"
+        blocked.write_text("a file, not a dir")
+        cfg = ProfilerConfig(backend="tpu",
+                             aot_cache_dir=str(blocked))
+        assert aotrt.store_from_config(cfg) is None

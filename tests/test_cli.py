@@ -494,6 +494,65 @@ class TestWarehouseConfigRoundTrip:
             build_parser().parse_args(
                 ["profile", "t.parquet", "--warehouse-format", "orc"])
 
+    def test_aot_env_cli_config_resolve_identically(self, monkeypatch):
+        """`aot_cache_dir` / `aot_cache` / `aot_prewarm` three-way
+        round-trips (ISSUE 15 satellite)."""
+        from tpuprof.cli import build_parser
+        from tpuprof.config import (resolve_aot_cache,
+                                    resolve_aot_cache_dir,
+                                    resolve_aot_prewarm)
+        for var in ("TPUPROF_AOT_CACHE_DIR", "TPUPROF_AOT_CACHE",
+                    "TPUPROF_AOT_PREWARM"):
+            monkeypatch.delenv(var, raising=False)
+        via_config = resolve_aot_cache_dir(
+            ProfilerConfig(aot_cache_dir="/aot").aot_cache_dir)
+        args = build_parser().parse_args(
+            ["profile", "t.parquet", "--aot-cache-dir", "/aot"])
+        via_cli = resolve_aot_cache_dir(args.aot_cache_dir)
+        monkeypatch.setenv("TPUPROF_AOT_CACHE_DIR", "/aot")
+        via_env = resolve_aot_cache_dir(None)
+        assert via_config == via_cli == via_env == "/aot"
+        monkeypatch.delenv("TPUPROF_AOT_CACHE_DIR")
+        assert resolve_aot_cache_dir(None) is None   # one-shot default
+
+        via_config = resolve_aot_cache(
+            ProfilerConfig(aot_cache="off").aot_cache)
+        args = build_parser().parse_args(
+            ["serve", "spool", "--aot-cache", "off"])
+        via_cli = resolve_aot_cache(args.aot_cache)
+        monkeypatch.setenv("TPUPROF_AOT_CACHE", "off")
+        via_env = resolve_aot_cache(None)
+        assert via_config == via_cli == via_env == "off"
+        assert resolve_aot_cache("on") == "on"   # explicit beats env
+        monkeypatch.delenv("TPUPROF_AOT_CACHE")
+        assert resolve_aot_cache(None) == "on"   # default
+
+        via_config = resolve_aot_prewarm(
+            ProfilerConfig(aot_prewarm=7).aot_prewarm)
+        args = build_parser().parse_args(
+            ["watch", "spool", "s", "--aot-prewarm", "7"])
+        via_cli = resolve_aot_prewarm(args.aot_prewarm)
+        monkeypatch.setenv("TPUPROF_AOT_PREWARM", "7")
+        via_env = resolve_aot_prewarm(None)
+        assert via_config == via_cli == via_env == 7
+        monkeypatch.delenv("TPUPROF_AOT_PREWARM")
+        assert resolve_aot_prewarm(None) == 4    # default
+
+    def test_aot_validation(self, monkeypatch):
+        with pytest.raises(ValueError, match="aot_cache"):
+            ProfilerConfig(aot_cache="maybe")
+        with pytest.raises(ValueError, match="aot_prewarm"):
+            ProfilerConfig(aot_prewarm=-1)
+        monkeypatch.setenv("TPUPROF_AOT_CACHE", "maybe")
+        from tpuprof.config import resolve_aot_cache
+        with pytest.raises(ValueError, match="TPUPROF_AOT_CACHE"):
+            resolve_aot_cache(None)
+        monkeypatch.delenv("TPUPROF_AOT_CACHE")
+        from tpuprof.cli import build_parser
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(
+                ["profile", "t.parquet", "--aot-cache", "maybe"])
+
     def test_history_backtest_parsers(self):
         from tpuprof.cli import build_parser
         args = build_parser().parse_args(

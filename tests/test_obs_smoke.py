@@ -178,6 +178,19 @@ EVENT_SCHEMA = {
                          "columns": ((list,), True),
                          "seconds": ((int, float), True),
                          "origin": ((str,), True)},
+    # AOT executable cache (runtime/aot.py, ISSUE 15): one per store
+    # load attempt that found bytes (status hit|corrupt — a clean
+    # miss emits nothing), one per background save published, one per
+    # finished restart prewarm pass
+    "aot_load": {"ts": ((int, float), True), "path": ((str,), True),
+                 "status": ((str,), True), "programs": ((int,), True),
+                 "seconds": ((int, float), True)},
+    "aot_save": {"ts": ((int, float), True), "path": ((str,), True),
+                 "programs": ((int,), True), "bytes": ((int,), True),
+                 "seconds": ((int, float), True),
+                 "compile_seconds": ((int, float), False)},
+    "aot_prewarm": {"ts": ((int, float), True), "root": ((str,), True),
+                    "loaded": ((int,), True), "failed": ((int,), True)},
 }
 
 

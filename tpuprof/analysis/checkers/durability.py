@@ -43,6 +43,7 @@ from tpuprof.analysis.registry import checker
 DURABLE_MODULES = (
     "runtime/checkpoint.py",
     "runtime/fleet.py",
+    "runtime/aot.py",
     "artifact/store.py",
     "serve/server.py",
     "serve/scheduler.py",

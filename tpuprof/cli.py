@@ -287,6 +287,20 @@ def build_parser() -> argparse.ArgumentParser:
                       help="total number of participating processes")
     dist.add_argument("--process-id", type=int, metavar="I",
                       help="this process's rank in [0, N)")
+    p.add_argument("--aot-cache-dir", metavar="DIR", default=None,
+                   help="AOT executable cache: serialize this "
+                        "profile's compiled programs under DIR (keyed "
+                        "by runner key + environment fingerprint) and "
+                        "deserialize on the next same-shape run — "
+                        "restart-to-warm in seconds where the jaxlib "
+                        "disk cache cannot go (default: "
+                        "TPUPROF_AOT_CACHE_DIR, else off for one-shot "
+                        "profiles)")
+    p.add_argument("--aot-cache", default=None, choices=("on", "off"),
+                   help="AOT executable-cache switch: 'off' never "
+                        "reads or writes serialized executables even "
+                        "with a dir configured (default: "
+                        "TPUPROF_AOT_CACHE, else on)")
     cache_group = p.add_mutually_exclusive_group()
     cache_group.add_argument(
         "--compile-cache", metavar="DIR", default=None,
@@ -361,6 +375,25 @@ def build_parser() -> argparse.ArgumentParser:
                            "is declared dead and its claimed jobs "
                            "stolen (default: "
                            "TPUPROF_LIVENESS_TIMEOUT_S, else 10)")
+    aot = s.add_argument_group(
+        "restart-to-warm (AOT executable cache)", "after a runner "
+        "compiles, its executables serialize into SPOOL/aot keyed by "
+        "runner key + environment fingerprint; a RESTARTED daemon "
+        "deserializes them in seconds (and prewarms its hottest keys "
+        "in the background) instead of re-paying the 20-40 s compile "
+        "— GET /v1/healthz reports readiness + prewarm progress")
+    aot.add_argument("--aot-cache-dir", metavar="DIR", default=None,
+                     help="AOT store root (default: "
+                          "TPUPROF_AOT_CACHE_DIR, else SPOOL/aot)")
+    aot.add_argument("--aot-cache", default=None, choices=("on", "off"),
+                     help="'off' disables the store entirely "
+                          "(default: TPUPROF_AOT_CACHE, else on)")
+    aot.add_argument("--aot-prewarm", type=int, default=None,
+                     metavar="K",
+                     help="deserialize the manifest's K hottest "
+                          "runner keys at startup, in the background "
+                          "(0 = lazy loads only; default: "
+                          "TPUPROF_AOT_PREWARM, else 4)")
     s.add_argument("--once", action="store_true",
                    help="answer the spool's current jobs, then exit "
                         "(CI / cron mode; default: serve forever)")
@@ -460,6 +493,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="'off' disables the columnar twin (cycles are "
                         "unaffected; default: "
                         "TPUPROF_WAREHOUSE_FORMAT, else parquet)")
+    w.add_argument("--aot-cache-dir", metavar="DIR", default=None,
+                   help="AOT executable-cache root: a restarted watch "
+                        "daemon deserializes its compiled programs "
+                        "from here in seconds instead of recompiling "
+                        "(default: TPUPROF_AOT_CACHE_DIR, else "
+                        "SPOOL/aot)")
+    w.add_argument("--aot-cache", default=None, choices=("on", "off"),
+                   help="'off' disables the AOT store (default: "
+                        "TPUPROF_AOT_CACHE, else on)")
+    w.add_argument("--aot-prewarm", type=int, default=None, metavar="K",
+                   help="runner keys prewarmed at startup (default: "
+                        "TPUPROF_AOT_PREWARM, else 4; 0 = lazy only)")
     w.add_argument("--config-json", metavar="JSON|@FILE",
                    help="ProfilerConfig kwargs applied to every watch "
                         "cycle's profile job, as inline JSON or "
@@ -858,9 +903,18 @@ def cmd_serve(args: argparse.Namespace) -> int:
             from tpuprof.obs.progress import Ticker
             ticker = Ticker(interval, progress=args.progress,
                             snapshots=bool(args.metrics_json)).start()
-    from tpuprof.config import (resolve_serve_auth_file,
+    from tpuprof.config import (resolve_aot_cache,
+                                resolve_aot_cache_dir,
+                                resolve_serve_auth_file,
                                 resolve_serve_http_port)
     http_port = resolve_serve_http_port(args.serve_http_port)
+    # restart-to-warm (ISSUE 15): the daemon's AOT store defaults to
+    # SPOOL/aot — a restarted daemon deserializes its compiled
+    # programs instead of re-paying the mesh+compile cost
+    aot_dir = None
+    if resolve_aot_cache(args.aot_cache) == "on":
+        aot_dir = resolve_aot_cache_dir(args.aot_cache_dir) \
+            or os.path.join(args.spool, "aot")
     # the HTTP edge implies fleet claims: N `--http` daemons on one
     # spool is the deployment shape the edge exists for, and claims
     # are what keep them from double-running each other's jobs
@@ -872,8 +926,16 @@ def cmd_serve(args: argparse.Namespace) -> int:
                          workers=args.serve_workers,
                          queue_depth=args.serve_queue_depth,
                          tenant_quota=args.serve_tenant_quota,
-                         job_timeout_s=args.job_timeout_s)
+                         job_timeout_s=args.job_timeout_s,
+                         aot_cache_dir=aot_dir,
+                         aot_cache=args.aot_cache,
+                         aot_prewarm=args.aot_prewarm)
     sched = daemon.scheduler
+    if aot_dir:
+        print(f"tpuprof: aot executable cache at {aot_dir} "
+              f"(prewarming "
+              f"{daemon.prewarmer.top_k if daemon.prewarmer else 0} "
+              "hottest keys)", file=sys.stderr)
     edge = None
     if http_port is not None:
         from tpuprof.errors import InputError
@@ -979,13 +1041,25 @@ def cmd_watch(args: argparse.Namespace) -> int:
             from tpuprof.obs.progress import Ticker
             ticker = Ticker(args.metrics_interval,
                             snapshots=True).start()
-    from tpuprof.config import (resolve_serve_auth_file,
+    from tpuprof.config import (resolve_aot_cache,
+                                resolve_aot_cache_dir,
+                                resolve_serve_auth_file,
                                 resolve_serve_http_port)
     http_port = resolve_serve_http_port(args.serve_http_port)
+    # restart-to-warm (ISSUE 15): the watch daemon's cycles share the
+    # serve AOT store default, so a restarted watch is profiling at
+    # warm latency in seconds
+    aot_dir = None
+    if resolve_aot_cache(args.aot_cache) == "on":
+        aot_dir = resolve_aot_cache_dir(args.aot_cache_dir) \
+            or os.path.join(args.spool, "aot")
     daemon = ServeDaemon(args.spool, poll_interval=args.poll_interval,
                          claim_jobs=http_port is not None,
                          workers=args.serve_workers,
-                         job_timeout_s=args.job_timeout_s)
+                         job_timeout_s=args.job_timeout_s,
+                         aot_cache_dir=aot_dir,
+                         aot_cache=args.aot_cache,
+                         aot_prewarm=args.aot_prewarm)
     edge = None
     if http_port is not None:
         from tpuprof.errors import InputError
@@ -1291,6 +1365,8 @@ def cmd_profile(args: argparse.Namespace) -> int:
             artifact_path=args.artifact,
             warehouse_dir=args.warehouse_dir,
             warehouse_format=args.warehouse_format,
+            aot_cache_dir=args.aot_cache_dir,
+            aot_cache=args.aot_cache,
             compile_cache_dir=cache_dir)
     except ValueError as exc:
         # config validation (duplicate --columns, bad thresholds, ...)

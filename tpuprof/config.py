@@ -385,6 +385,55 @@ def resolve_warehouse_format(value: Optional[str] = None) -> str:
     return "parquet"
 
 
+def resolve_aot_cache_dir(value: Optional[str] = None) -> Optional[str]:
+    """AOT executable-cache root (``aot_cache_dir`` — runtime/aot.py,
+    ROADMAP 3(d)): durable ``<digest>.aot`` entries of serialized
+    compiled executables, keyed by the resolved runner key + an
+    environment fingerprint, so a restarted process deserializes in
+    seconds instead of re-paying the 20-40 s mesh+compile cost.
+    Explicit config value, else ``TPUPROF_AOT_CACHE_DIR``, else None —
+    for one-shot profiles None means no store; the serve/watch daemons
+    default their store to ``SPOOL/aot`` instead (the restart-to-warm
+    path is the daemon's reason to have one)."""
+    if value:
+        return str(value)
+    return os.environ.get("TPUPROF_AOT_CACHE_DIR") or None
+
+
+AOT_CACHE_MODES = ("on", "off")
+
+
+def resolve_aot_cache(value: Optional[str] = None) -> str:
+    """AOT executable-cache switch (``aot_cache``): ``on`` (store
+    consulted/fed wherever an ``aot_cache_dir`` resolves) or ``off``
+    (never read or write serialized executables — the rollback knob,
+    and the way to keep a daemon's spool store dark without unsetting
+    the dir).  Explicit config value, else ``TPUPROF_AOT_CACHE``, else
+    ``on``."""
+    for cand, origin in ((value, "aot_cache"),
+                         (os.environ.get("TPUPROF_AOT_CACHE"),
+                          "TPUPROF_AOT_CACHE")):
+        if cand:
+            if cand not in AOT_CACHE_MODES:
+                raise ValueError(
+                    f"{origin}={cand!r} — use one of {AOT_CACHE_MODES}")
+            return cand
+    return "on"
+
+
+def resolve_aot_prewarm(value: Optional[int] = None) -> int:
+    """Restart prewarm width (``aot_prewarm``): how many of the AOT
+    manifest's hottest runner keys a starting daemon deserializes in
+    the background while already accepting jobs (runtime/aot.py
+    Prewarmer; progress on ``GET /v1/healthz``).  Explicit config
+    value, else ``TPUPROF_AOT_PREWARM``, else 4; 0 disables prewarm
+    (jobs still load entries lazily at their own acquire)."""
+    if value is not None:
+        return max(int(value), 0)
+    env = _env_int("TPUPROF_AOT_PREWARM")
+    return max(env, 0) if env is not None else 4
+
+
 PROFILE_PASSES = ("two_pass", "fused")
 
 
@@ -822,6 +871,40 @@ class ProfilerConfig:
                                             # WAREHOUSE_FORMAT env,
                                             # else "parquet".  CLI:
                                             # --warehouse-format
+    aot_cache_dir: Optional[str] = None     # AOT executable-cache root
+                                            # (runtime/aot.py): after a
+                                            # runner compiles, its core
+                                            # executables serialize
+                                            # here keyed by runner key
+                                            # + env fingerprint; the
+                                            # next process's same-key
+                                            # miss DESERIALIZES instead
+                                            # of compiling (restart-to-
+                                            # warm in seconds).  None =
+                                            # auto: TPUPROF_AOT_CACHE_
+                                            # DIR env, else no store
+                                            # for one-shot profiles
+                                            # (serve/watch daemons
+                                            # default to SPOOL/aot).
+                                            # CLI: --aot-cache-dir
+    aot_cache: Optional[str] = None         # "on" | "off": the AOT
+                                            # store switch/rollback —
+                                            # off never reads or
+                                            # writes serialized
+                                            # executables even with a
+                                            # dir configured.  None =
+                                            # auto: TPUPROF_AOT_CACHE
+                                            # env, else "on".  CLI:
+                                            # --aot-cache
+    aot_prewarm: Optional[int] = None       # restart prewarm width:
+                                            # manifest-hottest runner
+                                            # keys a starting daemon
+                                            # deserializes in the
+                                            # background (0 = lazy
+                                            # loads only).  None =
+                                            # auto: TPUPROF_AOT_PREWARM
+                                            # env, else 4.  CLI:
+                                            # --aot-prewarm
     artifact_keep: Optional[int] = None     # watch-cycle artifact
                                             # retention per source
                                             # (`tpuprof watch --keep`):
@@ -1017,6 +1100,15 @@ class ProfilerConfig:
                 "or None)")
         if self.artifact_keep is not None and self.artifact_keep < 1:
             raise ValueError("artifact_keep must be >= 1 (or None)")
+        if self.aot_cache is not None \
+                and self.aot_cache not in AOT_CACHE_MODES:
+            raise ValueError(
+                f"aot_cache={self.aot_cache!r} — use one of "
+                f"{AOT_CACHE_MODES} (or None for the "
+                "TPUPROF_AOT_CACHE/default resolution)")
+        if self.aot_prewarm is not None and self.aot_prewarm < 0:
+            raise ValueError("aot_prewarm must be >= 0 (0 = no "
+                             "prewarm; or None)")
         if self.warehouse_format is not None \
                 and self.warehouse_format not in WAREHOUSE_FORMATS:
             raise ValueError(

@@ -73,6 +73,17 @@ The profile warehouse (tpuprof/warehouse — ARTIFACTS.md) adds two:
   truncated/undecodable Parquet bytes, a missing or foreign schema id
   in the file metadata.  Never a raw pyarrow traceback; shares
   CorruptArtifactError's exit code 6 ("a persisted product rotted").
+
+The AOT executable cache (runtime/aot.py — ROADMAP 3(d)) adds one:
+
+* ``CorruptAotCacheError`` (CorruptArtifactError) — an AOT store
+  entry failed its integrity checks: truncation at any offset, a CRC
+  mismatch, a fingerprint that disagrees with its digest-addressed
+  filename, or a serialized executable the deserializer rejects.  The
+  acquire seam demotes it LOUDLY to a fresh compile (restarts can be
+  slow again but never wrong) and unlinks the entry, so this rarely
+  reaches a CLI; when it does (direct store surgery), it shares
+  CorruptArtifactError's exit code 6.
 """
 
 from typing import Any, Dict, List, Optional
@@ -165,6 +176,19 @@ class CorruptWarehouseError(CorruptArtifactError):
     history queries walk past a corrupt generation the way checkpoint
     restore walks its chain.  Subclasses :class:`CorruptArtifactError`,
     so it shares exit code 6 ("a persisted product rotted")."""
+
+
+class CorruptAotCacheError(CorruptArtifactError):
+    """An AOT executable-cache entry (runtime/aot.py) failed integrity
+    validation: truncated/bit-flipped envelope bytes, a payload CRC
+    mismatch, an internal fingerprint that disagrees with the entry's
+    digest-addressed filename, or a stored executable
+    ``deserialize_and_load`` rejects.  Never a raw pickle/json error;
+    the runner-acquire seam catches this, logs loudly, deletes the
+    rotten entry, and falls through to the fresh-compile path — a
+    corrupt cache may cost a restart its warm start, never its
+    correctness.  Subclasses :class:`CorruptArtifactError`, so it
+    shares exit code 6 ("a persisted product rotted")."""
 
 
 class LintFindingsError(InputError):

@@ -1,0 +1,598 @@
+"""AOT-serialized executable cache — restart-to-warm in seconds
+(ROADMAP 3(d); ISSUE 15).
+
+The PR-9 runner cache amortizes compiles *within* one process (cold
+2.79-3.12 s -> warm p50 0.056 s), but every daemon restart re-pays the
+full mesh+compile cost — 20-40 s on chip, 48.9 s for the first-ever
+compile measured on this box (PERF.md round 9).  The jaxlib persistent
+compile cache cannot close that gap here: repeated MeshRunner rebuilds
+with it enabled intermittently abort jaxlib (the PR-6/PR-9 gate), so
+the durable layer has to live ABOVE jax.  This module is that layer:
+
+* after a fresh :class:`~tpuprof.runtime.mesh.MeshRunner` builds on a
+  runner-cache miss, its core compiled programs are AOT-compiled
+  (``jit.lower(avals).compile()`` over the runner's program-extraction
+  seam), serialized with ``jax.experimental.serialize_executable``,
+  and written to a durable store — off the hot path, in a background
+  thread, keyed by the resolved PR-9 runner key PLUS an environment
+  fingerprint (jax/jaxlib versions, device platform/kind/count/ids,
+  the aot schema version);
+* the next process's miss for the same key *deserializes* those
+  executables instead of compiling them (measured ≥5x faster than the
+  compile it replaces, and the deserialized programs are bitwise-
+  identical in output — tests/test_aot.py pins stats byte-identity);
+* the store also keeps an LRU-ordered manifest of hot runner keys, so
+  a restarted daemon can prewarm its top-K runners in the background
+  while already accepting jobs (:class:`Prewarmer`; progress surfaces
+  on ``GET /v1/healthz``).
+
+Safety contract — *restarts can be slow again but never wrong*:
+
+* the environment fingerprint is part of the entry's FILENAME digest,
+  so any version/topology skew is a clean miss (different name), never
+  a wrong load; an entry whose *internal* fingerprint disagrees with
+  its digest is tampering or rot and raises typed;
+* every entry is a CRC-sealed envelope written via the lint durability
+  contract (dot-prefixed tmp + fsync + rename; this module is
+  registered in DURABLE_MODULES) — truncation at any byte offset, a
+  bit flip anywhere, an undecodable payload, or a deserializer raise
+  is the typed :class:`~tpuprof.errors.CorruptAotCacheError`, which
+  the acquire seam demotes LOUDLY to a fresh compile (and unlinks the
+  bad entry so the next restart is not haunted by it);
+* adoption is all-or-nothing per entry: every program deserializes
+  before any is adopted, so a half-rotten entry can never leave a
+  runner half-warm;
+* an adopted program that sees an argument signature the stored
+  executable was not compiled for (a different ``scan_batches``, a
+  column-subset re-bin shape) falls back to the runner's own jit
+  wrapper, which compiles exactly what the pre-AOT runner would have.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from tpuprof.errors import CorruptAotCacheError
+from tpuprof.obs import events as _obs_events
+from tpuprof.obs import metrics as _obs_metrics
+
+AOT_SCHEMA = "tpuprof-aot-v1"
+MANIFEST_SCHEMA = "tpuprof-aot-manifest-v1"
+_MAGIC = b"TPUPROF-AOT1\n"
+
+_HITS = _obs_metrics.counter(
+    "tpuprof_aot_cache_hits_total",
+    "runner-cache misses answered by deserializing AOT-cached "
+    "executables instead of compiling")
+_MISSES = _obs_metrics.counter(
+    "tpuprof_aot_cache_misses_total",
+    "runner-cache misses with no loadable AOT entry (fresh compile; "
+    "corrupt entries demote here too)")
+_LOAD_SECONDS = _obs_metrics.histogram(
+    "tpuprof_aot_load_seconds",
+    "wall seconds to deserialize + adopt one AOT store entry")
+_SAVE_SECONDS = _obs_metrics.histogram(
+    "tpuprof_aot_save_seconds",
+    "wall seconds to AOT-compile + serialize + publish one store "
+    "entry (background thread — off the serve hot path)")
+
+
+# ---------------------------------------------------------------------------
+# environment fingerprint + entry naming
+# ---------------------------------------------------------------------------
+
+def env_fingerprint(devices: Optional[Sequence] = None) -> Dict[str, Any]:
+    """Everything a serialized executable implicitly depends on beyond
+    the runner key: jax/jaxlib versions, the device platform/kind/
+    count/ids, and the aot schema version.  Part of the entry's
+    filename digest, so ANY mismatch is a miss by construction — a
+    jaxlib upgrade or a re-sliced topology can never deserialize a
+    stale executable."""
+    import jax
+    import jaxlib
+    devs = list(devices) if devices is not None else jax.devices()
+    return {
+        "schema": AOT_SCHEMA,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": devs[0].platform if devs else "?",
+        "device_kind": getattr(devs[0], "device_kind", "?")
+        if devs else "?",
+        "device_count": len(devs),
+        "devices": [[d.platform, int(d.id)] for d in devs],
+    }
+
+
+def entry_digest(key: Tuple, fingerprint: Dict[str, Any]) -> str:
+    canon = repr((tuple(key), sorted(fingerprint.items())))
+    return hashlib.sha256(canon.encode()).hexdigest()[:32]
+
+
+# ---------------------------------------------------------------------------
+# envelope: MAGIC + header json line + pickled program payload
+# ---------------------------------------------------------------------------
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """The durability contract (ANALYSIS.md): dot-prefixed tmp in the
+    same directory, fsync, then rename — a reader (or a crash) can see
+    the old entry or the new one, never torn bytes."""
+    tmp = os.path.join(
+        os.path.dirname(path) or ".",
+        f".{os.path.basename(path)}.tmp.{os.getpid()}."
+        f"{threading.get_ident()}")
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+
+
+def write_entry(path: str, key_repr: str, fingerprint: Dict[str, Any],
+                programs: Dict[str, Tuple]) -> int:
+    """Serialize one store entry (``programs``: name -> the
+    ``(payload, in_tree, out_tree)`` triple ``serialize_executable``
+    produced) and publish it atomically.  Returns the entry size."""
+    payload = pickle.dumps(programs, protocol=4)
+    header = {
+        "schema": AOT_SCHEMA,
+        "key": key_repr,
+        "fingerprint": fingerprint,
+        "programs": sorted(programs),
+        "payload_len": len(payload),
+        "payload_crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+    }
+    data = (_MAGIC + json.dumps(header, sort_keys=True).encode()
+            + b"\n" + payload)
+    _atomic_write(path, data)
+    return len(data)
+
+
+def read_entry(path: str, fingerprint: Dict[str, Any],
+               key_repr: Optional[str] = None) -> Dict[str, Tuple]:
+    """Read + integrity-check one store entry.  A missing file raises
+    ``FileNotFoundError`` (a clean miss); EVERY other failure —
+    truncation at any offset, a flipped bit, junk, a foreign schema, a
+    fingerprint that disagrees with the digest-addressed name — is the
+    typed :class:`CorruptAotCacheError`, never a raw pickle/json
+    error."""
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except FileNotFoundError:
+        raise
+    except OSError as exc:
+        raise CorruptAotCacheError(
+            f"aot entry {path!r} is unreadable "
+            f"({type(exc).__name__}: {exc})") from exc
+    if not data.startswith(_MAGIC):
+        raise CorruptAotCacheError(
+            f"aot entry {path!r} has no {AOT_SCHEMA} magic — torn, "
+            "truncated, or foreign bytes")
+    nl = data.find(b"\n", len(_MAGIC))
+    if nl < 0:
+        raise CorruptAotCacheError(
+            f"aot entry {path!r} truncated inside its header")
+    try:
+        header = json.loads(data[len(_MAGIC):nl])
+    except ValueError as exc:
+        raise CorruptAotCacheError(
+            f"aot entry {path!r} header is not valid JSON — truncated "
+            f"or corrupt ({exc})") from exc
+    if not isinstance(header, dict) or header.get("schema") != AOT_SCHEMA:
+        raise CorruptAotCacheError(
+            f"aot entry {path!r} has schema "
+            f"{header.get('schema') if isinstance(header, dict) else '?'!r};"
+            f" this build reads {AOT_SCHEMA!r}")
+    if header.get("fingerprint") != fingerprint:
+        # skew lands on a DIFFERENT filename (the digest covers the
+        # fingerprint) — a mismatch under the right name is rot/forgery
+        raise CorruptAotCacheError(
+            f"aot entry {path!r} carries a fingerprint that does not "
+            "match its digest-addressed name — forged or rotted entry")
+    if key_repr is not None and header.get("key") != key_repr:
+        raise CorruptAotCacheError(
+            f"aot entry {path!r} was written for a different runner "
+            "key than its name claims — forged or rotted entry")
+    payload = data[nl + 1:]
+    if len(payload) != header.get("payload_len") \
+            or zlib.crc32(payload) & 0xFFFFFFFF \
+            != header.get("payload_crc32"):
+        raise CorruptAotCacheError(
+            f"aot entry {path!r} payload CRC/length mismatch — "
+            "truncated or bit-rotted executables must never load")
+    try:
+        programs = pickle.loads(payload)
+    except Exception as exc:    # noqa: BLE001 — any unpickle failure
+        raise CorruptAotCacheError(
+            f"aot entry {path!r} payload does not unpickle "
+            f"({type(exc).__name__}: {exc})") from exc
+    if not isinstance(programs, dict) or not all(
+            isinstance(v, tuple) and len(v) == 3
+            for v in programs.values()):
+        raise CorruptAotCacheError(
+            f"aot entry {path!r} payload is not a program table")
+    return programs
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+class AotStore:
+    """One durable directory of ``<digest>.aot`` entries plus the
+    LRU-ordered ``manifest.json`` the prewarmer reads."""
+
+    def __init__(self, root: str,
+                 devices: Optional[Sequence] = None):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.fingerprint = env_fingerprint(devices)
+        self._manifest_lock = threading.Lock()
+
+    # -- naming -------------------------------------------------------------
+
+    def entry_path(self, key: Tuple) -> str:
+        return os.path.join(self.root,
+                            f"{entry_digest(key, self.fingerprint)}.aot")
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.root, "manifest.json")
+
+    # -- load ---------------------------------------------------------------
+
+    def load_into(self, runner, key: Tuple, config) -> int:
+        """Deserialize this key's entry and adopt its programs into
+        ``runner``.  Returns the number of programs adopted (0 = clean
+        miss); raises :class:`CorruptAotCacheError` on any integrity
+        failure.  Adoption is all-or-nothing: every program must
+        deserialize before any is adopted."""
+        from tpuprof.testing import faults as _faults
+        _faults.hit("aot_load")
+        path = self.entry_path(key)
+        t0 = time.perf_counter()
+        try:
+            programs = read_entry(path, self.fingerprint, repr(tuple(key)))
+        except FileNotFoundError:
+            return 0
+        from jax.experimental.serialize_executable import \
+            deserialize_and_load
+        scan_batches = int(getattr(config, "scan_batches", 1) or 1)
+        specs = runner.aot_program_specs(scan_batches)
+        loaded: List[Tuple[str, Any]] = []
+        for name, blob in programs.items():
+            if name not in specs:
+                continue        # a future build's extra program set
+            exe, in_tree, out_tree = blob
+            try:
+                compiled = deserialize_and_load(exe, in_tree, out_tree)
+            except Exception as exc:    # noqa: BLE001 — typed demote
+                raise CorruptAotCacheError(
+                    f"aot entry {path!r}: program {name!r} failed to "
+                    f"deserialize ({type(exc).__name__}: {exc}) — "
+                    "demoting to a fresh compile") from exc
+            loaded.append((name, compiled))
+        for name, compiled in loaded:
+            runner.adopt_program(name, compiled)
+        seconds = time.perf_counter() - t0
+        _LOAD_SECONDS.observe(seconds)
+        _obs_events.emit("aot_load", path=path, status="hit",
+                         programs=len(loaded),
+                         seconds=round(seconds, 4))
+        return len(loaded)
+
+    # -- save ---------------------------------------------------------------
+
+    def save_runner(self, key: Tuple, runner, config) -> Dict[str, Any]:
+        """AOT-compile the runner's core programs, serialize them, and
+        publish the entry + manifest row.  Synchronous — callers that
+        must stay off the hot path use :func:`schedule_save`."""
+        from jax.experimental.serialize_executable import serialize
+        scan_batches = int(getattr(config, "scan_batches", 1) or 1)
+        t0 = time.perf_counter()
+        specs = runner.aot_program_specs(scan_batches)
+        programs: Dict[str, Tuple] = {}
+        for name, (fn, avals) in specs.items():
+            compiled = fn.lower(*avals).compile()
+            payload, in_tree, out_tree = serialize(compiled)
+            programs[name] = (payload, in_tree, out_tree)
+        compile_s = time.perf_counter() - t0
+        path = self.entry_path(key)
+        t1 = time.perf_counter()
+        size = write_entry(path, repr(tuple(key)), self.fingerprint,
+                           programs)
+        seconds = time.perf_counter() - t0
+        _SAVE_SECONDS.observe(seconds)
+        _obs_events.emit("aot_save", path=path, programs=len(programs),
+                         bytes=size, seconds=round(seconds, 4),
+                         compile_seconds=round(compile_s, 4))
+        return {"path": path, "programs": len(programs), "bytes": size,
+                "compile_s": compile_s, "seconds": seconds,
+                "write_s": time.perf_counter() - t1}
+
+    # -- manifest (prewarm LRU) ---------------------------------------------
+
+    def read_manifest(self) -> Dict[str, Any]:
+        """The CRC-sealed prewarm manifest; a torn/corrupt manifest
+        degrades to empty (the entries themselves are digest-addressed
+        and self-validating — the manifest is an ordering hint, never
+        truth)."""
+        try:
+            with open(self.manifest_path, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            return {"entries": {}}
+        try:
+            doc = json.loads(data)
+            if not isinstance(doc, dict) \
+                    or doc.get("schema") != MANIFEST_SCHEMA:
+                raise ValueError("foreign schema")
+            integrity = doc.pop("integrity")
+            canon = json.dumps(doc, sort_keys=True,
+                               separators=(",", ":")).encode()
+            if zlib.crc32(canon) & 0xFFFFFFFF != integrity["crc32"]:
+                raise ValueError("crc mismatch")
+        except Exception:       # noqa: BLE001 — advisory file
+            from tpuprof.obs import blackbox
+            blackbox.record("aot_manifest_corrupt",
+                            path=self.manifest_path)
+            return {"entries": {}}
+        entries = doc.get("entries")
+        return {"entries": entries if isinstance(entries, dict) else {}}
+
+    def touch_manifest(self, key: Tuple, config, n_num: int,
+                       n_hash: int) -> None:
+        """Bump this key's LRU row (written at runner-cache miss time —
+        one write per shape per process, not per job).  Carries enough
+        to REBUILD the runner on prewarm: the shape signature plus the
+        program-relevant config fields, env-resolved now so a restart
+        under different env defaults still prewarms what actually
+        ran."""
+        from tpuprof.config import (resolve_pass_b_kernel,
+                                    resolve_profile_passes)
+        row = {
+            "last_used": round(time.time(), 3),
+            "n_num": int(n_num),
+            "n_hash": int(n_hash),
+            "config": {
+                "batch_rows": int(config.batch_rows),
+                "scan_batches": int(getattr(config, "scan_batches", 8)
+                                    or 8),
+                "mesh_devices": config.mesh_devices,
+                "hll_precision": int(config.hll_precision),
+                "bins": int(config.bins),
+                "use_pallas": config.use_pallas,
+                "use_fused": config.use_fused,
+                "pass_b_kernel": resolve_pass_b_kernel(
+                    getattr(config, "pass_b_kernel", None)),
+                "profile_passes": resolve_profile_passes(
+                    getattr(config, "profile_passes", None)),
+            },
+        }
+        with self._manifest_lock:
+            doc = self.read_manifest()
+            doc["entries"][entry_digest(key, self.fingerprint)] = row
+            core = {"schema": MANIFEST_SCHEMA, "entries": doc["entries"]}
+            sealed = dict(core)
+            sealed["integrity"] = {
+                "algorithm": "crc32/canonical-json",
+                "crc32": zlib.crc32(json.dumps(
+                    core, sort_keys=True,
+                    separators=(",", ":")).encode()) & 0xFFFFFFFF,
+            }
+            _atomic_write(self.manifest_path,
+                          json.dumps(sealed, indent=1).encode())
+
+    def entries(self) -> List[str]:
+        """Digest list of sealed entries on disk (dot-prefixed
+        in-flight temps filtered out, per the durability contract)."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return sorted(n[:-len(".aot")] for n in names
+                      if n.endswith(".aot") and not n.startswith("."))
+
+
+# ---------------------------------------------------------------------------
+# acquire-seam integration (serve/cache.RunnerCache.get calls this on
+# every in-process miss)
+# ---------------------------------------------------------------------------
+
+def store_from_config(config,
+                      devices: Optional[Sequence] = None
+                      ) -> Optional[AotStore]:
+    from tpuprof.config import resolve_aot_cache, resolve_aot_cache_dir
+    if resolve_aot_cache(getattr(config, "aot_cache", None)) != "on":
+        return None
+    root = resolve_aot_cache_dir(getattr(config, "aot_cache_dir", None))
+    if not root:
+        return None
+    try:
+        return AotStore(root, devices=devices)
+    except OSError:
+        return None             # unwritable store dir: cache off, not down
+
+
+_save_threads: List[threading.Thread] = []
+_save_lock = threading.Lock()
+_no_save = threading.local()
+
+
+class no_save:
+    """Context manager: suppress background saves on miss (the
+    prewarmer's mode — prewarm must only ever LOAD; a missing entry
+    there is not a reason to compile a runner nobody asked for)."""
+
+    def __enter__(self):
+        _no_save.active = True
+        return self
+
+    def __exit__(self, *exc):
+        _no_save.active = False
+
+
+def schedule_save(store: AotStore, key: Tuple, runner, config) -> None:
+    """AOT-compile + serialize in a background thread — off the hot
+    path (the runner's own jit wrappers compile independently on first
+    dispatch; this thread re-lowers the same programs for the store).
+    Non-daemon: a one-shot CLI process finishes the publish before
+    exiting, so the NEXT run restarts warm."""
+
+    def _run():
+        try:
+            store.save_runner(key, runner, config)
+        except Exception as exc:    # noqa: BLE001 — advisory path
+            from tpuprof.obs import blackbox
+            blackbox.record("aot_save_failed", error=f"{type(exc).__name__}: {exc}")
+
+    t = threading.Thread(target=_run, name="tpuprof-aot-save")
+    with _save_lock:
+        _save_threads.append(t)
+        del _save_threads[:-32]     # bounded bookkeeping
+    t.start()
+
+
+def wait_pending_saves(timeout: Optional[float] = None) -> None:
+    """Block until every scheduled background save finished (tests and
+    the bench harness; the daemon relies on non-daemon threads
+    instead)."""
+    with _save_lock:
+        threads = list(_save_threads)
+    deadline = None if timeout is None else time.monotonic() + timeout
+    for t in threads:
+        t.join(None if deadline is None
+               else max(deadline - time.monotonic(), 0.0))
+
+
+def on_runner_miss(runner, config, key: Tuple, n_num: int, n_hash: int,
+                   devices: Optional[Sequence] = None) -> bool:
+    """The acquire seam's hook, called right after a fresh MeshRunner
+    builds on an in-process runner-cache miss: consult the AOT store
+    before the first dispatch compiles anything.  Returns True when
+    the runner was warmed from disk.  NEVER raises — a rotten cache
+    demotes loudly to the fresh-compile path the runner already is."""
+    store = store_from_config(config, devices=devices)
+    if store is None:
+        return False
+    loaded = 0
+    try:
+        loaded = store.load_into(runner, key, config)
+    except CorruptAotCacheError as exc:
+        # loud demote: the restart is slow again but never wrong.  The
+        # bad entry is unlinked so the NEXT restart is not haunted.
+        from tpuprof.obs import blackbox
+        from tpuprof.utils.trace import logger
+        logger.warning("aot cache demoted to fresh compile: %s", exc)
+        blackbox.record("aot_load_corrupt", error=str(exc))
+        _obs_events.emit("aot_load", path=store.entry_path(key),
+                         status="corrupt", programs=0, seconds=0.0)
+        try:
+            os.unlink(store.entry_path(key))
+        except OSError:
+            pass
+    except Exception as exc:    # noqa: BLE001 — advisory layer
+        from tpuprof.obs import blackbox
+        blackbox.record("aot_load_failed",
+                        error=f"{type(exc).__name__}: {exc}")
+    try:
+        store.touch_manifest(key, config, n_num, n_hash)
+    except OSError:
+        pass
+    if loaded:
+        _HITS.inc()
+        return True
+    _MISSES.inc()
+    if not getattr(_no_save, "active", False):
+        schedule_save(store, key, runner, config)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# restart prewarm
+# ---------------------------------------------------------------------------
+
+class Prewarmer:
+    """Background restart prewarm: deserialize the manifest's top-K
+    hottest runner keys into the process runner cache while the daemon
+    is already accepting jobs.  Progress (keys loaded / pending) is
+    the ``GET /v1/healthz`` readiness signal a fleet balancer holds
+    traffic on."""
+
+    def __init__(self, root: str, top_k: int,
+                 devices: Optional[Sequence] = None):
+        self.root = root
+        self.top_k = max(int(top_k), 0)
+        self.devices = devices
+        self.loaded = 0
+        self.failed = 0
+        self.pending = 0
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Prewarmer":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpuprof-aot-prewarm")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        try:
+            from tpuprof.config import ProfilerConfig
+            from tpuprof.serve import cache as _cache
+            if self.top_k == 0 or not _cache.cache_enabled():
+                return
+            store = AotStore(self.root, devices=self.devices)
+            rows = sorted(store.read_manifest()["entries"].values(),
+                          key=lambda r: r.get("last_used") or 0,
+                          reverse=True)[: self.top_k]
+            self.pending = len(rows)
+            for row in rows:
+                try:
+                    config = ProfilerConfig(
+                        backend="tpu", aot_cache_dir=self.root,
+                        **{k: v for k, v in
+                           (row.get("config") or {}).items()})
+                    with no_save():
+                        _cache.acquire_runner(config,
+                                              int(row["n_num"]),
+                                              int(row["n_hash"]),
+                                              devices=self.devices)
+                    self.loaded += 1
+                except Exception as exc:    # noqa: BLE001 — advisory
+                    from tpuprof.obs import blackbox
+                    blackbox.record(
+                        "aot_prewarm_failed",
+                        error=f"{type(exc).__name__}: {exc}")
+                    self.failed += 1
+                finally:
+                    self.pending -= 1
+        finally:
+            self._done.set()
+            _obs_events.emit("aot_prewarm", root=self.root,
+                             loaded=self.loaded, failed=self.failed)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def status(self) -> Dict[str, Any]:
+        return {"root": self.root, "top_k": self.top_k,
+                "loaded": self.loaded, "pending": self.pending,
+                "failed": self.failed, "done": self.done()}

@@ -579,6 +579,121 @@ class MeshRunner:
         self._merge_spear = jax.jit(shard_map(
             local_merge_spear, mesh=mesh, in_specs=(state_spec,),
             out_specs=state_spec, check_vma=False))
+        # the AOT extraction seam (runtime/aot.py) reads the ORIGINAL
+        # jit wrappers from here: adoption replaces the public attrs
+        # with fallback-wrapped Compiled calls, and a save that lowered
+        # a wrapper would otherwise chase its own adopted tail
+        self._aot_jits = {
+            "step_a": self._step_a, "scan_a": self._scan_a,
+            "step_b": self._step_b, "scan_b": self._scan_b,
+        }
+        if self._step_ab is not None:
+            self._aot_jits["step_ab"] = self._step_ab
+            self._aot_jits["scan_ab"] = self._scan_ab
+
+    # -- AOT executable extraction/adoption seam (runtime/aot.py) ----------
+
+    def _sharded_aval(self, shape, dtype, spec):
+        return jax.ShapeDtypeStruct(tuple(shape), dtype,
+                                    sharding=NamedSharding(self.mesh,
+                                                           spec))
+
+    def _tree_aval(self, shapes: Pytree, spec) -> Pytree:
+        return jax.tree.map(
+            lambda l: self._sharded_aval(l.shape, l.dtype, spec), shapes)
+
+    def aot_program_specs(self, scan_batches: int = 1) -> Dict[str, tuple]:
+        """``{name: (jit_wrapper, abstract_args)}`` for every program
+        the AOT executable cache persists (ISSUE 15): the core fold/
+        scan programs, the packed finalize gathers, and the on-device
+        pass-B bounds — exactly the set a serve job's steady state
+        dispatches.  Abstract args carry the REAL input shardings
+        (state P("data"), batches as put_batch places them), so a
+        ``lower().compile()`` over them produces the same executable
+        the traced first dispatch would.  ``scan_batches`` sizes the
+        multi-batch scan programs (full groups; partial tails fall
+        back to the per-batch programs, adopted or not)."""
+        state_a = self._tree_aval(jax.eval_shape(self.init_pass_a),
+                                  P("data"))
+        state_b = self._tree_aval(jax.eval_shape(self.init_pass_b),
+                                  P("data"))
+        xt = self._sharded_aval((self.n_num, self.rows), jnp.float32,
+                                P(None, "data"))
+        rv = self._sharded_aval((self.rows,), jnp.bool_, P("data"))
+        ht = self._sharded_aval((self.n_hash, self.rows), jnp.uint16,
+                                P(None, "data"))
+        rep = self._sharded_aval((self.n_num,), jnp.float32, P())
+        s = max(int(scan_batches), 1)
+        xts = self._sharded_aval((s, self.n_num, self.rows),
+                                 jnp.float32, P(None, None, "data"))
+        rvs = self._sharded_aval((s, self.rows), jnp.bool_,
+                                 P(None, "data"))
+        hts = self._sharded_aval((s, self.n_hash, self.rows),
+                                 jnp.uint16, P(None, None, "data"))
+        jits = self._aot_jits
+        specs = {
+            "step_a": (jits["step_a"], (state_a, xt, rv, ht)),
+            "scan_a": (jits["scan_a"], (state_a, xts, rvs, hts)),
+            "step_b": (jits["step_b"], (state_b, xt, rv, rep, rep, rep)),
+            "scan_b": (jits["scan_b"], (state_b, xts, rvs,
+                                        rep, rep, rep)),
+        }
+        if "step_ab" in jits:
+            specs["step_ab"] = (jits["step_ab"],
+                                (state_a, state_b, xt, rv, ht,
+                                 rep, rep, rep))
+            specs["scan_ab"] = (jits["scan_ab"],
+                                (state_a, state_b, xts, rvs, hts,
+                                 rep, rep, rep))
+        gather_a = self._ensure_gather("a", self._merge_a, state_a)[0]
+        if gather_a is not None:
+            specs["gather:a"] = (gather_a, (state_a,))
+        b_key = "b:" + repr(tuple(state_b["counts"].shape))
+        gather_b = self._ensure_gather(b_key, self._merge_b,
+                                       state_b)[0]
+        if gather_b is not None:
+            specs["gather:" + b_key] = (gather_b, (state_b,))
+        specs["bounds_b"] = (self._ensure_bounds_b(), (state_a,))
+        return specs
+
+    @staticmethod
+    def _with_fallback(compiled, fallback):
+        """Adopted-program call: the deserialized executable answers
+        signatures it was compiled for; anything else (a tail stack's
+        different S, a column-subset re-bin shape) falls back to the
+        runner's own jit wrapper — which compiles exactly what the
+        pre-AOT runner would have, so adoption never changes results.
+        The aval check runs before execution, so no buffer is donated
+        on the fallback path."""
+        def call(*args):
+            try:
+                return compiled(*args)
+            except (TypeError, ValueError):
+                return fallback(*args)
+        call._aot_fallback = fallback
+        return call
+
+    def adopt_program(self, name: str, compiled) -> None:
+        """Route one program's dispatches through a deserialized
+        executable (runtime/aot.py).  Unknown names raise — the store
+        validates names against :meth:`aot_program_specs` first."""
+        if name.startswith("gather:"):
+            key = name[len("gather:"):]
+            fn, treedef, spec = self._gather_cache[key]
+            if fn is not None:
+                self._gather_cache[key] = (
+                    self._with_fallback(compiled, fn), treedef, spec)
+            return
+        if name == "bounds_b":
+            self._bounds_b = self._with_fallback(compiled,
+                                                 self._ensure_bounds_b())
+            return
+        attr = {"step_a": "_step_a", "scan_a": "_scan_a",
+                "step_b": "_step_b", "scan_b": "_scan_b",
+                "step_ab": "_step_ab", "scan_ab": "_scan_ab"}[name]
+        self._aot_jits[name]        # KeyError on a program not built
+        setattr(self, attr,
+                self._with_fallback(compiled, self._aot_jits[name]))
 
     # -- driver API --------------------------------------------------------
 
@@ -784,6 +899,27 @@ class MeshRunner:
         splits it back by a cached (treedef, shapes, dtypes) spec.
         Falls back to the per-leaf path for dtypes with no 32-bit
         bitcast (none in the current states)."""
+        fn, treedef, spec = self._ensure_gather(key, merge_fn, state)
+        if fn is None:      # non-32-bit dtype somewhere: per-leaf path
+            with _DISPATCH_LOCK:
+                sliced = jax.tree.map(lambda a: a[0], merge_fn(state))
+            return jax.device_get(sliced)
+        with _DISPATCH_LOCK:        # enqueue the packed merge program;
+            out = fn(state)         # fetch below blocks unlocked
+        buf = np.asarray(jax.device_get(out))
+        leaves, pos = [], 0
+        for shape, dtype in spec:
+            n_elems = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            n_words = n_elems * dtype.itemsize // 4     # carrier int32s
+            chunk = buf[pos:pos + n_words]
+            pos += n_words
+            leaves.append(chunk.view(dtype).reshape(shape))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _ensure_gather(self, key: str, merge_fn, state: Pytree):
+        """Build (or return) the packed-gather cache entry for ``key``
+        — works with an ABSTRACT state too (the AOT extraction seam
+        builds entries from ShapeDtypeStructs without any dispatch)."""
         cached = self._gather_cache.get(key)
         if cached is None:
             merged_shape = jax.eval_shape(merge_fn, state)
@@ -827,22 +963,7 @@ class MeshRunner:
                     return jnp.concatenate(flat)
                 self._gather_cache[key] = (jax.jit(packed), treedef, spec)
             cached = self._gather_cache[key]
-        fn, treedef, spec = cached
-        if fn is None:      # non-32-bit dtype somewhere: per-leaf path
-            with _DISPATCH_LOCK:
-                sliced = jax.tree.map(lambda a: a[0], merge_fn(state))
-            return jax.device_get(sliced)
-        with _DISPATCH_LOCK:        # enqueue the packed merge program;
-            out = fn(state)         # fetch below blocks unlocked
-        buf = np.asarray(jax.device_get(out))
-        leaves, pos = [], 0
-        for shape, dtype in spec:
-            n_elems = int(np.prod(shape, dtype=np.int64)) if shape else 1
-            n_words = n_elems * dtype.itemsize // 4     # carrier int32s
-            chunk = buf[pos:pos + n_words]
-            pos += n_words
-            leaves.append(chunk.view(dtype).reshape(shape))
-        return jax.tree_util.tree_unflatten(treedef, leaves)
+        return cached
 
     def bounds_b_device(self, state: Pytree):
         """(lo, hi, mean) for pass B computed ON DEVICE from the pass-A
@@ -851,7 +972,14 @@ class MeshRunner:
         dispatch with NO host round trip after pass A, so finalize_a's
         device->host transfer overlaps pass B's execution instead of
         serializing before it."""
-        if self._bounds_b is None:
+        self._ensure_bounds_b()
+        with _DISPATCH_LOCK:
+            return self._bounds_b(state)
+
+    def _ensure_bounds_b(self):
+        """Build (no dispatch) the bounds program if needed; returns
+        the UNADOPTED jit (the AOT seam's lower/fallback target)."""
+        if getattr(self, "_bounds_b_jit", None) is None:
             def f(st):
                 mom = jax.tree.map(lambda a: a[0], self._merge_a(st)["mom"])
                 n = mom["n"].astype(jnp.float32)
@@ -866,7 +994,7 @@ class MeshRunner:
                 mean = jnp.where(jnp.isfinite(mean), mean, 0.0)
                 return (lo.astype(jnp.float32), hi.astype(jnp.float32),
                         mean.astype(jnp.float32))
-            self._bounds_b = jax.jit(
+            self._bounds_b_jit = jax.jit(
                 f, out_shardings=(self._sh_rep,) * 3)
-        with _DISPATCH_LOCK:
-            return self._bounds_b(state)
+            self._bounds_b = self._bounds_b_jit
+        return self._bounds_b_jit

@@ -136,6 +136,16 @@ class RunnerCache:
             _note_build_with_cache()
             from tpuprof.runtime.mesh import MeshRunner
             runner = MeshRunner(config, n_num, n_hash, devices=devices)
+            # AOT executable cache (runtime/aot.py, ISSUE 15): before
+            # the first dispatch compiles anything, try deserializing
+            # this key's stored executables — a restarted daemon warms
+            # in seconds; on a store miss the entry is compiled +
+            # published by a background thread, off this hot path.
+            # Never raises: a rotten store demotes loudly to the fresh
+            # compile the runner already is.
+            from tpuprof.runtime import aot as _aot
+            _aot.on_runner_miss(runner, config, key, n_num, n_hash,
+                                devices=devices)
             self._runners[key] = runner
             while len(self._runners) > self.capacity:
                 self._runners.popitem(last=False)
@@ -232,4 +242,8 @@ def _note_build_with_cache() -> None:
             "further program builds (first build kept it): repeated "
             "MeshRunner rebuilds with the cache enabled intermittently "
             "abort jaxlib.  Warm starts come from the in-process runner "
-            "cache; set TPUPROF_COMPILE_CACHE_REBUILDS=1 to opt out.")
+            "cache, and CROSS-RESTART warmth from the app-level AOT "
+            "executable cache (aot_cache_dir / TPUPROF_AOT_CACHE_DIR — "
+            "the supported path; serve/watch daemons default it to "
+            "SPOOL/aot).  Set TPUPROF_COMPILE_CACHE_REBUILDS=1 to opt "
+            "out of the gate.")

@@ -28,6 +28,11 @@ Routes::
                                  (read-only; ISSUE 11 satellite — watch
                                  consumers poll the edge, not the
                                  spool filesystem)
+    GET  /v1/healthz             daemon readiness for fleet balancers
+                                 (unauthenticated, like /metrics):
+                                 200 ready, 503 warming (AOT restart
+                                 prewarm in progress — keys loaded/
+                                 pending in the body), 503 draining
     GET  /metrics                Prometheus text exposition of the
                                  process registry (the scrape surface;
                                  unauthenticated by design, like every
@@ -233,6 +238,11 @@ class HttpEdge:
             return (200,
                     _obs_metrics.registry().render_text().encode(),
                     "/metrics")
+        if method == "GET" and path == "/v1/healthz":
+            # unauthenticated like /metrics: a fleet balancer's probe
+            # carries no tenant credential, and readiness leaks nothing
+            # a scrape of /metrics does not already say
+            return self._healthz()
         if not path.startswith("/v1/"):
             return 404, {"error": f"no route {path!r}"}, "other"
         tenant = None
@@ -262,6 +272,37 @@ class HttpEdge:
             if m:
                 return self._get_history(m.group(1), query)
         return 404, {"error": f"no route {method} {path!r}"}, "other"
+
+    def _healthz(self) -> Tuple[int, Any, str]:
+        """Daemon readiness + AOT prewarm progress (ISSUE 15): 200
+        only when this daemon would answer a job at warm-class
+        latency.  A fleet balancer holds traffic on the 503s —
+        ``draining`` (graceful stop in progress, the PR-11 QueueClosed
+        semantic) or ``warming`` (restart prewarm still deserializing
+        its top-K runner keys; the body carries keys loaded/pending so
+        dashboards can show progress).  Jobs are ACCEPTED in every
+        state short of draining — warming only means the first ones
+        may pay a load."""
+        route = "/v1/healthz"
+        daemon = self.daemon
+        prewarmer = getattr(daemon, "prewarmer", None)
+        prewarm = prewarmer.status() if prewarmer is not None else None
+        body: Dict[str, Any] = {
+            "daemon": daemon.daemon_id,
+            "aot_cache_dir": getattr(daemon, "aot_cache_dir", None),
+            "prewarm": prewarm,
+        }
+        with daemon.scheduler._lock:
+            body["active"] = len(daemon.scheduler._active)
+        body["queued"] = len(daemon.scheduler._queue)
+        if daemon.stop_event.is_set():
+            body["status"] = "draining"
+            return 503, body, route
+        if prewarm is not None and not prewarm["done"]:
+            body["status"] = "warming"
+            return 503, body, route
+        body["status"] = "ready"
+        return 200, body, route
 
     def _post_job(self, body: Optional[bytes],
                   auth_tenant: Optional[str]) -> Tuple[int, Any, str]:

@@ -56,12 +56,19 @@ class ProfileScheduler:
                  queue_depth: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
                  job_timeout_s: Optional[float] = None,
+                 aot_cache_dir: Optional[str] = None,
                  devices: Optional[Sequence] = None):
-        from tpuprof.config import (resolve_job_timeout,
+        from tpuprof.config import (resolve_aot_cache_dir,
+                                    resolve_job_timeout,
                                     resolve_serve_queue_depth,
                                     resolve_serve_tenant_quota,
                                     resolve_serve_workers)
         self.workers = resolve_serve_workers(workers)
+        # daemon-level AOT executable-cache root (runtime/aot.py): a
+        # job that says nothing about its own store inherits it, so
+        # every serve/watch job's runner key feeds the same restart-
+        # to-warm store; a job's explicit aot_* fields win
+        self.aot_cache_dir = resolve_aot_cache_dir(aot_cache_dir)
         # daemon-level default for jobs that say nothing about their
         # own timeout; a job's explicit job_timeout_s override wins
         self.job_timeout_s = resolve_job_timeout(job_timeout_s)
@@ -151,6 +158,12 @@ class ProfileScheduler:
             # 6): every job inherits the daemon's watchdog unless it
             # names its own deadline
             kwargs.setdefault("job_timeout_s", self.job_timeout_s)
+        if self.aot_cache_dir is not None:
+            # same inheritance for the AOT executable store (ISSUE
+            # 15): the runner key deliberately ignores aot_* fields,
+            # so this changes which store warms the build, never which
+            # runner answers the job
+            kwargs.setdefault("aot_cache_dir", self.aot_cache_dir)
         if "metrics_enabled" not in kwargs:
             # collect() applies each config's obs knobs PROCESS-WIDE
             # (one-shot CLI semantics); a job that says nothing about

@@ -217,12 +217,37 @@ class ServeDaemon:
                  claim_jobs: bool = False,
                  daemon_id: Optional[str] = None,
                  liveness_timeout_s: Optional[float] = None,
+                 aot_cache_dir: Optional[str] = None,
+                 aot_cache: Optional[str] = None,
+                 aot_prewarm: Optional[int] = None,
                  **scheduler_kwargs):
         self.spool = spool
         self.dirs = _spool_dirs(spool)
         self.poll_interval = max(float(poll_interval), 0.01)
+        # AOT executable cache (runtime/aot.py, ISSUE 15): the daemon's
+        # restart-to-warm store.  The CLI defaults it to SPOOL/aot;
+        # library embeddings opt in by passing a dir (or the env twin).
+        from tpuprof.config import (resolve_aot_cache,
+                                    resolve_aot_cache_dir,
+                                    resolve_aot_prewarm)
+        self.aot_cache_dir = None
+        if resolve_aot_cache(aot_cache) == "on":
+            self.aot_cache_dir = resolve_aot_cache_dir(aot_cache_dir)
+        if scheduler is None and self.aot_cache_dir:
+            scheduler_kwargs.setdefault("aot_cache_dir",
+                                        self.aot_cache_dir)
         self.scheduler = scheduler if scheduler is not None \
             else ProfileScheduler(**scheduler_kwargs)
+        # restart prewarm: deserialize the manifest's hottest runner
+        # keys in the background while the poll loop below is already
+        # accepting jobs; /v1/healthz reports the progress so a fleet
+        # balancer can hold traffic until this daemon is warm
+        self.prewarmer = None
+        if self.aot_cache_dir:
+            from tpuprof.runtime import aot as _aot
+            self.prewarmer = _aot.Prewarmer(
+                self.aot_cache_dir,
+                resolve_aot_prewarm(aot_prewarm)).start()
         self._pending: Dict[str, Job] = {}   # submitted, result not yet out
         self._seen: set = set()
         self.stop_event = threading.Event()

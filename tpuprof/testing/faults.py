@@ -32,6 +32,10 @@ Sites wired today (grep ``faults.hit`` / ``faults.mangle``):
 ``singlepass_rebin``      start of a fused profile's targeted pass-B
                           re-bin (backends/tpu.py edge-miss fallback —
                           runtime/singlepass.py)
+``aot_load``              start of an AOT executable-cache entry load
+                          (runtime/aot.py — a raising load demotes
+                          loudly to a fresh compile, never fails the
+                          profile)
 ========================  ==================================================
 
 Spec grammar (config/env-driven; ``TPUPROF_FAULTS`` +
@@ -105,6 +109,9 @@ SITES = frozenset({
     # single-pass profiles (runtime/singlepass.py): the targeted
     # pass-B re-bin a fused profile runs on edge misses
     "singlepass_rebin",
+    # AOT executable cache (runtime/aot.py): entry load on a
+    # runner-cache miss — raises demote to a fresh compile
+    "aot_load",
 })
 
 
