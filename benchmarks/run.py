@@ -1155,6 +1155,11 @@ def measure_serve_http(rows: int, workdir: str, jobs: int = 104,
              "--http", "0", "--daemon-id", daemon_id,
              "--serve-workers", "2", "--serve-queue-depth", "256",
              "--liveness-timeout", "2", "--serve-auth-file", auth_path,
+             # the load lane submits IDENTICAL jobs on purpose (any
+             # daemon must serve any of them) — this leg measures
+             # compute throughput, so the read tier that would collapse
+             # them to one compute stays off (serve_read measures it)
+             "--read-cache", "off",
              "--no-compile-cache"],
             cwd=here, stderr=subprocess.DEVNULL)
 
@@ -1269,6 +1274,309 @@ def run_serve_http(scale: float, workdir: str) -> dict:
     rows = max(int(1_000_000 * scale), 10_000)
     out = measure_serve_http(rows, workdir)
     out["scenario"] = "serve_http"
+    return out
+
+
+def measure_serve_read(rows: int, workdir: str, reads: int = 1600,
+                       clients: int = 4, coalesce_k: int = 8) -> dict:
+    """Read-path tier envelope (ISSUE 16): ONE real `tpuprof serve
+    --http 0` daemon with the read tier at its product default (ON),
+    driven over keep-alive HTTP —
+
+    * miss path: the first stats export computes through the daemon
+      and must be byte-identical to the one-shot in-process path (the
+      leg FAILS otherwise — a cached wrong answer served fast is
+      worse than no cache);
+    * exactly-once: ``coalesce_k`` concurrent identical submits on a
+      COLD key must compute exactly once (healthz ``computed`` delta
+      == 1; the rest ride as coalesced followers or cache hits, all
+      with identical answers), and a late subscriber is answered
+      straight from the cache (``read_cache: "hit"``);
+    * pushdown: POST /v1/query answers from the pre-fed warehouse
+      generation (provenance ``warehouse``, values equal to the
+      one-shot), a repeat serves from the answer cache
+      (``X-Tpuprof-Provenance: cache``, same bytes), and touching the
+      source past the generation recomputes (provenance ``computed``);
+    * load: ``reads`` >=95%-read requests (conditional GETs + cache-
+      hit submits, 95% exactly) from ``clients`` keep-alive
+      connections -> ``serve_read_rps`` (must be >= 500 req/s) and
+      the read-hit latency tail (p99 must be < 50 ms)."""
+    import http.client
+    import shutil
+    import subprocess
+    import threading
+    from urllib.parse import urlsplit
+
+    fixture = _ensure_fixture("taxi", rows, workdir)
+    spool = os.path.join(workdir, "serve_read_spool")
+    shutil.rmtree(spool, ignore_errors=True)
+    cfg = {"batch_rows": 1 << 12}
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    from tpuprof import ProfileReport, ProfilerConfig
+    from tpuprof.serve import discover_edges, submit_job, wait_result_http
+    from tpuprof.warehouse import store
+
+    # one-shot ground truth, profiled BEFORE the daemon spawns: it
+    # seeds the warehouse generation the pushdown tier answers from
+    # and is the byte-identity reference for the miss path
+    report = ProfileReport(fixture,
+                           config=ProfilerConfig(backend="tpu", **cfg))
+    one_shot = report.to_json_dict()
+    desc = report.description
+    store.append_generation(os.path.join(spool, "warehouse"), fixture,
+                            desc, rows=int(desc["table"]["n"]),
+                            created_unix=time.time())
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpuprof", "serve", spool,
+         "--http", "0", "--daemon-id", "d0", "--serve-workers", "2",
+         "--serve-queue-depth", "256", "--no-compile-cache"],
+        cwd=here, stderr=subprocess.DEVNULL)
+    out: dict = {"rows": rows}
+    try:
+        deadline = time.monotonic() + 300
+        while "d0" not in discover_edges(spool):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"edge never advertised: {discover_edges(spool)}")
+            time.sleep(0.2)
+        url = discover_edges(spool)["d0"]
+        parts = urlsplit(url)
+        host, port = parts.hostname, parts.port
+
+        def _req(conn, method, path, body=None, headers=None):
+            payload = json.dumps(body).encode() if body is not None \
+                else None
+            t0 = time.perf_counter()
+            conn.request(method, path, body=payload,
+                         headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            return (resp.status, data, dict(resp.getheaders()),
+                    time.perf_counter() - t0)
+
+        ctl = http.client.HTTPConnection(host, port, timeout=1800)
+
+        # miss path: compute once through the daemon; the exported
+        # stats must equal the one-shot in-process export exactly
+        http_stats = os.path.join(workdir, "serve_read_stats.json")
+        t0 = time.perf_counter()
+        _code, doc = submit_job(url, fixture, stats_json=http_stats,
+                                config_kwargs=dict(cfg))
+        res = wait_result_http(url, doc["id"], timeout=1800)
+        if res["status"] != "done":
+            raise RuntimeError(f"miss-path job failed: {res}")
+        out["serve_read_miss_s"] = round(time.perf_counter() - t0, 3)
+        with open(http_stats) as fh:
+            if json.load(fh) != one_shot:
+                raise RuntimeError(
+                    "read-tier miss path differs from the one-shot path")
+
+        # seed the answer cache with one pure submit; its result is
+        # the conditional-GET target for the load lane below
+        _code, doc = submit_job(url, fixture, config_kwargs=dict(cfg))
+        seed = wait_result_http(url, doc["id"], timeout=1800)
+        if seed["status"] != "done":
+            raise RuntimeError(f"seed job failed: {seed}")
+
+        # exactly-once lane: K concurrent submits on a COLD key (a
+        # config fingerprint nothing above has computed)
+        cfg_cold = {"batch_rows": 1 << 11}
+        _s, h0_raw, _h, _t = _req(ctl, "GET", "/v1/healthz")
+        h0 = json.loads(h0_raw)
+        gate = threading.Barrier(coalesce_k)
+        docs: list = [None] * coalesce_k
+        errs: list = []
+
+        def _one(k):
+            try:
+                gate.wait(timeout=60)
+                _c, d = submit_job(url, fixture,
+                                   config_kwargs=dict(cfg_cold))
+                docs[k] = wait_result_http(url, d["id"], timeout=1800)
+            except Exception as exc:           # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=_one, args=(k,))
+                   for k in range(coalesce_k)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1800)
+        if errs:
+            raise RuntimeError(f"coalesce lane failed: {errs[0]}")
+        _s, h1_raw, _h, _t = _req(ctl, "GET", "/v1/healthz")
+        h1 = json.loads(h1_raw)
+        computed = h1["computed"] - h0["computed"]
+        folded = ((h1["coalesced"] - h0["coalesced"])
+                  + (h1["read_cache"]["hits"]
+                     - h0["read_cache"]["hits"]))
+        if computed != 1:
+            raise RuntimeError(
+                f"{coalesce_k} identical submits computed {computed}x "
+                "(exactly-once violated)")
+        if folded < coalesce_k - 1:
+            raise RuntimeError(
+                f"only {folded}/{coalesce_k - 1} submits folded onto "
+                "the one compute")
+        # fan-out identity: everything but the per-job lifecycle
+        # fields must be byte-for-byte the one computed answer
+        volatile = ("id", "seconds", "queue_seconds", "cache_hit",
+                    "coalesced_with", "read_cache")
+        stable = [{k: v for k, v in d.items() if k not in volatile}
+                  for d in docs]
+        if any(s != stable[0] for s in stable[1:]):
+            raise RuntimeError("coalesced followers got different "
+                               "answers")
+        out["serve_read_coalesce_k"] = coalesce_k
+        out["serve_read_coalesce_computed"] = computed
+        out["serve_read_coalesce_folded"] = folded
+
+        # late subscriber: answered from the cache, no recompute
+        _c, d = submit_job(url, fixture, config_kwargs=dict(cfg_cold))
+        late = wait_result_http(url, d["id"], timeout=1800)
+        if late.get("read_cache") != "hit":
+            raise RuntimeError(
+                f"late subscriber was not served from cache: {late}")
+
+        # pushdown lane: warehouse tier answers without profiling,
+        # the repeat serves from the answer cache
+        # numeric columns only: a categorical column has no mean to
+        # push down, and the leg compares means exactly
+        qcols = sorted(c for c, v in desc["variables"].items()
+                       if isinstance(v, dict)
+                       and v.get("mean") is not None)[:2]
+        if not qcols:
+            raise RuntimeError("fixture has no numeric columns")
+        q = {"source": fixture, "cols": qcols, "stats": ["mean"]}
+        jhdr = {"Content-Type": "application/json"}
+        t0 = time.perf_counter()
+        st, qraw, qh, _ = _req(ctl, "POST", "/v1/query", body=q,
+                               headers=jhdr)
+        out["serve_read_query_warehouse_s"] = \
+            round(time.perf_counter() - t0, 4)
+        qdoc = json.loads(qraw)
+        if st != 200 or qdoc.get("provenance") != "warehouse":
+            raise RuntimeError(f"pushdown warehouse tier: {st} {qdoc}")
+        for c in qcols:
+            if qdoc["columns"][c]["mean"] != \
+                    desc["variables"][c]["mean"]:
+                raise RuntimeError(
+                    f"pushdown answer for {c!r} differs from the "
+                    "one-shot description")
+        st2, qraw2, qh2, _ = _req(ctl, "POST", "/v1/query", body=q,
+                                  headers=jhdr)
+        if st2 != 200 or qh2.get("X-Tpuprof-Provenance") != "cache" \
+                or qraw2 != qraw:
+            raise RuntimeError(
+                "repeat query did not serve the same bytes from cache")
+
+        # the load: >=95%-read traffic over keep-alive connections —
+        # 19 conditional GETs (304 revalidations of the seed result)
+        # per 1 cache-hit submit, timed per request
+        rpath = "/v1/results/" + seed["id"]
+        st, _b, hdrs0, _ = _req(ctl, "GET", rpath)
+        if st != 200 or "ETag" not in hdrs0:
+            raise RuntimeError(f"seed result fetch: {st} {hdrs0}")
+        etag = hdrs0["ETag"]
+        per = reads // clients
+        write_every = 20                    # 1 in 20 -> exactly 95% GET
+        lock = threading.Lock()
+        lats: list = []
+        lerrs: list = []
+
+        def _client(_k):
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            my = []
+            try:
+                for i in range(per):
+                    if i % write_every == write_every - 1:
+                        st_, _p, _hh, dt = _req(
+                            conn, "POST", "/v1/jobs",
+                            body={"source": fixture,
+                                  "config": dict(cfg)},
+                            headers=jhdr)
+                        if st_ != 202:
+                            raise RuntimeError(
+                                f"load submit -> {st_}")
+                    else:
+                        st_, _p, _hh, dt = _req(
+                            conn, "GET", rpath,
+                            headers={"If-None-Match": etag})
+                        if st_ != 304:
+                            raise RuntimeError(
+                                f"conditional GET -> {st_}")
+                    my.append(dt)
+                with lock:
+                    lats.extend(my)
+            except Exception as exc:           # noqa: BLE001
+                with lock:
+                    lerrs.append(exc)
+            finally:
+                conn.close()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=_client, args=(k,))
+                   for k in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t0
+        if lerrs:
+            raise RuntimeError(f"read load failed: {lerrs[0]}")
+        lat = sorted(lats)
+        rps = len(lat) / wall
+        p50 = lat[(len(lat) - 1) // 2]
+        p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)]
+        out.update({
+            "serve_read_requests": len(lat),
+            "serve_read_clients": clients,
+            "serve_read_read_fraction": round(
+                (write_every - 1) / write_every, 3),
+            "serve_read_wall_s": round(wall, 3),
+            "serve_read_rps": round(rps, 1),
+            "serve_read_hit_p50_ms": round(p50 * 1000, 2),
+            "serve_read_hit_p99_ms": round(p99 * 1000, 2),
+            "rows_per_sec": round(rps, 1),
+        })
+        if rps < 500:
+            raise RuntimeError(
+                f"read tier sustained {rps:.0f} req/s (< 500 floor)")
+        if p99 >= 0.050:
+            raise RuntimeError(
+                f"cache-hit p99 {p99 * 1000:.1f}ms (>= 50ms ceiling)")
+
+        # computed pushdown tier LAST: the utime invalidates every
+        # source-fingerprint key, which would wreck the lanes above
+        os.utime(fixture)
+        t0 = time.perf_counter()
+        st3, qraw3, qh3, _ = _req(ctl, "POST", "/v1/query", body=q,
+                                  headers=jhdr)
+        out["serve_read_query_computed_s"] = \
+            round(time.perf_counter() - t0, 3)
+        qdoc3 = json.loads(qraw3)
+        if st3 != 200 or qdoc3.get("provenance") != "computed":
+            raise RuntimeError(f"pushdown computed tier: {st3} {qdoc3}")
+        ctl.close()
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    return out
+
+
+def run_serve_read(scale: float, workdir: str) -> dict:
+    # small fixture on purpose (the serve-leg rationale): the tracked
+    # signals are read-tier throughput, the hit-latency tail, and the
+    # exactly-once/provenance invariants — not scan throughput
+    rows = max(int(1_000_000 * scale), 10_000)
+    out = measure_serve_read(rows, workdir)
+    out["scenario"] = "serve_read"
     return out
 
 
@@ -1801,7 +2109,8 @@ def run_serve(scale: float, workdir: str) -> dict:
 REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
                         "hostfed", "prepare", "passb", "faults", "drift",
                         "rebalance", "serve", "watch", "serve_http",
-                        "warehouse", "lint", "singlepass", "restart")
+                        "warehouse", "lint", "singlepass", "restart",
+                        "serve_read")
 
 
 def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
@@ -1834,7 +2143,8 @@ def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
 
 _DELTA_KEYMAP = {"passb": "pass_b_rows_per_sec",
                  "prepare": "prepare_rows_per_sec",
-                 "faults": "guarded_rows_per_sec"}
+                 "faults": "guarded_rows_per_sec",
+                 "serve_read": "serve_read_rps"}
 
 
 def _historical_bands() -> dict:
@@ -2014,6 +2324,11 @@ def run_regression(scale: float, workdir: str,
             notes = (f"warm in {r['restart_to_warm_s']}s, "
                      f"deser {r['aot_deserialize_speedup_x']}x, "
                      f"job {r['restart_warm_vs_cold_x']}x")
+        if "serve_read_rps" in r:
+            notes = (f"{r['serve_read_rps']} req/s, hit p99 "
+                     f"{r['serve_read_hit_p99_ms']}ms, computed "
+                     f"{r['serve_read_coalesce_computed']}/"
+                     f"{r['serve_read_coalesce_k']}")
         rate = r.get("rows_per_sec",
                      r.get("prepare_rows_per_sec", float("nan")))
         rows = r.get("rows")
@@ -2036,7 +2351,7 @@ def main() -> None:
                                              "serve", "watch",
                                              "serve_http", "warehouse",
                                              "lint", "singlepass",
-                                             "restart",
+                                             "restart", "serve_read",
                                              "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
@@ -2074,7 +2389,7 @@ def main() -> None:
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
               "prepare", "passb", "faults", "drift", "rebalance",
               "wideexact", "serve", "watch", "serve_http", "warehouse",
-              "lint", "singlepass", "restart"]
+              "lint", "singlepass", "restart", "serve_read"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -2111,6 +2426,8 @@ def main() -> None:
             result = run_singlepass(args.scale, args.workdir)
         elif name == "restart":
             result = run_restart(args.scale, args.workdir)
+        elif name == "serve_read":
+            result = run_serve_read(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

@@ -553,6 +553,69 @@ class TestWarehouseConfigRoundTrip:
             build_parser().parse_args(
                 ["profile", "t.parquet", "--aot-cache", "maybe"])
 
+    def test_read_cache_env_cli_config_resolve_identically(
+            self, monkeypatch):
+        """`read_cache` / `read_cache_entries` / `read_cache_bytes`
+        three-way round-trips (ISSUE 16 satellite)."""
+        from tpuprof.cli import build_parser
+        from tpuprof.config import (resolve_read_cache,
+                                    resolve_read_cache_bytes,
+                                    resolve_read_cache_entries)
+        for var in ("TPUPROF_READ_CACHE", "TPUPROF_READ_CACHE_ENTRIES",
+                    "TPUPROF_READ_CACHE_BYTES"):
+            monkeypatch.delenv(var, raising=False)
+
+        via_config = resolve_read_cache(
+            ProfilerConfig(read_cache="off").read_cache)
+        args = build_parser().parse_args(
+            ["serve", "spool", "--read-cache", "off"])
+        via_cli = resolve_read_cache(args.read_cache)
+        monkeypatch.setenv("TPUPROF_READ_CACHE", "off")
+        via_env = resolve_read_cache(None)
+        assert via_config == via_cli == via_env == "off"
+        assert resolve_read_cache("on") == "on"   # explicit beats env
+        monkeypatch.delenv("TPUPROF_READ_CACHE")
+        assert resolve_read_cache(None) == "on"   # default
+
+        via_config = resolve_read_cache_entries(
+            ProfilerConfig(read_cache_entries=9).read_cache_entries)
+        args = build_parser().parse_args(
+            ["serve", "spool", "--read-cache-entries", "9"])
+        via_cli = resolve_read_cache_entries(args.read_cache_entries)
+        monkeypatch.setenv("TPUPROF_READ_CACHE_ENTRIES", "9")
+        via_env = resolve_read_cache_entries(None)
+        assert via_config == via_cli == via_env == 9
+        monkeypatch.delenv("TPUPROF_READ_CACHE_ENTRIES")
+        assert resolve_read_cache_entries(None) == 512   # default
+
+        via_config = resolve_read_cache_bytes(
+            ProfilerConfig(read_cache_bytes=4096).read_cache_bytes)
+        args = build_parser().parse_args(
+            ["serve", "spool", "--read-cache-bytes", "4096"])
+        via_cli = resolve_read_cache_bytes(args.read_cache_bytes)
+        monkeypatch.setenv("TPUPROF_READ_CACHE_BYTES", "4096")
+        via_env = resolve_read_cache_bytes(None)
+        assert via_config == via_cli == via_env == 4096
+        monkeypatch.delenv("TPUPROF_READ_CACHE_BYTES")
+        assert resolve_read_cache_bytes(None) == 64 << 20   # default
+
+    def test_read_cache_validation(self, monkeypatch):
+        with pytest.raises(ValueError, match="read_cache"):
+            ProfilerConfig(read_cache="maybe")
+        with pytest.raises(ValueError, match="read_cache_entries"):
+            ProfilerConfig(read_cache_entries=0)
+        with pytest.raises(ValueError, match="read_cache_bytes"):
+            ProfilerConfig(read_cache_bytes=0)
+        monkeypatch.setenv("TPUPROF_READ_CACHE", "maybe")
+        from tpuprof.config import resolve_read_cache
+        with pytest.raises(ValueError, match="TPUPROF_READ_CACHE"):
+            resolve_read_cache(None)
+        monkeypatch.delenv("TPUPROF_READ_CACHE")
+        from tpuprof.cli import build_parser
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(
+                ["serve", "spool", "--read-cache", "maybe"])
+
     def test_history_backtest_parsers(self):
         from tpuprof.cli import build_parser
         args = build_parser().parse_args(

@@ -522,6 +522,12 @@ class TestKillOneDaemon:
                 [_sys.executable, "-m", "tpuprof", "serve", spool,
                  "--http", "0", "--daemon-id", daemon_id,
                  "--serve-workers", "1", "--liveness-timeout", "2",
+                 # the 4 submits are byte-identical on purpose (any
+                 # daemon must be able to answer any of them) — the
+                 # read tier would collapse them onto ONE compute,
+                 # which is exactly what this exactly-once test must
+                 # NOT let happen
+                 "--read-cache", "off",
                  "--no-compile-cache"],
                 env=env, cwd=repo, stderr=subprocess.DEVNULL)
 

@@ -95,6 +95,8 @@ EVENT_SCHEMA = {
                   "seconds": ((int, float), True),
                   "queue_seconds": ((int, float, type(None)), False),
                   "cache_hit": ((bool, type(None)), False),
+                  "read_cache": ((str, type(None)), False),
+                  "coalesced_with": ((str, type(None)), False),
                   "error": ((str, type(None)), False)},
     # periodic daemon liveness (scheduler.heartbeat())
     "serve_heartbeat": {"ts": ((int, float), True),
@@ -191,6 +193,20 @@ EVENT_SCHEMA = {
                  "compile_seconds": ((int, float), False)},
     "aot_prewarm": {"ts": ((int, float), True), "root": ((str,), True),
                     "loaded": ((int,), True), "failed": ((int,), True)},
+    # edge read tier (serve/cache.py + serve/http.py, ISSUE 16): one
+    # per result-cache store and per CRC-demote (hits are counter-only
+    # — they are the hot path), and one per /v1/query answered, tagged
+    # with the tier that produced it (cache|warehouse|computed)
+    "read_cache": {"ts": ((int, float), True),
+                   "status": ((str,), True),
+                   "bytes": ((int,), True),
+                   "entries": ((int,), True)},
+    "query_pushdown": {"ts": ((int, float), True),
+                       "source": ((str,), True),
+                       "provenance": ((str,), True),
+                       "cols": ((int,), True),
+                       "stats": ((int,), True),
+                       "seconds": ((int, float), True)},
 }
 
 

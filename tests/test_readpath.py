@@ -1,0 +1,418 @@
+"""Read-path tier (tpuprof/serve/cache.py ResultCache + scheduler
+coalescing + /v1/query pushdown — ISSUE 16): the edge result cache's
+LRU/CRC discipline, N concurrent same-key submits collapsing onto ONE
+compute with N byte-identical fan-outs, conditional requests (ETag /
+If-None-Match -> 304) on results and history, the three-tier query
+answer (cache | warehouse | computed) with provenance labeling, and
+the selector edge's HTTP/1.1 keep-alive.  Every server binds port 0."""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuprof.serve import ProfileScheduler
+from tpuprof.serve.cache import (ResultCache, canonical_body, etag_for,
+                                 source_fingerprint)
+from tpuprof.testing import faults
+
+from test_http import CFG, _http, running_edge  # noqa: F401
+
+pytestmark = pytest.mark.http
+
+
+@pytest.fixture
+def parquet_path(tmp_path):
+    rng = np.random.default_rng(3)
+    n = 2000
+    df = pd.DataFrame({
+        "a": rng.normal(5, 1, n),
+        "b": rng.exponential(2.0, n),
+        "c": rng.choice(["u", "v"], n),
+    })
+    path = str(tmp_path / "rp.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# ResultCache unit behavior: LRU caps, CRC demote, stats
+# ---------------------------------------------------------------------------
+
+class TestResultCache:
+    def test_round_trip_is_byte_identical_with_stable_etag(self):
+        rc = ResultCache()
+        doc = {"rows": 10, "cols": 3}
+        etag = rc.put("k", doc)
+        payload, got_etag = rc.get("k")
+        assert payload == canonical_body(doc)
+        assert got_etag == etag == etag_for(payload)
+
+    def test_entry_cap_evicts_least_recently_used(self):
+        rc = ResultCache(capacity=2, max_bytes=1 << 20)
+        rc.put("a", {"v": 1})
+        rc.put("b", {"v": 2})
+        assert rc.get("a") is not None      # touch: "a" is now MRU
+        rc.put("c", {"v": 3})               # evicts "b", not "a"
+        assert rc.get("b") is None
+        assert rc.get("a") is not None and rc.get("c") is not None
+        assert rc.stats()["evictions"] == 1
+
+    def test_bytes_cap_evicts_until_under(self):
+        one = len(canonical_body({"v": 1}))
+        rc = ResultCache(capacity=64, max_bytes=2 * one + 1)
+        rc.put("a", {"v": 1})
+        rc.put("b", {"v": 2})
+        rc.put("c", {"v": 3})
+        st = rc.stats()
+        assert st["entries"] == 2 and st["bytes"] <= rc.max_bytes
+        assert rc.get("a") is None          # oldest paid the cap
+
+    def test_oversized_answer_passes_through_uncached(self):
+        rc = ResultCache(capacity=4, max_bytes=64)
+        etag = rc.put("big", {"blob": "x" * 1024})
+        assert etag.startswith('"crc32-')
+        assert rc.get("big") is None
+        assert rc.stats()["entries"] == 0
+
+    def test_corrupt_entry_demotes_to_a_miss(self):
+        """Flipped payload bytes must NEVER be served: the entry drops,
+        the demote is counted, the lookup reports a miss (the
+        CorruptReadCacheError discipline — never wrong, only slower)."""
+        rc = ResultCache()
+        rc.put("k", {"rows": 7})
+        payload, crc = rc._entries["k"]
+        rc._entries["k"] = (payload[:-2] + b"!\n", crc)
+        assert rc.get("k") is None
+        st = rc.stats()
+        assert st["demotes"] == 1 and st["entries"] == 0
+        assert rc.get("k") is None          # dropped, not resurrected
+
+    def test_hit_rate_reports(self):
+        rc = ResultCache()
+        rc.put("k", {"v": 1})
+        rc.get("k")
+        rc.get("nope")
+        st = rc.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        assert st["hit_rate"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# scheduler read tier: repeat answers, coalescing contention
+# ---------------------------------------------------------------------------
+
+class TestSchedulerReadTier:
+    def test_repeat_submit_hits_the_cache(self, parquet_path):
+        with ProfileScheduler(workers=1, read_cache="on") as sched:
+            first = sched.submit(source=parquet_path,
+                                 config_kwargs=dict(CFG))
+            sched.wait(first, timeout=600)
+            assert first.state == "done" and first.read_cache is None
+            again = sched.submit(source=parquet_path,
+                                 config_kwargs=dict(CFG))
+            assert again.state == "done"
+            assert again.read_cache == "hit"
+            assert again.result == first.result
+            st = sched.stats()
+            assert st["computed"] == 1
+            assert st["read_cache"]["hits"] == 1
+
+    def test_changed_source_bytes_invalidate(self, parquet_path):
+        with ProfileScheduler(workers=1, read_cache="on") as sched:
+            first = sched.submit(source=parquet_path,
+                                 config_kwargs=dict(CFG))
+            sched.wait(first, timeout=600)
+            # rewrite the file: mtime_ns/size move, the fingerprint
+            # with them — the cached answer must NOT serve
+            os.utime(parquet_path,
+                     ns=(time.time_ns(), time.time_ns() + 10**9))
+            again = sched.submit(source=parquet_path,
+                                 config_kwargs=dict(CFG))
+            sched.wait(again, timeout=600)
+            assert again.state == "done" and again.read_cache is None
+            assert sched.stats()["computed"] == 2
+
+    def test_side_effect_jobs_never_cache(self, parquet_path, tmp_path):
+        out = str(tmp_path / "r.json")
+        with ProfileScheduler(workers=1, read_cache="on") as sched:
+            for _ in range(2):
+                j = sched.submit(source=parquet_path, stats_json=out,
+                                 config_kwargs=dict(CFG))
+                sched.wait(j, timeout=600)
+                assert j.state == "done" and j.read_cache is None
+            assert sched.stats()["computed"] == 2
+
+    def test_off_by_default_at_the_library_layer(self, parquet_path):
+        with ProfileScheduler(workers=1) as sched:
+            for _ in range(2):
+                j = sched.submit(source=parquet_path,
+                                 config_kwargs=dict(CFG))
+                sched.wait(j, timeout=600)
+                assert j.read_cache is None
+            assert sched.stats()["computed"] == 2
+            assert sched.stats()["read_cache"] is None
+
+    def test_k_concurrent_submits_one_compute_identical_results(
+            self, parquet_path):
+        """The contention contract: K threads submit the same pure job
+        while the first is still running — exactly ONE profile runs,
+        every submitter gets a byte-identical answer, and a late
+        subscriber after the fan-out is served from the cache."""
+        K = 6
+        faults.configure("serve_job:sleep=1.0")
+        try:
+            with ProfileScheduler(workers=2, read_cache="on") as sched:
+                jobs, errs = [], []
+                gate = threading.Barrier(K)
+
+                def one():
+                    try:
+                        gate.wait(timeout=30)
+                        jobs.append(sched.submit(
+                            source=parquet_path,
+                            config_kwargs=dict(CFG)))
+                    except Exception as exc:   # pragma: no cover
+                        errs.append(exc)
+
+                threads = [threading.Thread(target=one)
+                           for _ in range(K)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=120)
+                assert not errs
+                for j in jobs:
+                    sched.wait(j, timeout=600)
+                    assert j.state == "done", (j.id, j.error)
+                bodies = {canonical_body(j.result) for j in jobs}
+                assert len(bodies) == 1         # byte-identical fan-out
+                st = sched.stats()
+                assert st["computed"] == 1, st
+                assert st["coalesced"] + st["read_cache"]["hits"] \
+                    == K - 1, st
+                assert st["done"] == K
+                # late subscriber: terminal answer straight from cache
+                late = sched.submit(source=parquet_path,
+                                    config_kwargs=dict(CFG))
+                assert late.state == "done"
+                assert late.read_cache == "hit"
+                assert canonical_body(late.result) in bodies
+                assert sched.stats()["computed"] == 1
+        finally:
+            faults.reset()
+
+    def test_followers_share_the_primarys_failure(self, parquet_path):
+        """A coalesced follower of a FAILING job fails with the same
+        typed error/exit code — it must not hang or silently succeed."""
+        faults.configure("serve_job:sleep=0.8,prep:fatal@1")
+        try:
+            with ProfileScheduler(workers=1, read_cache="on") as sched:
+                a = sched.submit(source=parquet_path,
+                                 config_kwargs=dict(CFG))
+                time.sleep(0.2)     # a is sleeping in its worker
+                b = sched.submit(source=parquet_path,
+                                 config_kwargs=dict(CFG))
+                assert b.coalesced_with == a.id
+                sched.wait(a, timeout=600)
+                sched.wait(b, timeout=600)
+                assert a.state == "failed" and b.state == "failed"
+                assert b.exit_code == a.exit_code
+                assert b.error == a.error
+                # a failure is never cached: the next submit recomputes
+                assert sched.stats()["read_cache"]["entries"] == 0
+        finally:
+            faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# conditional requests on the edge: ETag / If-None-Match -> 304
+# ---------------------------------------------------------------------------
+
+class TestConditionalRequests:
+    def test_result_carries_etag_and_honors_if_none_match(
+            self, parquet_path, tmp_path):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool, read_cache="on") as (_d, edge):
+            code, doc, _ = _http("POST", edge.url + "/v1/jobs",
+                                 body={"source": parquet_path,
+                                       "config": dict(CFG)})
+            assert code == 202, doc
+            jid = doc["id"]
+            deadline = time.monotonic() + 600
+            while True:
+                code, doc, hdrs = _http(
+                    "GET", edge.url + f"/v1/results/{jid}")
+                if code == 200 and doc.get("status") == "done":
+                    break
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+            etag = hdrs["ETag"]
+            assert etag.startswith('"crc32-')
+            assert int(hdrs["Content-Length"]) > 0
+            # conditional poll: unchanged -> 304, empty body
+            conn = http.client.HTTPConnection(edge.host, edge.port,
+                                              timeout=30)
+            try:
+                conn.request("GET", f"/v1/results/{jid}",
+                             headers={"If-None-Match": etag})
+                resp = conn.getresponse()
+                body = resp.read()
+                assert resp.status == 304 and body == b""
+                assert resp.headers["ETag"] == etag
+            finally:
+                conn.close()
+
+    def test_keepalive_serves_two_requests_on_one_connection(
+            self, tmp_path):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool) as (_d, edge):
+            conn = http.client.HTTPConnection(edge.host, edge.port,
+                                              timeout=30)
+            try:
+                for _ in range(2):
+                    conn.request("GET", "/v1/healthz")
+                    resp = conn.getresponse()
+                    doc = json.loads(resp.read())
+                    assert resp.status in (200, 503)
+                    assert "status" in doc
+            finally:
+                conn.close()
+
+    def test_healthz_reports_read_cache_stats(self, parquet_path,
+                                              tmp_path):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool, read_cache="on") as (daemon, edge):
+            job = daemon.scheduler.submit(source=parquet_path,
+                                          config_kwargs=dict(CFG))
+            daemon.scheduler.wait(job, timeout=600)
+            daemon.scheduler.submit(source=parquet_path,
+                                    config_kwargs=dict(CFG))
+            _code, doc, _ = _http("GET", edge.url + "/v1/healthz")
+            rc = doc["read_cache"]
+            assert rc["entries"] == 1 and rc["hits"] == 1
+            assert rc["bytes"] > 0 and rc["hit_rate"] > 0
+            assert doc["computed"] == 1 and doc["coalesced"] == 0
+
+    def test_healthz_read_cache_is_null_when_off(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool) as (_d, edge):
+            _code, doc, _ = _http("GET", edge.url + "/v1/healthz")
+            assert doc["read_cache"] is None
+
+
+# ---------------------------------------------------------------------------
+# POST /v1/query: warehouse pushdown -> narrow profile -> cache
+# ---------------------------------------------------------------------------
+
+class TestQueryPushdown:
+    def test_three_tiers_with_provenance_labels(self, parquet_path,
+                                                tmp_path):
+        from tpuprof import ProfileReport
+        from tpuprof.warehouse import store
+
+        spool = str(tmp_path / "spool")
+        report = ProfileReport(parquet_path, backend="cpu")
+        desc = report.description
+        store.append_generation(
+            os.path.join(spool, "warehouse"), parquet_path,
+            desc, rows=int(desc["table"]["n"]),
+            created_unix=time.time())
+        with running_edge(spool, read_cache="on") as (_d, edge):
+            q = {"source": parquet_path, "cols": ["a", "b"],
+                 "stats": ["mean", "std"]}
+            # tier 2: the generation post-dates the source
+            code, doc, hdrs = _http("POST", edge.url + "/v1/query",
+                                    body=dict(q))
+            assert code == 200, doc
+            assert hdrs["X-Tpuprof-Provenance"] == "warehouse"
+            assert doc["provenance"] == "warehouse"
+            assert doc["columns"]["a"]["mean"] == \
+                desc["variables"]["a"]["mean"]
+            assert doc["columns"]["b"]["std"] == \
+                desc["variables"]["b"]["std"]
+            etag = hdrs["ETag"]
+            # tier 1: repeat is byte-identical, labeled cache
+            code2, doc2, hdrs2 = _http("POST", edge.url + "/v1/query",
+                                       body=dict(q))
+            assert code2 == 200
+            assert hdrs2["X-Tpuprof-Provenance"] == "cache"
+            assert hdrs2["ETag"] == etag
+            assert doc2 == doc          # same bytes -> same document
+            # conditional repeat -> 304
+            conn = http.client.HTTPConnection(edge.host, edge.port,
+                                              timeout=30)
+            try:
+                conn.request("POST", "/v1/query",
+                             body=json.dumps(q).encode(),
+                             headers={"If-None-Match": etag,
+                                      "Content-Type":
+                                          "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 304 and resp.read() == b""
+            finally:
+                conn.close()
+            # tier 3: touch the source past the generation -> stale
+            # warehouse, a NARROW profile computes the answer
+            os.utime(parquet_path,
+                     ns=(time.time_ns() + 10**9,
+                         time.time_ns() + 10**9))
+            code3, doc3, hdrs3 = _http("POST", edge.url + "/v1/query",
+                                       body=dict(q), timeout=600)
+            assert code3 == 200, doc3
+            assert hdrs3["X-Tpuprof-Provenance"] == "computed"
+            assert doc3["provenance"] == "computed"
+            for col in ("a", "b"):
+                for stat in ("mean", "std"):
+                    got = doc3["columns"][col][stat]
+                    want = desc["variables"][col][stat]
+                    assert got == pytest.approx(want, rel=1e-6), \
+                        (col, stat)
+
+    def test_missing_column_falls_through_to_computed(
+            self, parquet_path, tmp_path):
+        """A warehouse generation that never profiled a requested
+        column cannot answer the whole question — the query must
+        compute, not return a partial answer labeled warehouse."""
+        from tpuprof import ProfileReport
+        from tpuprof.warehouse import store
+
+        spool = str(tmp_path / "spool")
+        cfg_narrow = dict(CFG, columns=["b"])
+        report = ProfileReport(parquet_path, backend="cpu",
+                               columns=["b"])
+        desc = report.description
+        store.append_generation(
+            os.path.join(spool, "warehouse"), parquet_path,
+            desc, rows=int(desc["table"]["n"]),
+            created_unix=time.time())
+        del cfg_narrow
+        with running_edge(spool, read_cache="on") as (_d, edge):
+            code, doc, hdrs = _http(
+                "POST", edge.url + "/v1/query",
+                body={"source": parquet_path, "cols": ["a"]},
+                timeout=600)
+            assert code == 200, doc
+            assert hdrs["X-Tpuprof-Provenance"] == "computed"
+            assert doc["columns"]["a"]["mean"] is not None
+
+    def test_query_validation_rejects_bad_bodies(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        with running_edge(spool) as (_d, edge):
+            for body in ({"cols": ["a"]},               # no source
+                         {"source": "s"},               # no cols
+                         {"source": "s", "cols": []},   # empty cols
+                         {"source": "s", "cols": "a"},  # not a list
+                         {"source": "s", "cols": ["a"],
+                          "stats": "mean"}):            # stats not list
+                code, doc, _ = _http("POST", edge.url + "/v1/query",
+                                     body=body)
+                assert code == 400, (body, doc)
+                assert "error" in doc

@@ -394,6 +394,28 @@ def build_parser() -> argparse.ArgumentParser:
                           "runner keys at startup, in the background "
                           "(0 = lazy loads only; default: "
                           "TPUPROF_AOT_PREWARM, else 4)")
+    read = s.add_argument_group(
+        "read-path tier (edge result cache + coalescing)", "terminal "
+        "answers keyed by (source fingerprint, config fingerprint): a "
+        "repeat submit of an unchanged source serves byte-identical "
+        "bytes in microseconds, N concurrent identical submits "
+        "collapse onto ONE compute, and POST /v1/query answers column "
+        "stats from the warehouse before scheduling anything")
+    read.add_argument("--read-cache", default=None,
+                      choices=("on", "off"),
+                      help="'off' disables the result cache AND "
+                           "coalescing — every submit computes "
+                           "(default: TPUPROF_READ_CACHE, else on)")
+    read.add_argument("--read-cache-entries", type=int, default=None,
+                      metavar="N",
+                      help="LRU entry cap on the result cache "
+                           "(default: TPUPROF_READ_CACHE_ENTRIES, "
+                           "else 512)")
+    read.add_argument("--read-cache-bytes", type=int, default=None,
+                      metavar="B",
+                      help="LRU byte cap on cached answer payloads "
+                           "(default: TPUPROF_READ_CACHE_BYTES, else "
+                           "64 MiB)")
     s.add_argument("--once", action="store_true",
                    help="answer the spool's current jobs, then exit "
                         "(CI / cron mode; default: serve forever)")
@@ -905,6 +927,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
                             snapshots=bool(args.metrics_json)).start()
     from tpuprof.config import (resolve_aot_cache,
                                 resolve_aot_cache_dir,
+                                resolve_read_cache,
+                                resolve_read_cache_bytes,
+                                resolve_read_cache_entries,
                                 resolve_serve_auth_file,
                                 resolve_serve_http_port)
     http_port = resolve_serve_http_port(args.serve_http_port)
@@ -929,7 +954,12 @@ def cmd_serve(args: argparse.Namespace) -> int:
                          job_timeout_s=args.job_timeout_s,
                          aot_cache_dir=aot_dir,
                          aot_cache=args.aot_cache,
-                         aot_prewarm=args.aot_prewarm)
+                         aot_prewarm=args.aot_prewarm,
+                         read_cache=resolve_read_cache(args.read_cache),
+                         read_cache_entries=resolve_read_cache_entries(
+                             args.read_cache_entries),
+                         read_cache_bytes=resolve_read_cache_bytes(
+                             args.read_cache_bytes))
     sched = daemon.scheduler
     if aot_dir:
         print(f"tpuprof: aot executable cache at {aot_dir} "

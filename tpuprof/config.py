@@ -421,6 +421,50 @@ def resolve_aot_cache(value: Optional[str] = None) -> str:
     return "on"
 
 
+READ_CACHE_MODES = ("on", "off")
+
+
+def resolve_read_cache(value: Optional[str] = None) -> str:
+    """Edge read-tier switch (``read_cache`` — serve/cache.py
+    ResultCache + serve/scheduler.py coalescing, ISSUE 16): ``on``
+    (repeat side-effect-free submits answer from the result cache, and
+    concurrent same-key submits coalesce onto one compute) or ``off``
+    (every submit computes — the rollback knob, and the mode the
+    kill/steal fleet tests and compute-path benches pin).  Explicit
+    config value, else ``TPUPROF_READ_CACHE``, else ``on``."""
+    for cand, origin in ((value, "read_cache"),
+                         (os.environ.get("TPUPROF_READ_CACHE"),
+                          "TPUPROF_READ_CACHE")):
+        if cand:
+            if cand not in READ_CACHE_MODES:
+                raise ValueError(
+                    f"{origin}={cand!r} — use one of {READ_CACHE_MODES}")
+            return cand
+    return "on"
+
+
+def resolve_read_cache_entries(value: Optional[int] = None) -> int:
+    """Read-tier result-cache entry cap (``read_cache_entries``):
+    explicit config value, else ``TPUPROF_READ_CACHE_ENTRIES``, else
+    512 cached answers."""
+    if value is not None:
+        return max(int(value), 1)
+    env = _env_int("TPUPROF_READ_CACHE_ENTRIES")
+    return max(env, 1) if env is not None else 512
+
+
+def resolve_read_cache_bytes(value: Optional[int] = None) -> int:
+    """Read-tier result-cache payload-bytes cap
+    (``read_cache_bytes``): explicit config value, else
+    ``TPUPROF_READ_CACHE_BYTES``, else 64 MiB — wide-table answers are
+    large, and the byte cap (not the entry cap) is what keeps a few of
+    them from pinning the edge's memory."""
+    if value is not None:
+        return max(int(value), 1)
+    env = _env_int("TPUPROF_READ_CACHE_BYTES")
+    return max(env, 1) if env is not None else 64 << 20
+
+
 def resolve_aot_prewarm(value: Optional[int] = None) -> int:
     """Restart prewarm width (``aot_prewarm``): how many of the AOT
     manifest's hottest runner keys a starting daemon deserializes in
@@ -905,6 +949,29 @@ class ProfilerConfig:
                                             # auto: TPUPROF_AOT_PREWARM
                                             # env, else 4.  CLI:
                                             # --aot-prewarm
+    read_cache: Optional[str] = None        # "on" | "off": the edge
+                                            # read tier (serve/cache.py
+                                            # ResultCache + scheduler
+                                            # coalescing) — off makes
+                                            # every submit compute (the
+                                            # rollback knob).  None =
+                                            # auto: TPUPROF_READ_CACHE
+                                            # env, else "on".  CLI:
+                                            # --read-cache
+    read_cache_entries: Optional[int] = None  # read-tier result-cache
+                                            # entry cap (LRU).  None =
+                                            # auto: TPUPROF_READ_CACHE_
+                                            # ENTRIES env, else 512.
+                                            # CLI: --read-cache-entries
+    read_cache_bytes: Optional[int] = None  # read-tier result-cache
+                                            # total payload-bytes cap —
+                                            # what keeps a few wide-
+                                            # table answers from
+                                            # pinning the edge's
+                                            # memory.  None = auto:
+                                            # TPUPROF_READ_CACHE_BYTES
+                                            # env, else 64 MiB.  CLI:
+                                            # --read-cache-bytes
     artifact_keep: Optional[int] = None     # watch-cycle artifact
                                             # retention per source
                                             # (`tpuprof watch --keep`):
@@ -1130,6 +1197,18 @@ class ProfilerConfig:
             raise ValueError(
                 "serve_http_port must be in [0, 65535] (0 = ephemeral; "
                 "or None = no HTTP edge)")
+        if self.read_cache is not None \
+                and self.read_cache not in READ_CACHE_MODES:
+            raise ValueError(
+                f"read_cache={self.read_cache!r} — use one of "
+                f"{READ_CACHE_MODES} (or None for the "
+                "TPUPROF_READ_CACHE/default resolution)")
+        if self.read_cache_entries is not None \
+                and self.read_cache_entries < 1:
+            raise ValueError("read_cache_entries must be >= 1 (or None)")
+        if self.read_cache_bytes is not None \
+                and self.read_cache_bytes < 1:
+            raise ValueError("read_cache_bytes must be >= 1 (or None)")
         if self.metrics_interval < 0:
             raise ValueError("metrics_interval must be >= 0")
         if self.metrics_max_bytes is not None \

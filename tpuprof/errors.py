@@ -84,6 +84,16 @@ The AOT executable cache (runtime/aot.py — ROADMAP 3(d)) adds one:
   slow again but never wrong) and unlinks the entry, so this rarely
   reaches a CLI; when it does (direct store surgery), it shares
   CorruptArtifactError's exit code 6.
+
+The edge read tier (serve/cache.py ResultCache — ISSUE 16) adds one:
+
+* ``CorruptReadCacheError`` (CorruptArtifactError) — a read-cache
+  entry's payload bytes no longer match the CRC recorded at store
+  time (in-memory bit rot, or a bug that mutated a cached buffer).
+  The cache demotes the entry LOUDLY to a miss — a repeat request can
+  cost a recompute but never serve rotten bytes — so this rarely
+  escapes the cache; when it does, it shares CorruptArtifactError's
+  exit code 6.
 """
 
 from typing import Any, Dict, List, Optional
@@ -189,6 +199,17 @@ class CorruptAotCacheError(CorruptArtifactError):
     corrupt cache may cost a restart its warm start, never its
     correctness.  Subclasses :class:`CorruptArtifactError`, so it
     shares exit code 6 ("a persisted product rotted")."""
+
+
+class CorruptReadCacheError(CorruptArtifactError):
+    """An edge read-cache entry (serve/cache.py ResultCache) failed its
+    integrity check: the payload bytes re-hash to a different CRC than
+    the one recorded when the entry was stored.  The cache catches
+    this, logs loudly, drops the entry, and reports a miss — a rotten
+    cache may cost a repeat request its sub-millisecond answer, never
+    its correctness (the PR-15 AOT-demote discipline applied to the
+    read tier).  Subclasses :class:`CorruptArtifactError`, so it shares
+    exit code 6 ("a persisted product rotted")."""
 
 
 class LintFindingsError(InputError):

@@ -6,15 +6,18 @@ is paid once and every warm profile answers in sub-seconds:
 
 * serve/cache.py      keyed MeshRunner cache (config fingerprint fields
                       + shape signature) + the per-process persistent-
-                      compile-cache gate
+                      compile-cache gate + the edge ResultCache (read
+                      tier: terminal answers keyed by source/config
+                      fingerprints, CRC-checked, LRU-bounded)
 * serve/jobs.py       job state machine + bounded multi-tenant queue
 * serve/scheduler.py  worker pool, SLO metrics, job lifecycle,
                       per-job watchdog (job_timeout_s)
 * serve/server.py     spool-directory daemon + submit client transport,
                       plus the fleet claim path (N daemons, one spool:
                       atomic job claims, heartbeats, stale-claim steal)
-* serve/http.py       the network edge: threaded stdlib HTTP server on
-                      the same scheduler (POST /v1/jobs, results,
+* serve/http.py       the network edge: selector-based async HTTP
+                      server on the same scheduler (POST /v1/jobs,
+                      results with ETag/304, POST /v1/query pushdown,
                       metrics, watch alert feeds; bearer-token ->
                       tenant auth) + the `tpuprof submit --url` client
 * serve/watch.py      continuous drift watch: scheduled re-profiles,
@@ -26,8 +29,10 @@ package; embed :class:`ProfileScheduler` directly for in-process use
 (the serve bench does).
 """
 
-from tpuprof.serve.cache import (RunnerCache, acquire_runner, cache_stats,
-                                 process_cache, runner_key)
+from tpuprof.serve.cache import (ResultCache, RunnerCache, acquire_runner,
+                                 cache_stats, canonical_body, etag_for,
+                                 process_cache, runner_key,
+                                 source_fingerprint)
 from tpuprof.serve.http import (HttpEdge, discover_edges, load_auth_file,
                                 submit_job, wait_result_http)
 from tpuprof.serve.jobs import (Job, JobQueue, QueueClosed, QueueFull,
@@ -41,10 +46,11 @@ from tpuprof.serve.watch import (DriftWatcher, SourceWatch,
 
 __all__ = [
     "DriftWatcher", "HttpEdge", "Job", "JobQueue", "ProfileScheduler",
-    "QueueClosed", "QueueFull", "RunnerCache", "ServeDaemon",
-    "SourceWatch", "TenantQuotaExceeded", "WATCH_MANIFEST_SCHEMA",
-    "acquire_runner", "cache_stats", "discover_edges", "load_auth_file",
+    "QueueClosed", "QueueFull", "ResultCache", "RunnerCache",
+    "ServeDaemon", "SourceWatch", "TenantQuotaExceeded",
+    "WATCH_MANIFEST_SCHEMA", "acquire_runner", "cache_stats",
+    "canonical_body", "discover_edges", "etag_for", "load_auth_file",
     "poll_intervals", "process_cache", "read_manifest", "read_result",
-    "runner_key", "submit_job", "wait_result", "wait_result_http",
-    "write_job", "write_manifest",
+    "runner_key", "source_fingerprint", "submit_job", "wait_result",
+    "wait_result_http", "write_job", "write_manifest",
 ]

@@ -28,15 +28,27 @@ FIRST cache-enabled build keep the persistent cache — that is the
 cold-start the disk cache exists to amortize across process restarts —
 and disables it before every later build.  ``TPUPROF_COMPILE_CACHE_
 REBUILDS=1`` opts back into the old always-on behavior.
+
+The read tier (ISSUE 16) lives here too: :class:`ResultCache` is the
+edge's ANSWER cache — canonical serialized response bodies keyed by
+(source fingerprint, config fingerprint), bytes-capped LRU, CRC-checked
+on every read with a typed loud demote (:class:`~tpuprof.errors.
+CorruptReadCacheError`) so a rotten entry costs a recompute, never a
+wrong answer.  A hit never touches the mesh, the spool, or even the
+scheduler queue — the request is answered at admission.
 """
 
 from __future__ import annotations
 
 import collections
+import hashlib
+import json
 import os
 import threading
+import zlib
 from typing import Any, Dict, Optional, Sequence, Tuple
 
+from tpuprof.obs import events as _obs_events
 from tpuprof.obs import metrics as _obs_metrics
 
 _CACHE_HITS = _obs_metrics.counter(
@@ -247,3 +259,180 @@ def _note_build_with_cache() -> None:
             "the supported path; serve/watch daemons default it to "
             "SPOOL/aot).  Set TPUPROF_COMPILE_CACHE_REBUILDS=1 to opt "
             "out of the gate.")
+
+
+# ---------------------------------------------------------------------------
+# edge result/answer cache — the read tier (ISSUE 16 (a))
+# ---------------------------------------------------------------------------
+
+_READ_HITS = _obs_metrics.counter(
+    "tpuprof_read_cache_hits_total",
+    "read-tier requests answered from the edge result cache (no "
+    "scheduler admission, no mesh)")
+_READ_MISSES = _obs_metrics.counter(
+    "tpuprof_read_cache_misses_total",
+    "read-tier lookups that found no (or a rotten) cached answer")
+_READ_EVICTIONS = _obs_metrics.counter(
+    "tpuprof_read_cache_evictions_total",
+    "read-cache entries dropped to respect the entry/bytes caps")
+_READ_DEMOTES = _obs_metrics.counter(
+    "tpuprof_read_cache_demotes_total",
+    "read-cache entries dropped because their payload failed its CRC "
+    "check (CorruptReadCacheError demoted to a miss)")
+_READ_BYTES = _obs_metrics.gauge(
+    "tpuprof_read_cache_bytes",
+    "payload bytes currently held by the edge result cache")
+_READ_ENTRIES = _obs_metrics.gauge(
+    "tpuprof_read_cache_entries",
+    "entries currently held by the edge result cache")
+
+
+def source_fingerprint(source: Any) -> str:
+    """The read-tier's identity for a source: path + mtime_ns + size,
+    hashed short.  Touching (or rewriting) the file changes the
+    fingerprint, so cached answers invalidate NATURALLY — no TTL knob,
+    no stale-read window wider than one stat() — while repeat requests
+    against an unchanged file share one key.  A source that cannot be
+    stat'ed (not a local file: a URL, a just-deleted path) falls back
+    to the path text alone, which still coalesces concurrent repeats."""
+    text = os.path.abspath(str(source))
+    try:
+        st = os.stat(text)
+        raw = f"{text}|{st.st_mtime_ns}|{st.st_size}"
+    except OSError:
+        raw = text
+    return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+def etag_for(payload: bytes) -> str:
+    """A strong ETag for a serialized response body: the CRC32 of the
+    exact bytes on the wire, quoted per RFC 9110.  The same CRC the
+    artifact envelope uses, so a result's ETag doubles as its
+    integrity token — byte-identical answers (the coalescing/read-tier
+    guarantee) always carry byte-identical ETags."""
+    return '"crc32-%08x"' % (zlib.crc32(payload) & 0xFFFFFFFF)
+
+
+def canonical_body(doc: Dict[str, Any]) -> bytes:
+    """The ONE serialization of a cached answer — matching the HTTP
+    edge's JSON framing (indent=1, default=str) so a cache hit's bytes
+    are indistinguishable from the miss path that stored them."""
+    return (json.dumps(doc, indent=1, default=str) + "\n").encode()
+
+
+class ResultCache:
+    """Bounded LRU of serialized answer bodies, capped on BOTH entry
+    count and total payload bytes (a handful of 100-MB wide-table
+    answers must not silently pin the edge's memory).  Thread-safe.
+
+    Entries store ``(payload bytes, crc32)``; every :meth:`get`
+    re-hashes the payload and compares — a mismatch raises nothing to
+    the caller: the entry is demoted LOUDLY (logged + counted on
+    ``tpuprof_read_cache_demotes_total``) and the lookup reports a
+    miss, the same never-wrong-only-slower discipline the AOT store
+    uses (:class:`~tpuprof.errors.CorruptReadCacheError`)."""
+
+    def __init__(self, capacity: int = 512,
+                 max_bytes: int = 64 << 20):
+        self.capacity = max(int(capacity), 1)
+        self.max_bytes = max(int(max_bytes), 1)
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[Any, Tuple[bytes, int]]" \
+            = collections.OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.demotes = 0
+
+    def put(self, key: Any, doc: Dict[str, Any]) -> str:
+        """Serialize ``doc`` canonically, store it under ``key``, and
+        return the payload's ETag.  An oversized single answer (larger
+        than the whole bytes cap) is not stored — the ETag is still
+        returned so the caller's response carries it."""
+        payload = canonical_body(doc)
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        etag = '"crc32-%08x"' % crc
+        if len(payload) > self.max_bytes:
+            return etag
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old[0])
+            self._entries[key] = (payload, crc)
+            self._bytes += len(payload)
+            while (len(self._entries) > self.capacity
+                   or self._bytes > self.max_bytes):
+                _, (dropped, _c) = self._entries.popitem(last=False)
+                self._bytes -= len(dropped)
+                self.evictions += 1
+                _READ_EVICTIONS.inc()
+            _READ_BYTES.set(self._bytes)
+            _READ_ENTRIES.set(len(self._entries))
+        if _obs_metrics.enabled():
+            _obs_events.emit("read_cache", status="store",
+                             bytes=len(payload),
+                             entries=len(self._entries))
+        return etag
+
+    def get(self, key: Any) -> Optional[Tuple[bytes, str]]:
+        """``(payload, etag)`` for a fresh entry, ``None`` on a miss.
+        A CRC mismatch demotes the entry and reports the miss."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                _READ_MISSES.inc()
+                return None
+            payload, crc = entry
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                # typed demote: never serve bytes that fail their own
+                # integrity envelope — drop, count, miss (the caller
+                # recomputes; CorruptReadCacheError documents the shape
+                # for anyone probing entries directly)
+                self._entries.pop(key, None)
+                self._bytes -= len(payload)
+                self.demotes += 1
+                self.misses += 1
+                _READ_DEMOTES.inc()
+                _READ_MISSES.inc()
+                _READ_BYTES.set(self._bytes)
+                _READ_ENTRIES.set(len(self._entries))
+                from tpuprof.errors import CorruptReadCacheError
+                from tpuprof.utils.trace import logger
+                exc = CorruptReadCacheError(
+                    f"read-cache entry {key!r} failed its CRC check — "
+                    "dropped; this request recomputes")
+                logger.warning(str(exc))
+                if _obs_metrics.enabled():
+                    _obs_events.emit("read_cache", status="demote",
+                                     bytes=len(payload),
+                                     entries=len(self._entries))
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            _READ_HITS.inc()
+            return payload, '"crc32-%08x"' % crc
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {"entries": len(self._entries),
+                    "bytes": self._bytes,
+                    "capacity": self.capacity,
+                    "max_bytes": self.max_bytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "demotes": self.demotes,
+                    "hit_rate": self.hits / total if total else 0.0}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self.hits = 0
+            self.misses = 0
+            self.evictions = 0
+            self.demotes = 0
+            _READ_BYTES.set(0)
+            _READ_ENTRIES.set(0)

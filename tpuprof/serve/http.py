@@ -2,9 +2,9 @@
 (ROADMAP item 1 — "the transport is the only missing layer").
 
 ``tpuprof serve SPOOL --http PORT`` puts a real network front door on
-the existing scheduler: a threaded stdlib HTTP server (no new
-dependency — the repo rule) speaking the ``tpuprof-serve-job-v1`` /
-``tpuprof-serve-result-v1`` schemas over the wire.  The edge OWNS no
+the existing scheduler: a selector-based async stdlib HTTP server (no
+new dependency — the repo rule) speaking the ``tpuprof-serve-job-v1``
+/ ``tpuprof-serve-result-v1`` schemas over the wire.  The edge OWNS no
 job lifecycle: admission, quotas, watchdogs and typed failures all
 stay in serve/scheduler.py; HTTP is a second client of the same
 machinery the file spool uses — and the spool stays the durability
@@ -19,24 +19,48 @@ Routes::
                                  scheduler's reject reason; malformed
                                  body -> 400 (never a daemon crash);
                                  draining daemon -> 503
+    POST /v1/query               {source, cols, stats} -> the values
+                                 doc, answered from the cheapest tier
+                                 that is still CORRECT: the edge
+                                 result cache, else a column-pruned
+                                 read of the newest fresh warehouse
+                                 generation, else a narrow (column-
+                                 subset) profile job; the serving tier
+                                 is on the X-Tpuprof-Provenance header
+                                 and the computing tier in the body
     GET  /v1/jobs/<id>           lifecycle view (local live state,
                                  else the spool's terminal record,
                                  else "queued" for a peer's job)
-    GET  /v1/results/<id>        the terminal record: 200 when landed,
-                                 202 while pending, 404 unknown
+    GET  /v1/results/<id>        the terminal record: 200 when landed
+                                 (ETag + If-None-Match -> 304), 202
+                                 while pending, 404 unknown
     GET  /v1/watch/<key>/alerts  a watched source's alerts.json feed
                                  (read-only; ISSUE 11 satellite — watch
                                  consumers poll the edge, not the
                                  spool filesystem)
+    GET  /v1/history/<key>       warehouse history series (ETag +
+                                 If-None-Match -> 304)
     GET  /v1/healthz             daemon readiness for fleet balancers
                                  (unauthenticated, like /metrics):
                                  200 ready, 503 warming (AOT restart
                                  prewarm in progress — keys loaded/
-                                 pending in the body), 503 draining
+                                 pending in the body), 503 draining;
+                                 the body carries read-cache entries/
+                                 bytes/hit-rate + computed/coalesced
+                                 counts (read-tier health)
     GET  /metrics                Prometheus text exposition of the
                                  process registry (the scrape surface;
                                  unauthenticated by design, like every
                                  /metrics in the fleet)
+
+The transport (ISSUE 16 (d)): one selector event loop owns every
+socket — accept, keep-alive reads, response writes — and parsed
+requests run on a small bounded worker pool, so thousands of idle
+keep-alive connections cost file descriptors, not threads (the
+thread-per-socket edge pinned one Python thread per open connection).
+Conditional requests ride strong CRC ETags (serve/cache.py
+``etag_for``): a balancer or client that re-validates an unchanged
+result gets a bodyless 304 instead of a re-serialized answer.
 
 Auth: a ``serve_auth_file`` of ``<token> <tenant>`` lines maps bearer
 tokens onto tenants — the tenant id feeds the PR-9 per-tenant quotas,
@@ -55,16 +79,23 @@ edge cannot be reached at all.
 
 from __future__ import annotations
 
+import collections
+import io
 import json
 import os
 import re
+import selectors
+import socket
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from concurrent.futures import ThreadPoolExecutor
+from http.client import parse_headers
+from http.client import responses as _HTTP_REASONS
 from typing import Any, Dict, Optional, Tuple
 
 from tpuprof.errors import (CorruptResultError, InputError,
                             ServeUnavailableError)
+from tpuprof.obs import events as _obs_events
 from tpuprof.obs import metrics as _obs_metrics
 from tpuprof.serve.server import (JOB_SCHEMA, RESULT_SCHEMA, ServeDaemon,
                                   poll_intervals, read_result)
@@ -76,9 +107,14 @@ _REQUEST_SECONDS = _obs_metrics.histogram(
     "tpuprof_http_request_seconds",
     "HTTP edge request handling latency (receive -> response written) "
     "— does NOT include the job's own runtime, only the edge")
+_PUSHDOWN = _obs_metrics.counter(
+    "tpuprof_query_pushdown_total",
+    "/v1/query answers by serving tier (cache|warehouse|computed)")
 
 MAX_BODY_BYTES = 1 << 20            # a job request is a small JSON doc;
                                     # anything bigger is garbage or abuse
+
+QUERY_SCHEMA = "tpuprof-query-v1"   # the /v1/query answer document
 
 _ID_RE = re.compile(r"^[A-Za-z0-9._-]+$")
 
@@ -123,60 +159,320 @@ def load_auth_file(path: str) -> Dict[str, str]:
 # server side
 # ---------------------------------------------------------------------------
 
-class _EdgeHandler(BaseHTTPRequestHandler):
-    server_version = "tpuprof-serve"
-    protocol_version = "HTTP/1.1"
+MAX_HEADER_BYTES = 64 << 10         # request line + headers cap — a
+                                    # buffer that grows past this with
+                                    # no complete head is a flood
+HTTP_WORKERS = 8                    # bounded handler pool: concurrency
+                                    # of request HANDLING, decoupled
+                                    # from how many sockets are open
 
-    # BaseHTTPRequestHandler logs every request to stderr; the edge's
-    # audit trail is the metrics + serve_job events, not daemon noise
-    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
-        pass
 
-    def do_POST(self) -> None:
-        self._route("POST")
+class _Conn:
+    """One client connection's loop-owned state."""
+    __slots__ = ("sock", "rbuf", "wbuf", "busy", "close_after",
+                 "dropped", "events")
 
-    def do_GET(self) -> None:
-        self._route("GET")
+    def __init__(self, sock):
+        self.sock = sock
+        self.rbuf = b""             # bytes read, not yet parsed
+        self.wbuf = b""             # response bytes not yet written
+        self.busy = False           # a request is in flight (reads
+                                    # paused — backpressure, and no
+                                    # pipelining ambiguity)
+        self.close_after = False    # close once wbuf drains
+        self.dropped = False
+        self.events = 0             # current selector interest mask
 
-    def _route(self, method: str) -> None:
-        edge: "HttpEdge" = self.server.edge  # type: ignore[attr-defined]
-        t0 = time.perf_counter()
+
+class _SelectorHttpServer:
+    """Selector-based async HTTP/1.1 server (ISSUE 16 (d)): ONE event
+    loop thread owns accept + every socket's reads/writes, and parsed
+    requests are handled on a bounded :class:`ThreadPoolExecutor` —
+    thousands of idle keep-alive connections cost file descriptors,
+    not Python threads (the :class:`http.server.ThreadingHTTPServer`
+    edge this replaces pinned a thread per open socket for the
+    connection's whole lifetime).
+
+    Keeps the stdlib server's driving surface (``server_address``,
+    ``serve_forever``/``shutdown``/``server_close``) so
+    :class:`HttpEdge` drives either shape identically.  Routing stays
+    in :meth:`HttpEdge.handle`; this class only speaks the wire:
+    request-line + header parse (:func:`http.client.parse_headers` —
+    case-insensitive, exactly what ``handle`` already consumes),
+    Content-Length bodies capped at :data:`MAX_BODY_BYTES`, keep-alive
+    per HTTP/1.1 semantics, partial writes finished under
+    ``EVENT_WRITE``."""
+
+    def __init__(self, address, workers: int = HTTP_WORKERS):
+        self.edge = None            # set by HttpEdge after construction
+        self._listen = socket.create_server(address, backlog=128)
+        self._listen.setblocking(False)
+        self.server_address = self._listen.getsockname()
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._listen, selectors.EVENT_READ,
+                           ("listen", None))
+        # self-pipe: workers finishing a response (and shutdown) wake
+        # the select() so the loop never sleeps on a ready answer
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ,
+                           ("wake", None))
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(int(workers), 1),
+            thread_name_prefix="tpuprof-http-worker")
+        self._lock = threading.Lock()
+        self._completed: "collections.deque[Tuple[_Conn, bytes]]" = \
+            collections.deque()
+        self._conns: set = set()
+        self._stop = threading.Event()
+        self._stopped = threading.Event()
+
+    # -- loop --------------------------------------------------------------
+
+    def serve_forever(self) -> None:
         try:
-            code, body, route = edge.handle(method, self.path,
-                                            self._read_body(),
-                                            self.headers)
+            while not self._stop.is_set():
+                for key, mask in self._sel.select(timeout=0.5):
+                    kind, conn = key.data
+                    if kind == "listen":
+                        self._accept()
+                    elif kind == "wake":
+                        try:
+                            while self._wake_r.recv(4096):
+                                pass
+                        except (BlockingIOError, OSError):
+                            pass
+                    else:
+                        if mask & selectors.EVENT_WRITE:
+                            self._flush(conn)
+                        if mask & selectors.EVENT_READ \
+                                and not conn.dropped:
+                            self._readable(conn)
+                self._drain_completed()
+        finally:
+            self._stopped.set()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake()
+        self._stopped.wait(timeout=10)
+
+    def server_close(self) -> None:
+        for sock in (self._listen, self._wake_r, self._wake_w):
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for conn in list(self._conns):
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        self._conns.clear()
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except (BlockingIOError, OSError):
+            pass
+
+    # -- socket events (loop thread only) ----------------------------------
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._listen.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            conn = _Conn(sock)
+            self._conns.add(conn)
+            self._interest(conn, selectors.EVENT_READ)
+
+    def _interest(self, conn: _Conn, events: int) -> None:
+        """Set the selector interest mask for a connection (0 parks it
+        — a busy connection with a request in flight is watched for
+        NOTHING: reads pause until its answer is written)."""
+        if conn.dropped or events == conn.events:
+            return
+        if conn.events and not events:
+            self._sel.unregister(conn.sock)
+        elif events and not conn.events:
+            self._sel.register(conn.sock, events, ("conn", conn))
+        elif events:
+            self._sel.modify(conn.sock, events, ("conn", conn))
+        conn.events = events
+
+    def _drop(self, conn: _Conn) -> None:
+        if conn.dropped:
+            return
+        conn.dropped = True
+        if conn.events:
+            try:
+                self._sel.unregister(conn.sock)
+            except (KeyError, OSError):
+                pass
+            conn.events = 0
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self._conns.discard(conn)
+
+    def _readable(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(64 << 10)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(conn)
+            return
+        if not data:
+            self._drop(conn)        # peer closed
+            return
+        conn.rbuf += data
+        self._maybe_dispatch(conn)
+
+    def _maybe_dispatch(self, conn: _Conn) -> None:
+        """Parse one complete request off the buffer and hand it to
+        the worker pool; incomplete requests wait for more bytes."""
+        if conn.busy or conn.dropped:
+            return
+        head_end = conn.rbuf.find(b"\r\n\r\n")
+        if head_end < 0:
+            if len(conn.rbuf) > MAX_HEADER_BYTES:
+                self._drop(conn)    # header flood, no valid request
+            return
+        head_lines = conn.rbuf[:head_end].split(b"\r\n")
+        try:
+            request_line = head_lines[0].decode("latin-1")
+            method, path, version = request_line.split()
+            headers = parse_headers(io.BytesIO(
+                b"\r\n".join(head_lines[1:]) + b"\r\n\r\n"))
+        except (ValueError, UnicodeDecodeError):
+            self._drop(conn)        # not HTTP — no answer owed
+            return
+        try:
+            length = int(headers.get("Content-Length") or 0)
+        except ValueError:
+            length = -1
+        body: Optional[bytes] = None
+        if 0 <= length <= MAX_BODY_BYTES:
+            total = head_end + 4 + length
+            if len(conn.rbuf) < total:
+                return              # body still arriving
+            body = conn.rbuf[head_end + 4:total]
+            conn.rbuf = conn.rbuf[total:]
+        else:
+            # oversized/garbage length: the handler answers 400 (body
+            # None), and the connection closes — the unread body bytes
+            # make the stream unframeable
+            conn.rbuf = b""
+            conn.close_after = True
+        if version == "HTTP/1.0":
+            conn.close_after = conn.close_after or \
+                (headers.get("Connection") or "").lower() != "keep-alive"
+        else:
+            if (headers.get("Connection") or "").lower() == "close":
+                conn.close_after = True
+        conn.busy = True
+        self._interest(conn, 0)     # pause reads while answering
+        self._pool.submit(self._handle, conn, method, path, body,
+                          headers)
+
+    def _flush(self, conn: _Conn) -> None:
+        if conn.dropped:
+            return
+        if conn.wbuf:
+            try:
+                sent = conn.sock.send(conn.wbuf)
+                conn.wbuf = conn.wbuf[sent:]
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                self._drop(conn)
+                return
+        if conn.wbuf:
+            self._interest(conn, selectors.EVENT_WRITE)
+            return
+        if conn.busy:
+            return                  # response not queued yet
+        if conn.close_after:
+            self._drop(conn)
+            return
+        self._interest(conn, selectors.EVENT_READ)
+        if conn.rbuf:
+            # the client already sent its next keep-alive request
+            self._maybe_dispatch(conn)
+
+    def _drain_completed(self) -> None:
+        while True:
+            with self._lock:
+                if not self._completed:
+                    return
+                conn, payload = self._completed.popleft()
+            if conn.dropped:
+                continue
+            conn.wbuf += payload
+            conn.busy = False
+            self._flush(conn)
+
+    # -- request handling (worker pool) ------------------------------------
+
+    def _handle(self, conn: _Conn, method: str, path: str,
+                body: Optional[bytes], headers) -> None:
+        t0 = time.perf_counter()
+        extra: Optional[Dict[str, str]] = None
+        try:
+            res = self.edge.handle(method, path, body, headers)
+            code, rbody, route = res[0], res[1], res[2]
+            if len(res) > 3:
+                extra = res[3]
         except Exception as exc:    # noqa: BLE001 — an edge answers
             code, route = 500, "error"
-            body = {"error": f"{type(exc).__name__}: {exc}"}
-        try:
-            payload = body if isinstance(body, bytes) \
-                else json.dumps(body, indent=1, default=str).encode()
-            ctype = "text/plain; version=0.0.4; charset=utf-8" \
-                if isinstance(body, bytes) else "application/json"
-            self.send_response(code)
-            if code == 401:
-                self.send_header("WWW-Authenticate", "Bearer")
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-        except (BrokenPipeError, ConnectionResetError):
-            pass                    # client went away mid-answer
+            rbody, extra = {"error": f"{type(exc).__name__}: {exc}"}, None
+        response = self._render(code, rbody, extra,
+                                close=conn.close_after)
+        with self._lock:
+            self._completed.append((conn, response))
+        self._wake()
         _REQUESTS.inc(code=str(code), route=route)
         _REQUEST_SECONDS.observe(time.perf_counter() - t0)
 
-    def _read_body(self) -> Optional[bytes]:
-        try:
-            length = int(self.headers.get("Content-Length") or 0)
-        except ValueError:
-            return None
-        if length < 0 or length > MAX_BODY_BYTES:
-            return None
-        return self.rfile.read(length) if length else b""
+    @staticmethod
+    def _render(code: int, body, extra: Optional[Dict[str, str]],
+                close: bool) -> bytes:
+        if isinstance(body, bytes):
+            payload = body
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        else:
+            payload = json.dumps(body, indent=1, default=str).encode()
+            ctype = "application/json"
+        headers = dict(extra or {})
+        ctype = headers.pop("Content-Type", ctype)
+        reason = _HTTP_REASONS.get(code, "Unknown")
+        lines = [f"HTTP/1.1 {code} {reason}",
+                 "Server: tpuprof-serve"]
+        if code == 401:
+            lines.append("WWW-Authenticate: Bearer")
+        lines.append(f"Content-Type: {ctype}")
+        lines.append(f"Content-Length: {len(payload)}")
+        for name, value in headers.items():
+            lines.append(f"{name}: {value}")
+        lines.append("Connection: close" if close
+                     else "Connection: keep-alive")
+        head = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+        return head + payload
 
 
 class HttpEdge:
-    """One daemon's HTTP front door: a :class:`ThreadingHTTPServer`
+    """One daemon's HTTP front door: a :class:`_SelectorHttpServer`
     delegating every route to the daemon's spool + scheduler.  Bind
     with ``port=0`` for an ephemeral port (CI — no collisions on a
     busy box); the bound port is on :attr:`port` and advertised in
@@ -187,9 +483,8 @@ class HttpEdge:
                  auth_file: Optional[str] = None):
         self.daemon = daemon
         self.tokens = load_auth_file(auth_file) if auth_file else None
-        self.httpd = ThreadingHTTPServer((host, int(port)), _EdgeHandler)
-        self.httpd.edge = self      # type: ignore[attr-defined]
-        self.httpd.daemon_threads = True
+        self.httpd = _SelectorHttpServer((host, int(port)))
+        self.httpd.edge = self
         self.host = host
         self.port = int(self.httpd.server_address[1])
         self._thread: Optional[threading.Thread] = None
@@ -229,10 +524,13 @@ class HttpEdge:
     # -- routing -----------------------------------------------------------
 
     def handle(self, method: str, path: str, body: Optional[bytes],
-               headers) -> Tuple[int, Any, str]:
-        """(status, body, route-pattern) for one request.  ``body`` as
-        bytes passes through verbatim (the /metrics exposition);
-        anything else is JSON-encoded by the handler."""
+               headers) -> Tuple:
+        """(status, body, route-pattern[, extra-headers]) for one
+        request.  ``body`` as bytes passes through verbatim (the
+        /metrics exposition, pre-serialized conditional answers);
+        anything else is JSON-encoded by the transport.  The optional
+        fourth element is a header dict (ETag, provenance, an
+        overriding Content-Type)."""
         path, _, query = path.partition("?")
         if method == "GET" and path == "/metrics":
             return (200,
@@ -258,20 +556,41 @@ class HttpEdge:
                                        "token"}, "auth")
         if method == "POST" and path == "/v1/jobs":
             return self._post_job(body, tenant)
+        if method == "POST" and path == "/v1/query":
+            return self._post_query(body, tenant, headers)
         if method == "GET":
             m = re.match(r"^/v1/jobs/([^/]+)$", path)
             if m:
                 return self._get_job(m.group(1))
             m = re.match(r"^/v1/results/([^/]+)$", path)
             if m:
-                return self._get_result(m.group(1))
+                return self._get_result(m.group(1), headers)
             m = re.match(r"^/v1/watch/([^/]+)/alerts$", path)
             if m:
                 return self._get_alerts(m.group(1))
             m = re.match(r"^/v1/history/([^/]+)$", path)
             if m:
-                return self._get_history(m.group(1), query)
+                return self._get_history(m.group(1), query, headers)
         return 404, {"error": f"no route {method} {path!r}"}, "other"
+
+    @staticmethod
+    def _conditional(doc: Dict[str, Any], route: str, headers,
+                     extra: Optional[Dict[str, str]] = None) -> Tuple:
+        """Shared conditional-request wrapper (ISSUE 16 satellite):
+        serialize the answer canonically, stamp its strong CRC ETag,
+        and honor ``If-None-Match`` with a bodyless 304 — the client
+        re-validates an unchanged result for ~60 header bytes instead
+        of a full re-serialized document."""
+        from tpuprof.serve.cache import canonical_body, etag_for
+        payload = canonical_body(doc)
+        etag = etag_for(payload)
+        hdrs = {"ETag": etag, "Content-Type": "application/json"}
+        hdrs.update(extra or {})
+        inm = (headers.get("If-None-Match") or "") if headers else ""
+        if inm and (inm.strip() == "*"
+                    or etag in [t.strip() for t in inm.split(",")]):
+            return 304, b"", route, hdrs
+        return 200, payload, route, hdrs
 
     def _healthz(self) -> Tuple[int, Any, str]:
         """Daemon readiness + AOT prewarm progress (ISSUE 15): 200
@@ -292,9 +611,17 @@ class HttpEdge:
             "aot_cache_dir": getattr(daemon, "aot_cache_dir", None),
             "prewarm": prewarm,
         }
-        with daemon.scheduler._lock:
-            body["active"] = len(daemon.scheduler._active)
-        body["queued"] = len(daemon.scheduler._queue)
+        sched = daemon.scheduler
+        with sched._lock:
+            body["active"] = len(sched._active)
+            # read-tier health (ISSUE 16 satellite): balancers and
+            # dashboards see cache size/hit-rate and the exactly-once
+            # ledger (computed vs coalesced) next to warming state
+            body["computed"] = sched._computed
+            body["coalesced"] = sched._coalesced
+        body["queued"] = len(sched._queue)
+        rc = getattr(sched, "read_cache", None)
+        body["read_cache"] = rc.stats() if rc is not None else None
         if daemon.stop_event.is_set():
             body["status"] = "draining"
             return 503, body, route
@@ -382,7 +709,7 @@ class HttpEdge:
             return 200, {"id": jid, "status": "queued"}, route
         return 404, {"error": f"unknown job {jid!r}"}, route
 
-    def _get_result(self, jid: str) -> Tuple[int, Any, str]:
+    def _get_result(self, jid: str, headers=None) -> Tuple:
         route = "/v1/results/<id>"
         if not _ID_RE.match(jid):
             return 400, {"error": f"malformed job id {jid!r}"}, route
@@ -394,7 +721,10 @@ class HttpEdge:
             # 500 with the typed name and let the client keep polling
             return 500, {"error": f"{type(exc).__name__}: {exc}"}, route
         if res is not None:
-            return 200, res, route
+            # terminal records are immutable, so the CRC ETag is a
+            # permanent validator: a re-poll with If-None-Match costs
+            # a 304, not a re-read + re-serialize
+            return self._conditional(res, route, headers)
         if jid in self.daemon.scheduler._jobs \
                 or os.path.exists(os.path.join(self.daemon.dirs["jobs"],
                                                f"{jid}.json")):
@@ -419,12 +749,15 @@ class HttpEdge:
         # is already JSON — stream the bytes; no parse, no copy drift
         return 200, data or b"[]", route
 
-    def _get_history(self, key: str, query: str) -> Tuple[int, Any, str]:
+    def _get_history(self, key: str, query: str,
+                     headers=None) -> Tuple:
         """The warehouse history feed off the edge (ISSUE 13 (c)):
         ``GET /v1/history/<key>?col=price&stat=mean`` answers the stat
         series, ``?trend=1[&col=price]`` the PSI/KS-over-time series —
         both the same ``tpuprof-history-v1`` document `tpuprof history`
-        prints, read from the spool's warehouse the watch loop feeds."""
+        prints, read from the spool's warehouse the watch loop feeds.
+        Answers carry the shared CRC ETag and honor If-None-Match
+        (an unchanged warehouse re-poll costs a 304)."""
         from urllib.parse import parse_qs
         route = "/v1/history/<key>"
         if not _ID_RE.match(key) or set(key) <= {"."}:
@@ -460,7 +793,190 @@ class HttpEdge:
             return 501, {"error": str(exc)}, route
         except CorruptWarehouseError as exc:
             return 500, {"error": f"{type(exc).__name__}: {exc}"}, route
-        return 200, doc, route
+        return self._conditional(doc, route, headers)
+
+    # -- query pushdown (ISSUE 16 (c)) -------------------------------------
+
+    def _post_query(self, body: Optional[bytes],
+                    auth_tenant: Optional[str], headers) -> Tuple:
+        """``POST /v1/query {source, cols, stats}``: answer column
+        statistics from the CHEAPEST tier that is still correct —
+
+        1. **cache**: the edge result cache holds this exact answer
+           (byte-identical repeat, sub-millisecond, no I/O);
+        2. **warehouse**: the newest readable warehouse generation
+           post-dates the source — a column-pruned Parquet read (only
+           the requested stat chunks materialize, the PR-13 169×
+           cheaper path);
+        3. **computed**: the source is stale/absent in the warehouse —
+           a NARROW profile (``columns=cols``, PR-14's column-subset
+           path) runs through the ordinary scheduler admission.
+
+        The serving tier rides the ``X-Tpuprof-Provenance`` header (so
+        a cache hit stays byte-identical to the answer it cached,
+        whose body names the tier that COMPUTED it)."""
+        route = "/v1/query"
+        t0 = time.perf_counter()
+        if body is None:
+            return (400, {"error": "missing or oversized request body "
+                                   f"(cap {MAX_BODY_BYTES} bytes)"},
+                    route)
+        try:
+            req = json.loads(body)
+        except ValueError as exc:
+            return (400, {"error": f"request body is not JSON "
+                                   f"({exc})"}, route)
+        if not isinstance(req, dict):
+            return (400, {"error": "request body must be a JSON "
+                                   "object"}, route)
+        source = req.get("source")
+        if not isinstance(source, str) or not source:
+            return 400, {"error": "query needs a 'source' path"}, route
+        cols = req.get("cols")
+        if not isinstance(cols, list) or not cols \
+                or not all(isinstance(c, str) for c in cols):
+            return (400, {"error": "'cols' must be a non-empty list "
+                                   "of column names"}, route)
+        stats = req.get("stats") or ["mean"]
+        if not isinstance(stats, list) \
+                or not all(isinstance(s, str) for s in stats):
+            return (400, {"error": "'stats' must be a list of stat "
+                                   "names"}, route)
+        config = req.get("config")
+        if config is not None and not isinstance(config, dict):
+            return (400, {"error": "'config' must be a JSON object of "
+                                   "ProfilerConfig kwargs"}, route)
+        tenant = auth_tenant if auth_tenant is not None \
+            else (req.get("tenant") or "default")
+        source = os.path.abspath(source)
+
+        sched = self.daemon.scheduler
+        rc = getattr(sched, "read_cache", None)
+        key = None
+        if rc is not None:
+            from tpuprof.serve.cache import source_fingerprint
+            key = ("query", source_fingerprint(source), tuple(cols),
+                   tuple(stats),
+                   json.dumps(config or {}, sort_keys=True))
+            hit = rc.get(key)
+            if hit is not None:
+                payload, etag = hit
+                return self._query_response(
+                    payload, etag, "cache", route, headers,
+                    source, cols, stats, t0)
+
+        # warehouse tier: the newest readable generation, column-pruned
+        from tpuprof.errors import WarehouseUnavailableError
+        from tpuprof.warehouse import store as _store
+        from tpuprof.warehouse.history import query_columns
+        dirpath = _store.source_dir(
+            os.path.join(self.daemon.spool, "warehouse"), source)
+        gen_doc = None
+        try:
+            gen_doc = query_columns(dirpath, cols, stats)
+        except WarehouseUnavailableError:
+            gen_doc = None          # no pyarrow here: compute answers
+        fresh = False
+        if gen_doc is not None and not gen_doc["missing"]:
+            created = gen_doc.get("created_unix")
+            try:
+                fresh = created is not None \
+                    and created >= os.stat(source).st_mtime
+            except OSError:
+                # the source is gone: the warehouse is all there is,
+                # and "stale" has nothing fresher to defer to
+                fresh = True
+        if fresh:
+            doc = {"schema": QUERY_SCHEMA, "source": source,
+                   "provenance": "warehouse",
+                   "generation": gen_doc["generation"],
+                   "rows": gen_doc.get("rows"),
+                   "columns": gen_doc["columns"]}
+            return self._query_answer(doc, key, rc, route, headers,
+                                      cols, stats, t0)
+
+        # computed tier: a NARROW profile — only the requested columns
+        # run the mesh (PR-14 column-subset re-bin path via columns=)
+        from tpuprof.serve.jobs import Job, new_job_id
+        jid = new_job_id()
+        tmp_stats = os.path.join(self.daemon.dirs["tmp"],
+                                 f".query.{jid}.json")
+        kwargs = dict(config or {})
+        kwargs["columns"] = list(cols)
+        job = sched.submit(Job(source=source, tenant=tenant,
+                               job_id=jid, stats_json=tmp_stats,
+                               config_kwargs=kwargs))
+        if job.state == "rejected":
+            if job.reject_kind in ("QueueFull", "TenantQuotaExceeded"):
+                code = 429
+            elif job.reject_kind == "QueueClosed":
+                code = 503
+            else:
+                code = 400
+            wire = dict(job.to_wire())
+            wire["schema"] = RESULT_SCHEMA
+            return code, wire, route
+        try:
+            sched.wait(job, timeout=3600)
+        except TimeoutError:
+            return (504, {"error": f"query profile {job.id} still "
+                                   f"{job.state} after 3600s"}, route)
+        if job.state != "done":
+            code = 400 if job.exit_code == 2 else 500
+            return (code, {"error": job.error,
+                           "exit_code": job.exit_code}, route)
+        try:
+            with open(tmp_stats) as fh:
+                stats_doc = json.load(fh)
+        except (OSError, ValueError) as exc:
+            return (500, {"error": f"query stats unreadable: "
+                                   f"{type(exc).__name__}: {exc}"},
+                    route)
+        finally:
+            try:
+                os.unlink(tmp_stats)
+            except OSError:
+                pass
+        variables = stats_doc.get("variables") or {}
+        columns: Dict[str, Any] = {}
+        for col in cols:
+            var = variables.get(col) or {}
+            columns[col] = {s: var.get(s) for s in stats}
+        doc = {"schema": QUERY_SCHEMA, "source": source,
+               "provenance": "computed", "generation": None,
+               "rows": job.result.get("rows"), "columns": columns}
+        return self._query_answer(doc, key, rc, route, headers,
+                                  cols, stats, t0)
+
+    def _query_answer(self, doc: Dict[str, Any], key, rc, route: str,
+                      headers, cols, stats, t0: float) -> Tuple:
+        """Publish a freshly produced query answer to the result cache
+        (repeats then serve byte-identically from tier 1) and frame
+        the response."""
+        from tpuprof.serve.cache import canonical_body, etag_for
+        payload = canonical_body(doc)
+        etag = etag_for(payload)
+        if rc is not None and key is not None:
+            rc.put(key, doc)
+        return self._query_response(payload, etag, doc["provenance"],
+                                    route, headers, doc["source"],
+                                    cols, stats, t0)
+
+    def _query_response(self, payload: bytes, etag: str, tier: str,
+                        route: str, headers, source, cols, stats,
+                        t0: float) -> Tuple:
+        _PUSHDOWN.inc(tier=tier)
+        if _obs_metrics.enabled():
+            _obs_events.emit("query_pushdown", source=str(source),
+                             provenance=tier, cols=len(cols),
+                             stats=len(stats),
+                             seconds=round(time.perf_counter() - t0, 4))
+        hdrs = {"ETag": etag, "Content-Type": "application/json",
+                "X-Tpuprof-Provenance": tier}
+        inm = (headers.get("If-None-Match") or "") if headers else ""
+        if inm and etag in [t.strip() for t in inm.split(",")]:
+            return 304, b"", route, hdrs
+        return 200, payload, route, hdrs
 
 
 # ---------------------------------------------------------------------------

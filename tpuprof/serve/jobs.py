@@ -76,7 +76,18 @@ class Job:
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.cache_hit: Optional[bool] = None
+        self.coalesced_with: Optional[str] = None  # primary job id this
+                                                # submit collapsed onto
+                                                # (read tier, ISSUE 16)
+        self.read_cache: Optional[str] = None   # "hit" when the result
+                                                # cache answered at
+                                                # admission (no queue,
+                                                # no mesh)
         self._config = None          # validated ProfilerConfig (scheduler)
+        self._key = None             # read-tier coalescing key (source
+                                     # fingerprint, config fingerprint)
+        self._followers: List["Job"] = []   # same-key submits riding
+                                            # this job's one compute
 
     def to(self, state: str, error: Optional[str] = None,
            exit_code: Optional[int] = None) -> "Job":
@@ -128,6 +139,10 @@ class Job:
             out["reject_kind"] = self.reject_kind
         if self.cache_hit is not None:
             out["cache_hit"] = self.cache_hit
+        if self.coalesced_with is not None:
+            out["coalesced_with"] = self.coalesced_with
+        if self.read_cache is not None:
+            out["read_cache"] = self.read_cache
         out.update(self.result)
         return out
 

@@ -46,6 +46,10 @@ _JOB_SECONDS = _obs_metrics.histogram(
     "tpuprof_serve_job_seconds",
     "end-to-end job latency (enqueue -> terminal), queue wait included "
     "— the p50/p99 SLO series")
+_COALESCED = _obs_metrics.counter(
+    "tpuprof_coalesced_jobs_total",
+    "submits that collapsed onto an in-flight same-key job (read tier "
+    "— exactly-once compute, N fanned-out results)")
 
 
 class ProfileScheduler:
@@ -57,13 +61,30 @@ class ProfileScheduler:
                  tenant_quota: Optional[int] = None,
                  job_timeout_s: Optional[float] = None,
                  aot_cache_dir: Optional[str] = None,
+                 read_cache: Optional[str] = None,
+                 read_cache_entries: Optional[int] = None,
+                 read_cache_bytes: Optional[int] = None,
                  devices: Optional[Sequence] = None):
         from tpuprof.config import (resolve_aot_cache_dir,
                                     resolve_job_timeout,
+                                    resolve_read_cache,
+                                    resolve_read_cache_bytes,
+                                    resolve_read_cache_entries,
                                     resolve_serve_queue_depth,
                                     resolve_serve_tenant_quota,
                                     resolve_serve_workers)
         self.workers = resolve_serve_workers(workers)
+        # the read tier (ISSUE 16) is OPT-IN at this layer: a scheduler
+        # that was not handed a read_cache mode keeps the historical
+        # every-submit-computes behavior (the property every pre-16
+        # contention/steal test pins); `tpuprof serve` resolves the
+        # product default ("on") at the CLI
+        self.read_cache = None
+        if read_cache is not None \
+                and resolve_read_cache(read_cache) == "on":
+            self.read_cache = _cache.ResultCache(
+                resolve_read_cache_entries(read_cache_entries),
+                resolve_read_cache_bytes(read_cache_bytes))
         # daemon-level AOT executable-cache root (runtime/aot.py): a
         # job that says nothing about its own store inherits it, so
         # every serve/watch job's runner key feeds the same restart-
@@ -79,6 +100,11 @@ class ProfileScheduler:
         self._done_cond = threading.Condition(self._lock)
         self._jobs: Dict[str, Job] = {}
         self._active: Dict[str, Job] = {}
+        self._by_key: Dict[Any, Job] = {}   # in-flight coalescing table:
+                                            # (source fp, config fp) ->
+                                            # the one computing primary
+        self._computed = 0          # jobs that actually ran the mesh
+        self._coalesced = 0         # submits that rode another's compute
         self._counts = {DONE: 0, FAILED: 0, REJECTED: 0}
         self._latencies: "collections.deque[float]" = \
             collections.deque(maxlen=4096)   # done jobs only (SLO view)
@@ -108,6 +134,26 @@ class ProfileScheduler:
             job = Job(**kwargs)
         try:
             job._config = self._build_config(job)
+            # read tier (ISSUE 16): a side-effect-free repeat answers
+            # from the result cache, and a concurrent same-key submit
+            # rides the in-flight compute — neither touches the queue
+            key = self._coalesce_key(job)
+            if key is not None:
+                if self._attach_follower(key, job):
+                    return job
+                hit = self.read_cache.get(key)
+                if hit is not None:
+                    return self._answer_from_cache(job, hit[0])
+                # the probe missed: claim the primary slot atomically
+                # with a re-check, so K racing submits elect exactly
+                # one computer (the rest attach)
+                with self._lock:
+                    primary = self._by_key.get(key)
+                    if primary is not None \
+                            and primary.state not in TERMINAL:
+                        return self._attach_locked(primary, key, job)
+                    self._by_key[key] = job
+                    job._key = key
             self._queue.admit(job)
         except (QueueFull, TenantQuotaExceeded, QueueClosed,
                 ValueError, TypeError) as exc:
@@ -121,13 +167,96 @@ class ProfileScheduler:
                 self._submitted += 1
                 self._jobs[job.id] = job
                 self._counts[REJECTED] += 1
+                if job._key is not None \
+                        and self._by_key.get(job._key) is job:
+                    del self._by_key[job._key]
             self._record_terminal(job)
+            # a follower that attached in the claim->admit window must
+            # not wait on a job that will never run
+            self._fan_out(job)
             return job
         with self._lock:
             self._submitted += 1
             self._jobs[job.id] = job
         _QUEUE_DEPTH.set(len(self._queue))
         return job
+
+    def _coalesce_key(self, job: Job):
+        """The read-tier identity of a submit — or None when the tier
+        is off or the job has side effects.  A job that writes an
+        output/report/artifact must RUN (the write IS the product);
+        only pure "profile and answer" submits are cacheable and
+        coalescible."""
+        if self.read_cache is None:
+            return None
+        if job.output or job.stats_json or job.artifact \
+                or job.config_kwargs.get("artifact_path"):
+            return None
+        return (_cache.source_fingerprint(job.source),
+                job._config.fingerprint())
+
+    def _attach_follower(self, key, job: Job) -> bool:
+        with self._lock:
+            primary = self._by_key.get(key)
+            if primary is None or primary.state in TERMINAL:
+                return False
+            self._attach_locked(primary, key, job)
+            return True
+
+    def _attach_locked(self, primary: Job, key, job: Job) -> Job:
+        primary._followers.append(job)
+        job.coalesced_with = primary.id
+        job._key = key
+        self._submitted += 1
+        self._jobs[job.id] = job
+        self._coalesced += 1
+        _COALESCED.inc()
+        return job
+
+    def _answer_from_cache(self, job: Job, payload: bytes) -> Job:
+        """Terminal bookkeeping for a result-cache hit: the job never
+        queues, never runs, never touches a tenant slot — it is DONE at
+        admission with the cached answer."""
+        job.read_cache = "hit"
+        job.result = dict(json.loads(payload.decode()))
+        job.to(RUNNING)
+        job.to(DONE)
+        with self._done_cond:
+            self._submitted += 1
+            self._jobs[job.id] = job
+            self._counts[DONE] += 1
+            if job.seconds is not None:
+                self._latencies.append(job.seconds)
+            self._done_cond.notify_all()
+        self._record_terminal(job)
+        return job
+
+    def _fan_out(self, job: Job) -> None:
+        """Copy the primary's terminal state onto every follower that
+        coalesced onto it — N byte-identical answers from one compute.
+        Runs after the primary's own terminal bookkeeping; each
+        follower gets its own terminal record/event."""
+        while True:
+            with self._done_cond:
+                if not job._followers:
+                    return
+                followers = job._followers[:]
+                del job._followers[:len(followers)]
+            for f in followers:
+                f.cache_hit = job.cache_hit
+                f.to(RUNNING)
+                if job.state == DONE:
+                    f.result = dict(job.result)
+                    f.to(DONE)
+                else:
+                    f.to(FAILED, error=job.error,
+                         exit_code=job.exit_code)
+                with self._done_cond:
+                    self._counts[f.state] += 1
+                    if f.state == DONE and f.seconds is not None:
+                        self._latencies.append(f.seconds)
+                    self._done_cond.notify_all()
+                self._record_terminal(f)
 
     def _build_config(self, job: Job):
         """Validate the job's config overrides NOW (admission time):
@@ -189,6 +318,9 @@ class ProfileScheduler:
     def _run_job(self, job: Job) -> None:
         from tpuprof.errors import TYPED_ERRORS, exit_code
         config = job._config
+        with self._lock:
+            self._computed += 1     # actual mesh runs — the read
+                                    # tier's exactly-once witness
         # was this shape's runner already compiled? (probe only — the
         # hit itself is counted inside collect's acquire)
         job.cache_hit = self._probe_cache(job, config)
@@ -244,13 +376,24 @@ class ProfileScheduler:
         finally:
             _ACTIVE.dec()
             self._queue.release(job)
+            if job._key is not None and job.state == DONE \
+                    and self.read_cache is not None:
+                # publish BEFORE the key leaves the coalescing table:
+                # a racing same-key submit either attaches (pre-
+                # terminal), or finds the cache warm — never a third
+                # compute in the handoff window
+                self.read_cache.put(job._key, job.result)
             with self._done_cond:
                 self._active.pop(job.id, None)
                 self._counts[job.state] += 1
                 if job.state == DONE and job.seconds is not None:
                     self._latencies.append(job.seconds)
+                if job._key is not None \
+                        and self._by_key.get(job._key) is job:
+                    del self._by_key[job._key]
                 self._done_cond.notify_all()
             self._record_terminal(job)
+            self._fan_out(job)
 
     def _probe_cache(self, job: Job, config) -> Optional[bool]:
         """True when the job's (config, shape) key already holds a
@@ -281,6 +424,8 @@ class ProfileScheduler:
                          queue_seconds=round(job.queue_seconds or 0.0, 4)
                          if job.queue_seconds is not None else None,
                          cache_hit=job.cache_hit,
+                         read_cache=job.read_cache,
+                         coalesced_with=job.coalesced_with,
                          error=job.error)
 
     # -- client API --------------------------------------------------------
@@ -322,10 +467,14 @@ class ProfileScheduler:
                 "active": len(self._active),
                 "queued": len(self._queue),
                 "workers": self.workers,
+                "computed": self._computed,
+                "coalesced": self._coalesced,
             }
         out["p50_s"] = round(percentile(lat, 50), 4)
         out["p99_s"] = round(percentile(lat, 99), 4)
         out["cache"] = _cache.cache_stats()
+        out["read_cache"] = (self.read_cache.stats()
+                             if self.read_cache is not None else None)
         return out
 
     def snapshot(self) -> Dict[str, Any]:

@@ -37,7 +37,7 @@ HISTORY_SCHEMA = "tpuprof-history-v1"
 
 _QUERIES = _obs_metrics.counter(
     "tpuprof_history_queries_total",
-    "warehouse history queries by kind (stat|trend)")
+    "warehouse history queries by kind (stat|trend|columns)")
 _QUERY_SECONDS = _obs_metrics.histogram(
     "tpuprof_history_query_seconds",
     "wall seconds per history query (chain scan + pruned reads)")
@@ -142,6 +142,54 @@ def query_trend(dirpath: str, col: Optional[str] = None
                skipped=skipped)
     _observe("trend", dirpath, len(series), time.perf_counter() - t0)
     return doc
+
+
+def query_columns(dirpath: str, cols: List[str],
+                  stats: List[str]) -> Optional[Dict[str, Any]]:
+    """The NEWEST readable generation's values for a column/stat subset
+    — the warehouse leg of ``POST /v1/query`` pushdown (ISSUE 16 (c)).
+
+    Walks the chain newest-first so the freshest answer wins; a corrupt
+    head generation demotes to the next readable one exactly like the
+    stat-series walk (counted, blackboxed, never a raw traceback).
+    Column-pruned: only the ``column`` chunk plus the requested stat
+    chunks materialize, so a two-stat probe of a wide profile reads
+    kilobytes, not the whole Parquet file.
+
+    Returns ``None`` when no generation is readable (the caller falls
+    through to the computed tier); otherwise a dict with
+    ``generation``/``created_unix``/``rows``/``columns``/``missing``,
+    where ``missing`` lists requested columns this generation never
+    profiled — a non-empty list also sends the caller to the computed
+    tier, since the warehouse cannot answer the whole question."""
+    t0 = time.perf_counter()
+    for gen, path in reversed(store.chain(dirpath)):
+        try:
+            g = columnar.read_stats_parquet(
+                path, columns=list(cols),
+                stats=["column"] + [s for s in stats if s != "column"])
+        except (CorruptWarehouseError, OSError) as exc:
+            _FALLBACKS.inc()
+            blackbox.record("warehouse_fallback", path=path,
+                            error=f"{type(exc).__name__}: {exc}")
+            continue
+        columns: Dict[str, Any] = {}
+        missing: List[str] = []
+        for col in cols:
+            var = g.stats.get(col)
+            if var is None:
+                missing.append(col)
+                continue
+            columns[col] = {s: var.get(s) for s in stats}
+        _observe("columns", dirpath, 1, time.perf_counter() - t0)
+        return {
+            "generation": gen,
+            "created_unix": g.created_unix,
+            "rows": g.meta.get("rows"),
+            "columns": columns,
+            "missing": missing,
+        }
+    return None
 
 
 def _hist(var: Dict[str, Any]) -> Optional[Dict[str, Any]]:
