@@ -1580,6 +1580,224 @@ def run_serve_read(scale: float, workdir: str) -> dict:
     return out
 
 
+def measure_serve_shed(rows: int, workdir: str, burst: int = 10,
+                       backlog: int = 2, reads: int = 400,
+                       clients: int = 2) -> dict:
+    """Overload envelope (ISSUE 19, rung 8): ONE real daemon with a
+    ``--serve-backlog`` budget, its single worker saturated by a burst
+    of distinct-shape compute submits —
+
+    * shedding: once queued compute stands at the budget, further
+      non-cacheable submits must answer **503** with
+      ``reject_kind: "BacklogFull"`` and a positive jittered
+      ``Retry-After`` (bounded by the 300 s clamp + jitter);
+    * reads only, not collapse: WHILE the queue is saturated and
+      shedding, conditional GETs of a cached result and a cache-hit
+      submit keep answering — the read p99 must stay **< 50 ms**
+      (the in-leg gate) and the leg FAILS if saturation ended before
+      the read window did (a vacuous gate is no gate);
+    * ledger: ``/v1/healthz`` must reconcile exactly — its ``shed``
+      count equals the 503s the driver observed;
+    * drain: SIGTERM mid-queue must exit **0** inside the drain
+      budget (in-flight finishes, unstarted claims released)."""
+    import http.client
+    import shutil
+    import signal
+    import subprocess
+    import threading
+    from urllib.parse import urlsplit
+
+    fixture = _ensure_fixture("taxi", rows, workdir)
+    spool = os.path.join(workdir, "serve_shed_spool")
+    shutil.rmtree(spool, ignore_errors=True)
+    cfg = {"batch_rows": 1 << 12}
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    from tpuprof.serve import discover_edges, submit_job, wait_result_http
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpuprof", "serve", spool,
+         "--http", "0", "--daemon-id", "d0", "--serve-workers", "1",
+         "--serve-queue-depth", "64", "--serve-backlog", str(backlog),
+         "--serve-drain-timeout", "240", "--no-compile-cache"],
+        cwd=here, stderr=subprocess.DEVNULL)
+    out: dict = {"rows": rows}
+    try:
+        deadline = time.monotonic() + 300
+        while "d0" not in discover_edges(spool):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"edge never advertised: {discover_edges(spool)}")
+            time.sleep(0.2)
+        url = discover_edges(spool)["d0"]
+        parts = urlsplit(url)
+        host, port = parts.hostname, parts.port
+
+        def _req(conn, method, path, body=None, headers=None):
+            payload = json.dumps(body).encode() if body is not None \
+                else None
+            t0 = time.perf_counter()
+            conn.request(method, path, body=payload,
+                         headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            return (resp.status, data, dict(resp.getheaders()),
+                    time.perf_counter() - t0)
+
+        ctl = http.client.HTTPConnection(host, port, timeout=1800)
+        jhdr = {"Content-Type": "application/json"}
+
+        # seed the read tier: one computed answer to poll against
+        _code, doc = submit_job(url, fixture, config_kwargs=dict(cfg))
+        seed = wait_result_http(url, doc["id"], timeout=1800)
+        if seed["status"] != "done":
+            raise RuntimeError(f"seed job failed: {seed}")
+        rpath = "/v1/results/" + seed["id"]
+        st, _b, hdrs0, _ = _req(ctl, "GET", rpath)
+        if st != 200 or "ETag" not in hdrs0:
+            raise RuntimeError(f"seed result fetch: {st} {hdrs0}")
+        etag = hdrs0["ETag"]
+
+        # saturate the single worker: a burst of distinct shapes (no
+        # compile cache — every one is slow, honest compute); past the
+        # backlog budget the edge must shed with 503 + Retry-After
+        accepted = shed = 0
+        retry_afters: list = []
+        for k in range(burst):
+            st, raw, hh, _ = _req(
+                ctl, "POST", "/v1/jobs",
+                body={"source": fixture,
+                      "config": {"batch_rows": (1 << 11) + 64 * k}},
+                headers=jhdr)
+            if st == 202:
+                accepted += 1
+            elif st == 503:
+                rej = json.loads(raw)
+                if rej.get("reject_kind") != "BacklogFull":
+                    raise RuntimeError(f"503 without BacklogFull: {rej}")
+                ra = float(hh["Retry-After"])
+                if not 0 < ra <= 400:
+                    raise RuntimeError(f"Retry-After out of range: {ra}")
+                retry_afters.append(ra)
+                shed += 1
+            else:
+                raise RuntimeError(f"burst submit -> {st} {raw!r}")
+        if not shed:
+            raise RuntimeError(
+                f"burst of {burst} never shed (backlog {backlog})")
+
+        # the read lane, measured WHILE compute is saturated/shedding
+        per = reads // clients
+        lock = threading.Lock()
+        lats: list = []
+        lerrs: list = []
+
+        def _reader(_k):
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            my = []
+            try:
+                for _ in range(per):
+                    st_, _p, _hh, dt = _req(
+                        conn, "GET", rpath,
+                        headers={"If-None-Match": etag})
+                    if st_ != 304:
+                        raise RuntimeError(f"conditional GET -> {st_}")
+                    my.append(dt)
+                with lock:
+                    lats.extend(my)
+            except Exception as exc:           # noqa: BLE001
+                with lock:
+                    lerrs.append(exc)
+            finally:
+                conn.close()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=_reader, args=(k,))
+                   for k in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t0
+        if lerrs:
+            raise RuntimeError(f"read lane failed: {lerrs[0]}")
+
+        # a cache-hit submit also rides the read tier while shedding
+        st, raw, _hh, _ = _req(ctl, "POST", "/v1/jobs",
+                               body={"source": fixture,
+                                     "config": dict(cfg)},
+                               headers=jhdr)
+        if st != 202:
+            raise RuntimeError(f"cache-hit submit shed: {st} {raw!r}")
+        hit = wait_result_http(url, json.loads(raw)["id"], timeout=60)
+        if hit["status"] != "done":
+            raise RuntimeError(f"cache-hit submit failed: {hit}")
+
+        # the gate is only honest if compute was still saturated when
+        # the read window closed — and the healthz ledger must
+        # reconcile with what the driver saw
+        st, hraw, _hh, _ = _req(ctl, "GET", "/v1/healthz")
+        h = json.loads(hraw)
+        if h["queued"] < 1 or h["active"] < 1:
+            raise RuntimeError(
+                "compute tier drained before the read window closed "
+                f"(queued={h['queued']} active={h['active']}) — "
+                "shrink reads or grow the burst")
+        if h["shed"] != shed:
+            raise RuntimeError(
+                f"healthz shed={h['shed']} != driver-observed {shed}")
+        if h["serve_backlog"] != backlog:
+            raise RuntimeError(
+                f"healthz serve_backlog={h['serve_backlog']}")
+        ctl.close()
+
+        lat = sorted(lats)
+        p50 = lat[(len(lat) - 1) // 2]
+        p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)]
+        out.update({
+            "serve_shed_burst": burst,
+            "serve_shed_backlog": backlog,
+            "serve_shed_accepted": accepted,
+            "serve_shed_shed": shed,
+            "serve_shed_retry_after_s": round(
+                sum(retry_afters) / len(retry_afters), 2),
+            "serve_shed_reads": len(lat),
+            "serve_shed_read_rps": round(len(lat) / wall, 1),
+            "serve_shed_read_p50_ms": round(p50 * 1000, 2),
+            "serve_shed_read_p99_ms": round(p99 * 1000, 2),
+            "rows_per_sec": round(len(lat) / wall, 1),
+        })
+        if p99 >= 0.050:
+            raise RuntimeError(
+                f"read p99 {p99 * 1000:.1f}ms under shedding load "
+                "(>= 50ms ceiling)")
+
+        # drain proof: SIGTERM mid-queue must exit 0 inside the budget
+        t0 = time.perf_counter()
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=300)
+        out["serve_shed_drain_s"] = round(time.perf_counter() - t0, 2)
+        if rc != 0:
+            raise RuntimeError(f"drain exited {rc}, not 0")
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    return out
+
+
+def run_serve_shed(scale: float, workdir: str) -> dict:
+    # small fixture on purpose: the tracked signals are the shed
+    # contract and the read tail under saturation, not scan throughput
+    rows = max(int(1_000_000 * scale), 10_000)
+    out = measure_serve_shed(rows, workdir)
+    out["scenario"] = "serve_shed"
+    return out
+
+
 def run_watch(scale: float, workdir: str) -> dict:
     # small fixture on purpose, like serve: the tracked signals are the
     # warm cycle latency and the alert latency, not scan throughput
@@ -2110,7 +2328,7 @@ REGRESSION_SCENARIOS = ("taxi", "tpch", "criteo", "wide1b", "streaming",
                         "hostfed", "prepare", "passb", "faults", "drift",
                         "rebalance", "serve", "watch", "serve_http",
                         "warehouse", "lint", "singlepass", "restart",
-                        "serve_read")
+                        "serve_read", "serve_shed")
 
 
 def _load_baseline(baseline: "str | None", workdir: str) -> "tuple":
@@ -2329,6 +2547,11 @@ def run_regression(scale: float, workdir: str,
                      f"{r['serve_read_hit_p99_ms']}ms, computed "
                      f"{r['serve_read_coalesce_computed']}/"
                      f"{r['serve_read_coalesce_k']}")
+        if "serve_shed_shed" in r:
+            notes = (f"shed {r['serve_shed_shed']}/"
+                     f"{r['serve_shed_burst']}, read p99 "
+                     f"{r['serve_shed_read_p99_ms']}ms, drain "
+                     f"{r['serve_shed_drain_s']}s")
         rate = r.get("rows_per_sec",
                      r.get("prepare_rows_per_sec", float("nan")))
         rows = r.get("rows")
@@ -2352,6 +2575,7 @@ def main() -> None:
                                              "serve_http", "warehouse",
                                              "lint", "singlepass",
                                              "restart", "serve_read",
+                                             "serve_shed",
                                              "regression", "all"])
     parser.add_argument("--scale", type=float, default=0.01)
     parser.add_argument("--workdir", default="/tmp/tpuprof_bench")
@@ -2389,7 +2613,8 @@ def main() -> None:
     names = (["taxi", "tpch", "criteo", "wide1b", "streaming", "hostfed",
               "prepare", "passb", "faults", "drift", "rebalance",
               "wideexact", "serve", "watch", "serve_http", "warehouse",
-              "lint", "singlepass", "restart", "serve_read"]
+              "lint", "singlepass", "restart", "serve_read",
+              "serve_shed"]
              if args.scenario == "all" else [args.scenario])
     for name in names:
         if name in ("taxi", "tpch", "criteo"):
@@ -2428,6 +2653,8 @@ def main() -> None:
             result = run_restart(args.scale, args.workdir)
         elif name == "serve_read":
             result = run_serve_read(args.scale, args.workdir)
+        elif name == "serve_shed":
+            result = run_serve_shed(args.scale, args.workdir)
         else:
             result = run_streaming(args.scale, args.workdir, args.backend)
         print(json.dumps(result))

@@ -721,3 +721,97 @@ def test_describe_function_contract():
     assert schema.validate_stats(stats) == []
     with pytest.raises(ValueError, match="not both"):
         describe(df, ProfilerConfig(backend="cpu"), bins=5)
+
+
+class TestOverloadConfigRoundTrip:
+    """The overload/drain/breaker/abuse-cap knobs (ISSUE 19) resolve
+    identically from env, CLI and config — the same three-way contract
+    every other serve knob honors."""
+
+    KNOBS = (
+        # (config field, CLI flag, env var, resolver name, default,
+        #  test value — byte caps clamp below 1024, so theirs is 4096)
+        ("serve_backlog", "--serve-backlog",
+         "TPUPROF_SERVE_BACKLOG", "resolve_serve_backlog", 0, 3),
+        ("serve_drain_timeout_s", "--serve-drain-timeout",
+         "TPUPROF_SERVE_DRAIN_TIMEOUT_S",
+         "resolve_serve_drain_timeout", 30.0, 3),
+        ("breaker_threshold", "--breaker-threshold",
+         "TPUPROF_BREAKER_THRESHOLD",
+         "resolve_breaker_threshold", 3, 5),
+        ("breaker_cooldown_s", "--breaker-cooldown",
+         "TPUPROF_BREAKER_COOLDOWN_S",
+         "resolve_breaker_cooldown", 30.0, 3),
+        ("serve_max_connections", "--serve-max-connections",
+         "TPUPROF_SERVE_MAX_CONNECTIONS",
+         "resolve_serve_max_connections", 512, 3),
+        ("serve_conn_timeout_s", "--serve-conn-timeout",
+         "TPUPROF_SERVE_CONN_TIMEOUT_S",
+         "resolve_serve_conn_timeout", 30.0, 3),
+        ("serve_max_header_bytes", "--serve-max-header-bytes",
+         "TPUPROF_SERVE_MAX_HEADER_BYTES",
+         "resolve_serve_max_header_bytes", 64 << 10, 4096),
+        ("serve_max_body_bytes", "--serve-max-body-bytes",
+         "TPUPROF_SERVE_MAX_BODY_BYTES",
+         "resolve_serve_max_body_bytes", 1 << 20, 4096),
+    )
+
+    def test_env_cli_config_resolve_identically(self, monkeypatch):
+        import tpuprof.config as cfg_mod
+        from tpuprof.cli import build_parser
+        for field, flag, env, resolver_name, _default, value \
+                in self.KNOBS:
+            resolver = getattr(cfg_mod, resolver_name)
+            via_config = resolver(
+                getattr(ProfilerConfig(**{field: value}), field))
+            args = build_parser().parse_args(
+                ["serve", "spool", flag, str(value)])
+            via_cli = resolver(getattr(args, field))
+            monkeypatch.setenv(env, str(value))
+            via_env = resolver(None)
+            assert via_config == via_cli == via_env == value, field
+            # explicit value beats the env twin
+            assert resolver(value * 2) == value * 2, field
+            monkeypatch.delenv(env)
+
+    def test_defaults_and_env_fallback(self, monkeypatch):
+        import tpuprof.config as cfg_mod
+        for field, _flag, env, resolver_name, default, value \
+                in self.KNOBS:
+            resolver = getattr(cfg_mod, resolver_name)
+            monkeypatch.delenv(env, raising=False)
+            assert resolver(None) == default, field
+            monkeypatch.setenv(env, str(value))
+            assert resolver(None) == value, field
+            monkeypatch.delenv(env)
+
+    def test_serve_parser_defaults_leave_resolution_open(self):
+        from tpuprof.cli import build_parser
+        args = build_parser().parse_args(["serve", "spool"])
+        for field, _flag, _env, _res, _default, _value in self.KNOBS:
+            assert getattr(args, field) is None, field
+
+    def test_config_validation_rejects_bad_values(self):
+        for field, bad, match in (
+                ("serve_backlog", -1, "serve_backlog"),
+                ("serve_drain_timeout_s", -1, "serve_drain_timeout_s"),
+                ("breaker_threshold", 0, "breaker_threshold"),
+                ("breaker_cooldown_s", -1, "breaker_cooldown_s"),
+                ("serve_max_connections", 0, "serve_max_connections"),
+                ("serve_conn_timeout_s", 0, "serve_conn_timeout_s"),
+                ("serve_max_header_bytes", 100,
+                 "serve_max_header_bytes"),
+                ("serve_max_body_bytes", 100, "serve_max_body_bytes")):
+            with pytest.raises(ValueError, match=match):
+                ProfilerConfig(**{field: bad})
+        # 0 backlog means shedding OFF and is legal (the default)
+        assert ProfilerConfig(serve_backlog=0).serve_backlog == 0
+
+    def test_submit_deadline_flag_parses(self):
+        from tpuprof.cli import build_parser
+        args = build_parser().parse_args(
+            ["submit", "spool", "src.parquet", "--deadline-ms", "250"])
+        assert args.deadline_ms == 250
+        args = build_parser().parse_args(
+            ["submit", "spool", "src.parquet"])
+        assert args.deadline_ms is None

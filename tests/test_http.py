@@ -701,3 +701,354 @@ class TestSubmitUrlCli:
         rc = main(["submit", "--url", "http://127.0.0.1:1"])
         assert rc == 2
         assert "source" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# overload: backlog shed (ISSUE 19 (a)) — 503 + Retry-After, reads-only
+# ---------------------------------------------------------------------------
+
+class TestOverloadShed:
+    def test_backlog_shed_503_with_retry_after_reads_keep_serving(
+            self, parquet_path, tmp_path):
+        """With `serve_backlog` queued computes already waiting, a NEW
+        compute sheds 503 with a jittered Retry-After — while a submit
+        the read tier can answer (a coalescible repeat of a queued
+        shape) still rides for free: "reads only" degradation."""
+        from tpuprof.testing import faults
+        spool = str(tmp_path / "spool")
+        # wedge the first compute so the queue deterministically holds
+        faults.install(faults.FaultPlan.from_spec("serve_job:sleep=3@1"))
+        try:
+            with running_edge(spool, serve_backlog=1,
+                              read_cache="on") as (daemon, edge):
+                def post(cfg):
+                    return _http("POST", edge.url + "/v1/jobs",
+                                 {"source": parquet_path, "config": cfg})
+                code1, doc1, _ = post({"batch_rows": 1024})
+                assert code1 == 202                 # running (wedged)
+                code2, doc2, _ = post({"batch_rows": 512})
+                assert code2 == 202                 # queued: depth 1
+                code3, doc3, hdrs3 = post({"batch_rows": 2048})
+                assert code3 == 503
+                assert doc3["reject_kind"] == "BacklogFull"
+                assert "reads" in doc3["error"] or \
+                    "backlog" in doc3["error"]
+                retry = float(hdrs3["Retry-After"])
+                assert 0.0 < retry <= 400.0
+                # the read tier still serves: a repeat of the QUEUED
+                # shape coalesces onto it instead of shedding
+                code4, doc4, _ = post({"batch_rows": 512})
+                assert code4 == 202, doc4
+                # healthz carries the overload ledger
+                code, hz, _ = _http("GET", edge.url + "/v1/healthz")
+                assert code == 200
+                assert hz["shed"] == 1
+                assert hz["serve_backlog"] == 1
+                assert hz["queued"] >= 1
+                # the accepted jobs still answer once the wedge lifts
+                for doc in (doc1, doc2):
+                    assert wait_result_http(
+                        edge.url, doc["id"],
+                        timeout=600)["status"] == "done"
+                st = daemon.scheduler.stats()
+                assert st["shed"] == 1 and st["rejected"] == 1
+        finally:
+            faults.reset()
+
+    def test_backlog_zero_means_no_shedding(self, parquet_path,
+                                            tmp_path):
+        """The default (serve_backlog=0) is the historical behavior:
+        no shed, the bounded queue is the only admission limit."""
+        spool = str(tmp_path / "spool")
+        with running_edge(spool) as (daemon, edge):
+            assert daemon.scheduler.serve_backlog == 0
+            code, hz, _ = _http("GET", edge.url + "/v1/healthz")
+            assert hz["serve_backlog"] == 0 and hz["shed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation (ISSUE 19 (b)): expired jobs are never started
+# ---------------------------------------------------------------------------
+
+class TestClientDeadline:
+    def test_expired_deadline_never_starts_and_exits_11(
+            self, parquet_path, tmp_path):
+        from tpuprof.testing import faults
+        spool = str(tmp_path / "spool")
+        faults.install(faults.FaultPlan.from_spec("serve_job:sleep=2@1"))
+        try:
+            with running_edge(spool, read_cache="off") as (daemon, edge):
+                code, _doc = submit_job(edge.url, parquet_path,
+                                        config_kwargs=dict(CFG))
+                assert code == 202          # wedged in the worker
+                code, doc = submit_job(edge.url, parquet_path,
+                                       config_kwargs={"batch_rows": 512},
+                                       deadline_ms=100)
+                assert code == 202
+                res = wait_result_http(edge.url, doc["id"], timeout=600)
+                assert res["status"] == "failed"
+                assert res["exit_code"] == 11
+                assert "deadline exceeded" in res["error"]
+                assert "not started" in res["error"]
+                code, hz, _ = _http("GET", edge.url + "/v1/healthz")
+                assert hz["deadline_expired"] == 1
+        finally:
+            faults.reset()
+
+    def test_deadline_rides_the_spool_wire_schema(self, parquet_path,
+                                                  tmp_path):
+        """`deadline_unix_ms` in the job file (the forwarder form) is
+        honored by a daemon that never saw the HTTP header."""
+        spool = str(tmp_path / "spool")
+        jid = write_job(spool, parquet_path, config_kwargs=dict(CFG),
+                        deadline_unix_ms=int((time.time() - 1) * 1000))
+        with running_edge(spool) as (_daemon, _edge):
+            res = wait_result(spool, jid, timeout=600)
+        assert res["status"] == "failed" and res["exit_code"] == 11
+        assert res["deadline_unix_ms"] is not None
+
+    def test_bad_deadline_header_is_400(self, parquet_path, tmp_path):
+        import http.client
+        spool = str(tmp_path / "spool")
+        with running_edge(spool) as (_daemon, edge):
+            for bad in ("nope", "-5", "0"):
+                conn = http.client.HTTPConnection(edge.host, edge.port,
+                                                  timeout=30)
+                try:
+                    conn.request(
+                        "POST", "/v1/jobs",
+                        body=json.dumps(
+                            {"source": parquet_path,
+                             "config": dict(CFG)}).encode(),
+                        headers={"Content-Type": "application/json",
+                                 "X-Tpuprof-Deadline-Ms": bad})
+                    resp = conn.getresponse()
+                    doc = json.loads(resp.read())
+                    assert resp.status == 400, (bad, doc)
+                    assert "Deadline-Ms" in doc["error"]
+                finally:
+                    conn.close()
+
+    def test_cli_deadline_flag_propagates_exit_11(self, parquet_path,
+                                                  tmp_path, capsys):
+        from tpuprof.testing import faults
+        spool = str(tmp_path / "spool")
+        faults.install(faults.FaultPlan.from_spec("serve_job:sleep=2@1"))
+        try:
+            with running_edge(spool, read_cache="off") as (_d, edge):
+                code, _doc = submit_job(edge.url, parquet_path,
+                                        config_kwargs=dict(CFG))
+                assert code == 202          # wedge the worker first
+                rc = main(["submit", "--url", edge.url, parquet_path,
+                           "--batch-rows", "512",
+                           "--deadline-ms", "100",
+                           "--timeout", "600"])
+                assert rc == 11
+                assert "deadline exceeded" in capsys.readouterr().err
+        finally:
+            faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# disconnect cancellation (ISSUE 19 (b)): client gone -> unclaimed job
+# cancelled; claimed jobs finish for their followers
+# ---------------------------------------------------------------------------
+
+class TestDisconnectCancellation:
+    def test_disconnected_query_cancels_its_unclaimed_job(
+            self, parquet_path, tmp_path):
+        import socket
+        from tpuprof.testing import faults
+        spool = str(tmp_path / "spool")
+        faults.install(faults.FaultPlan.from_spec("serve_job:sleep=3@1"))
+        try:
+            with running_edge(spool, read_cache="off") as (daemon, edge):
+                sched = daemon.scheduler
+                code, _doc = submit_job(edge.url, parquet_path,
+                                        config_kwargs=dict(CFG))
+                assert code == 202          # worker wedged on job 1
+                # a /v1/query that must COMPUTE queues job 2 and
+                # blocks its handler on the answer
+                body = json.dumps({"source": parquet_path,
+                                   "cols": ["a"]}).encode()
+                sock = socket.create_connection((edge.host, edge.port),
+                                                timeout=30)
+                sock.sendall(
+                    b"POST /v1/query HTTP/1.1\r\n"
+                    b"Host: x\r\nContent-Type: application/json\r\n" +
+                    f"Content-Length: {len(body)}\r\n\r\n".encode() +
+                    body)
+                deadline = time.monotonic() + 60
+                while sched.stats()["queued"] < 1:
+                    assert time.monotonic() < deadline, sched.stats()
+                    time.sleep(0.02)
+                # the client walks away before the answer
+                sock.close()
+                while sched.stats()["cancelled"] < 1:
+                    assert time.monotonic() < deadline, sched.stats()
+                    time.sleep(0.02)
+                # the cancelled job terminated without running
+                st = sched.stats()
+                assert st["cancelled"] == 1
+                assert st["computed"] <= 1      # job 2 never ran
+        finally:
+            faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# per-connection caps (ISSUE 19 (a)): slow-loris, floods, fd ceiling
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def capped_edge(spool, **edge_kwargs):
+    daemon = ServeDaemon(spool, workers=1, claim_jobs=True,
+                         daemon_id="caps", liveness_timeout_s=5.0)
+    edge = HttpEdge(daemon, port=0, **edge_kwargs).start()
+    try:
+        yield edge
+    finally:
+        edge.close()
+        daemon.close()
+
+
+def _recv_until_closed(sock, timeout=10.0):
+    import socket as _socket
+    sock.settimeout(timeout)
+    chunks = []
+    try:
+        while True:
+            data = sock.recv(4096)
+            if not data:
+                break
+            chunks.append(data)
+    except (_socket.timeout, OSError):
+        pass
+    return b"".join(chunks)
+
+
+class TestConnectionCaps:
+    def test_slow_loris_socket_is_reaped(self, tmp_path):
+        """Trickling header bytes does NOT extend the I/O deadline:
+        the connection is dropped at conn_timeout_s no matter how
+        alive the trickle looks."""
+        import socket
+        spool = str(tmp_path / "spool")
+        with capped_edge(spool, conn_timeout_s=1.0) as edge:
+            sock = socket.create_connection((edge.host, edge.port),
+                                            timeout=30)
+            t0 = time.monotonic()
+            try:
+                sock.sendall(b"GET /v1/healthz HTT")     # never finishes
+                got = _recv_until_closed(sock, timeout=10.0)
+            finally:
+                sock.close()
+            elapsed = time.monotonic() - t0
+            assert got == b""           # dropped, no answer owed
+            assert elapsed < 8.0        # reaped by the sweep, not the
+                                        # client timeout
+
+    def test_oversized_header_is_dropped(self, tmp_path):
+        import socket
+        spool = str(tmp_path / "spool")
+        with capped_edge(spool, max_header_bytes=2048) as edge:
+            sock = socket.create_connection((edge.host, edge.port),
+                                            timeout=30)
+            try:
+                sock.sendall(b"GET / HTTP/1.1\r\nX-Flood: " +
+                             b"a" * 4096)      # no terminator, over cap
+                got = _recv_until_closed(sock, timeout=10.0)
+            finally:
+                sock.close()
+            assert got == b""           # not HTTP worth answering
+
+    def test_oversized_body_is_400_with_the_cap(self, parquet_path,
+                                                tmp_path):
+        import http.client
+        spool = str(tmp_path / "spool")
+        with capped_edge(spool, max_body_bytes=2048) as edge:
+            conn = http.client.HTTPConnection(edge.host, edge.port,
+                                              timeout=30)
+            try:
+                conn.request("POST", "/v1/jobs", body=b"x" * 4096,
+                             headers={"Content-Type":
+                                      "application/json"})
+                resp = conn.getresponse()
+                doc = json.loads(resp.read())
+                assert resp.status == 400
+                assert "2048" in doc["error"]
+            finally:
+                conn.close()
+
+    def test_connection_ceiling_turns_newcomers_away(self, tmp_path):
+        import socket
+        spool = str(tmp_path / "spool")
+        with capped_edge(spool, max_connections=1,
+                         conn_timeout_s=30.0) as edge:
+            first = socket.create_connection((edge.host, edge.port),
+                                             timeout=30)
+            try:
+                # occupy the one slot with a real exchange (keep-alive)
+                first.sendall(b"GET /v1/healthz HTTP/1.1\r\n"
+                              b"Host: x\r\n\r\n")
+                first.settimeout(10)
+                assert first.recv(12).startswith(b"HTTP/1.1 200")
+                # the newcomer gets a terse 503 and the door
+                second = socket.create_connection(
+                    (edge.host, edge.port), timeout=30)
+                try:
+                    got = _recv_until_closed(second, timeout=10.0)
+                finally:
+                    second.close()
+                assert got.startswith(b"HTTP/1.1 503")
+            finally:
+                first.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (ISSUE 19 (d)): queued jobs released, peers answer
+# ---------------------------------------------------------------------------
+
+class TestGracefulDrain:
+    def test_drain_releases_queued_jobs_and_a_peer_answers(
+            self, parquet_path, tmp_path):
+        """SIGTERM semantics in-process: healthz flips to draining,
+        the advert is pulled, the in-flight job finishes HERE, the
+        queued jobs are released (claims unlinked, job files kept) and
+        a peer daemon answers them — zero loss."""
+        from tpuprof.testing import faults
+        spool = str(tmp_path / "spool")
+        faults.install(faults.FaultPlan.from_spec("serve_job:sleep=2@1"))
+        jids = []
+        try:
+            with running_edge(spool, daemon_id="dA",
+                              read_cache="off") as (dA, eA):
+                for cfg in ({"batch_rows": 1024}, {"batch_rows": 512},
+                            {"batch_rows": 2048}):
+                    code, doc = submit_job(eA.url, parquet_path,
+                                           config_kwargs=cfg)
+                    assert code == 202
+                    jids.append(doc["id"])
+                # job 1 wedged in the worker, jobs 2-3 queued
+                dA.stop_event.set()
+                code, hz, _ = _http("GET", eA.url + "/v1/healthz")
+                assert code == 503 and hz["status"] == "draining"
+                assert hz["draining"] is True
+                eA.stop_accepting()
+                assert "dA" not in discover_edges(spool)
+                # running_edge's exit now drains dA: the wedged job
+                # finishes here, the queued two are released
+            assert dA.scheduler.stats()["released"] == 2
+            claims = [n for n in os.listdir(
+                os.path.join(spool, "claims"))
+                if not n.startswith(".")]
+            assert claims == []         # released claims are unlinked
+            res1 = wait_result(spool, jids[0], timeout=600)
+            assert res1["status"] == "done" and res1["daemon"] == "dA"
+            with running_edge(spool, daemon_id="dB",
+                              read_cache="off") as (_dB, _eB):
+                for jid in jids[1:]:
+                    res = wait_result(spool, jid, timeout=600)
+                    assert res["status"] == "done", res
+                    assert res["daemon"] == "dB"
+        finally:
+            faults.reset()

@@ -207,6 +207,21 @@ EVENT_SCHEMA = {
                        "cols": ((int,), True),
                        "stats": ((int,), True),
                        "seconds": ((int, float), True)},
+    # overload-safe serving (serve/breaker.py + serve/server.py,
+    # ISSUE 19): one per circuit-breaker state transition (state the
+    # breaker ENTERED; failures is the consecutive-failure count that
+    # drove it), and one per graceful drain completed (released =
+    # queued jobs handed back to the fleet, unanswered = accepted jobs
+    # this daemon still owed at exit — zero on a clean drain)
+    "breaker_transition": {"ts": ((int, float), True),
+                           "source": ((str,), True),
+                           "state": ((str,), True),
+                           "failures": ((int,), True)},
+    "serve_drain": {"ts": ((int, float), True),
+                    "daemon": ((str,), True),
+                    "seconds": ((int, float), True),
+                    "released": ((int,), True),
+                    "unanswered": ((int,), True)},
 }
 
 

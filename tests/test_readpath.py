@@ -416,3 +416,81 @@ class TestQueryPushdown:
                                      body=body)
                 assert code == 400, (body, doc)
                 assert "error" in doc
+
+
+class TestQueryBreaker:
+    def test_breaker_open_half_open_close(self, parquet_path, tmp_path):
+        """The warehouse-pushdown breaker lifecycle (ISSUE 19 (c)):
+        consecutive corrupt-walk queries open it, an open breaker skips
+        the walk (``provenance:"breaker_open"``), and after the
+        cooldown one half-open probe against a healed chain closes it
+        again."""
+        from tpuprof import ProfileReport
+        from tpuprof.serve import HttpEdge, ServeDaemon
+        from tpuprof.serve.breaker import CircuitBreaker
+        from tpuprof.warehouse import store
+
+        spool = str(tmp_path / "spool")
+        wh = os.path.join(spool, "warehouse")
+        report = ProfileReport(parquet_path, backend="cpu")
+        desc = report.description
+        store.append_generation(wh, parquet_path, desc,
+                                rows=int(desc["table"]["n"]),
+                                created_unix=time.time())
+        # rot the chain: every generation file now reads corrupt
+        corrupted = []
+        for root, _dirs, files in os.walk(wh):
+            for name in files:
+                if name.endswith(".parquet"):
+                    path = os.path.join(root, name)
+                    with open(path, "wb") as fh:
+                        fh.write(b"not a parquet file")
+                    corrupted.append(path)
+        assert corrupted
+        breaker = CircuitBreaker(threshold=2, cooldown_s=0.5)
+        daemon = ServeDaemon(spool, poll_interval=0.03,
+                             claim_jobs=True, daemon_id="brk",
+                             workers=1, liveness_timeout_s=5.0,
+                             read_cache="off")
+        edge = HttpEdge(daemon, port=0, breaker=breaker).start()
+        t = threading.Thread(target=daemon.run, daemon=True)
+        t.start()
+        key = os.path.abspath(parquet_path)
+        q = {"source": parquet_path, "cols": ["a"], "stats": ["mean"]}
+        try:
+            # each corrupt walk counts one consecutive failure and
+            # falls through to compute — two reach the threshold
+            for i in (1, 2):
+                code, doc, _ = _http("POST", edge.url + "/v1/query",
+                                     body=dict(q), timeout=600)
+                assert code == 200, doc
+                assert doc["provenance"] == "computed", (i, doc)
+            assert breaker.state(key) == "open"
+            # open: the walk is skipped entirely, and the label says so
+            code, doc, hdrs = _http("POST", edge.url + "/v1/query",
+                                    body=dict(q), timeout=600)
+            assert code == 200, doc
+            assert doc["provenance"] == "breaker_open"
+            assert hdrs["X-Tpuprof-Provenance"] == "breaker_open"
+            # the detour is visible to operators in healthz
+            code, hdoc, _ = _http("GET", edge.url + "/v1/healthz")
+            assert code == 200
+            assert hdoc["breaker"]["open"][key]["state"] == "open"
+            # heal the chain, wait out the cooldown: the ONE half-open
+            # probe reads the fresh head generation and closes it
+            store.append_generation(wh, parquet_path, desc,
+                                    rows=int(desc["table"]["n"]),
+                                    created_unix=time.time() + 5)
+            time.sleep(0.6)
+            code, doc, _ = _http("POST", edge.url + "/v1/query",
+                                 body=dict(q), timeout=600)
+            assert code == 200, doc
+            assert doc["provenance"] == "warehouse"
+            assert breaker.state(key) == "closed"
+            code, hdoc, _ = _http("GET", edge.url + "/v1/healthz")
+            assert hdoc["breaker"]["open"] == {}
+        finally:
+            edge.close()
+            daemon.stop_event.set()
+            t.join(timeout=30)
+            daemon.close()

@@ -375,6 +375,59 @@ def build_parser() -> argparse.ArgumentParser:
                            "is declared dead and its claimed jobs "
                            "stolen (default: "
                            "TPUPROF_LIVENESS_TIMEOUT_S, else 10)")
+    overload = s.add_argument_group(
+        "overload + drain (ISSUE 19)", "admission shed past a backlog "
+        "budget (503 + jittered Retry-After; reads keep serving), "
+        "per-connection abuse caps on the HTTP edge, a circuit "
+        "breaker on warehouse pushdown, and the SIGTERM graceful-"
+        "drain budget")
+    overload.add_argument(
+        "--serve-backlog", type=int, default=None, metavar="N",
+        help="shed budget: non-cacheable submits answer 503 + "
+             "Retry-After once N compute jobs are queued; 0 = off — "
+             "only the hard --serve-queue-depth 429 bound applies "
+             "(default: TPUPROF_SERVE_BACKLOG, else 0)")
+    overload.add_argument(
+        "--serve-drain-timeout", type=float, default=None,
+        dest="serve_drain_timeout_s", metavar="SEC",
+        help="graceful-drain budget on SIGTERM: in-flight jobs get "
+             "SEC to finish and flush before the daemon exits; "
+             "unstarted claimed jobs are released to fleet peers "
+             "immediately (default: TPUPROF_SERVE_DRAIN_TIMEOUT_S, "
+             "else 30)")
+    overload.add_argument(
+        "--breaker-threshold", type=int, default=None, metavar="N",
+        help="consecutive corrupt/failed warehouse generation reads "
+             "per source before /v1/query skips the warehouse tier "
+             "for that source (default: TPUPROF_BREAKER_THRESHOLD, "
+             "else 3)")
+    overload.add_argument(
+        "--breaker-cooldown", type=float, default=None,
+        dest="breaker_cooldown_s", metavar="SEC",
+        help="open-breaker cooldown before one half-open probe is "
+             "allowed back through the warehouse (default: "
+             "TPUPROF_BREAKER_COOLDOWN_S, else 30)")
+    overload.add_argument(
+        "--serve-max-connections", type=int, default=None, metavar="N",
+        help="open-socket ceiling on the HTTP edge; newcomers past it "
+             "get a terse 503 (default: "
+             "TPUPROF_SERVE_MAX_CONNECTIONS, else 512)")
+    overload.add_argument(
+        "--serve-conn-timeout", type=float, default=None,
+        dest="serve_conn_timeout_s", metavar="SEC",
+        help="per-connection I/O deadline: a client must finish "
+             "sending its request (and drain its response) within "
+             "SEC — trickling bytes does not extend it (slow-loris "
+             "defense; default: TPUPROF_SERVE_CONN_TIMEOUT_S, else "
+             "30)")
+    overload.add_argument(
+        "--serve-max-header-bytes", type=int, default=None, metavar="B",
+        help="request-line + header byte cap per request (default: "
+             "TPUPROF_SERVE_MAX_HEADER_BYTES, else 64 KiB)")
+    overload.add_argument(
+        "--serve-max-body-bytes", type=int, default=None, metavar="B",
+        help="request body byte cap (default: "
+             "TPUPROF_SERVE_MAX_BODY_BYTES, else 1 MiB)")
     aot = s.add_argument_group(
         "restart-to-warm (AOT executable cache)", "after a runner "
         "compiles, its executables serialize into SPOOL/aot keyed by "
@@ -591,6 +644,14 @@ def build_parser() -> argparse.ArgumentParser:
     u.add_argument("--timeout", type=float, default=None, metavar="SEC",
                    help="give up waiting after SEC (the job keeps "
                         "running server-side)")
+    u.add_argument("--deadline-ms", type=int, default=None, metavar="MS",
+                   help="answer-within budget the DAEMON enforces "
+                        "(X-Tpuprof-Deadline-Ms): a job still queued "
+                        "MS milliseconds after submit is never "
+                        "started — it fails typed "
+                        "(DeadlineExceededError, exit code 11) "
+                        "instead of running for a client that stopped "
+                        "caring")
 
     d = sub.add_parser(
         "diff", help="compare two stats artifacts and report per-column "
@@ -948,6 +1009,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
                                          or http_port is not None),
                          daemon_id=args.daemon_id,
                          liveness_timeout_s=args.liveness_timeout,
+                         drain_timeout_s=args.serve_drain_timeout_s,
                          workers=args.serve_workers,
                          queue_depth=args.serve_queue_depth,
                          tenant_quota=args.serve_tenant_quota,
@@ -959,7 +1021,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
                          read_cache_entries=resolve_read_cache_entries(
                              args.read_cache_entries),
                          read_cache_bytes=resolve_read_cache_bytes(
-                             args.read_cache_bytes))
+                             args.read_cache_bytes),
+                         serve_backlog=args.serve_backlog)
     sched = daemon.scheduler
     if aot_dir:
         print(f"tpuprof: aot executable cache at {aot_dir} "
@@ -968,13 +1031,25 @@ def cmd_serve(args: argparse.Namespace) -> int:
               "hottest keys)", file=sys.stderr)
     edge = None
     if http_port is not None:
+        from tpuprof.config import (resolve_breaker_cooldown,
+                                    resolve_breaker_threshold)
         from tpuprof.errors import InputError
+        from tpuprof.serve.breaker import CircuitBreaker
         from tpuprof.serve.http import HttpEdge
         try:
             edge = HttpEdge(
                 daemon, port=http_port,
                 auth_file=resolve_serve_auth_file(
-                    args.serve_auth_file)).start()
+                    args.serve_auth_file),
+                max_connections=args.serve_max_connections,
+                conn_timeout_s=args.serve_conn_timeout_s,
+                max_header_bytes=args.serve_max_header_bytes,
+                max_body_bytes=args.serve_max_body_bytes,
+                breaker=CircuitBreaker(
+                    threshold=resolve_breaker_threshold(
+                        args.breaker_threshold),
+                    cooldown_s=resolve_breaker_cooldown(
+                        args.breaker_cooldown_s))).start()
         except (InputError, OSError) as exc:
             # bad auth file / port in use: refuse to start, one line
             print(f"tpuprof: error: http edge: {exc}", file=sys.stderr)
@@ -993,7 +1068,11 @@ def cmd_serve(args: argparse.Namespace) -> int:
 
     def _graceful(signum, frame):
         blackbox.record("signal", name="SIGTERM", action="drain")
-        daemon.stop_event.set()
+        daemon.stop_event.set()     # /v1/healthz flips to "draining"
+        if edge is not None:
+            # stop accepting new sockets NOW; established connections
+            # keep draining and in-flight answers are delivered
+            edge.stop_accepting()
 
     try:
         _signal.signal(_signal.SIGTERM, _graceful)
@@ -1219,7 +1298,7 @@ def cmd_submit(args: argparse.Namespace) -> int:
                 args.url, args.source, output=args.output,
                 tenant=args.tenant, stats_json=args.stats_json,
                 artifact=args.artifact, config_kwargs=config,
-                token=token)
+                token=token, deadline_ms=args.deadline_ms)
         except ServeUnavailableError as exc:
             # the edge itself is down: ITS typed exit code (9), so a
             # retry wrapper can tell "edge unreachable" from "the job
@@ -1231,6 +1310,15 @@ def cmd_submit(args: argparse.Namespace) -> int:
                   " (pass --token or set TPUPROF_SERVE_TOKEN)",
                   file=sys.stderr)
             return 2
+        if code == 503 and doc.get("reject_kind") == "BacklogFull":
+            # overload shed (ISSUE 19): the daemon is deliberately
+            # degrading to reads-only — the typed serve-plane exit
+            # code (9), with the server's Retry-After hint, so a
+            # retry wrapper backs off instead of hammering
+            print(f"tpuprof: error: job shed (HTTP 503): "
+                  f"{doc.get('error', doc)}", file=sys.stderr)
+            from tpuprof.errors import ServeUnavailableError as _SUE
+            return exit_code(_SUE(""))
         if code not in (200, 202):
             # the daemon answered and said no: 429 carries the
             # scheduler's reject reason, 400 the request's own fault
@@ -1254,10 +1342,17 @@ def cmd_submit(args: argparse.Namespace) -> int:
             print(f"tpuprof: error: {exc}", file=sys.stderr)
             return 4                # the watchdog-shaped failure
     else:
+        # a relative --deadline-ms budget resolves to an absolute wall
+        # clock HERE, at submit time — the spool file may sit unclaimed
+        # for a while, and that wait is exactly what the deadline bounds
+        deadline_unix_ms = (int((time.time() + args.deadline_ms / 1000.0)
+                                * 1000)
+                            if args.deadline_ms is not None else None)
         job_id = write_job(args.spool, args.source, output=args.output,
                            tenant=args.tenant,
                            stats_json=args.stats_json,
-                           artifact=args.artifact, config_kwargs=config)
+                           artifact=args.artifact, config_kwargs=config,
+                           deadline_unix_ms=deadline_unix_ms)
         if args.no_wait:
             print(job_id)
             return 0

@@ -326,6 +326,107 @@ def resolve_job_timeout(value: Optional[float] = None) -> Optional[float]:
     return resolve_watchdog_timeout(value, "TPUPROF_JOB_TIMEOUT_S")
 
 
+def resolve_serve_backlog(value: Optional[int] = None) -> int:
+    """Overload shed budget (``serve_backlog`` — ISSUE 19): the
+    queued-compute depth past which the edge SHEDS new non-cacheable
+    submits with HTTP 503 + a jittered ``Retry-After`` instead of
+    letting the queue fill toward its hard 429 bound — overload
+    degrades to "reads only", never to collapse.  Explicit config
+    value, else ``TPUPROF_SERVE_BACKLOG``, else 0 = shedding off (the
+    historical behavior: only the queue-depth 429 bound applies)."""
+    if value is not None:
+        return max(int(value), 0)
+    env = _env_int("TPUPROF_SERVE_BACKLOG")
+    return max(env, 0) if env is not None else 0
+
+
+def resolve_serve_drain_timeout(value: Optional[float] = None) -> float:
+    """Graceful-drain bound for the serve daemon (``serve_drain_timeout_s``
+    — ISSUE 19): after SIGTERM the daemon stops accepting new sockets,
+    finishes in-flight jobs for at most this many seconds, then releases
+    its unstarted spool claims so fleet peers steal the rest and exits 0.
+    Explicit config value, else ``TPUPROF_SERVE_DRAIN_TIMEOUT_S``, else
+    30 seconds.  Distinct from the device-drain watchdog
+    (``drain_timeout_s``) — that one bounds a blocking mesh call, this
+    one bounds a process's goodbye."""
+    if value is not None:
+        return max(float(value), 0.0)
+    env = _env_float("TPUPROF_SERVE_DRAIN_TIMEOUT_S")
+    return max(env, 0.0) if env is not None else 30.0
+
+
+def resolve_breaker_threshold(value: Optional[int] = None) -> int:
+    """Warehouse-pushdown circuit breaker trip point
+    (``breaker_threshold`` — serve/breaker.py): consecutive
+    corrupt/failed generation reads per source before the breaker
+    opens and queries skip straight to the compute tier.  Explicit
+    config value, else ``TPUPROF_BREAKER_THRESHOLD``, else 3."""
+    if value is not None:
+        return max(int(value), 1)
+    env = _env_int("TPUPROF_BREAKER_THRESHOLD")
+    return max(env, 1) if env is not None else 3
+
+
+def resolve_breaker_cooldown(value: Optional[float] = None) -> float:
+    """Open-breaker cooldown (``breaker_cooldown_s``): seconds an open
+    warehouse breaker waits before letting ONE half-open probe through;
+    a successful probe closes it, a failure re-opens it for another
+    cooldown.  Explicit config value, else
+    ``TPUPROF_BREAKER_COOLDOWN_S``, else 30 seconds."""
+    if value is not None:
+        return max(float(value), 0.0)
+    env = _env_float("TPUPROF_BREAKER_COOLDOWN_S")
+    return max(env, 0.0) if env is not None else 30.0
+
+
+def resolve_serve_max_connections(value: Optional[int] = None) -> int:
+    """HTTP edge connection ceiling (``serve_max_connections``): open
+    sockets the selector loop holds at once; an accept past the ceiling
+    is closed immediately (and counted) so a connection flood cannot
+    exhaust file descriptors.  Explicit config value, else
+    ``TPUPROF_SERVE_MAX_CONNECTIONS``, else 512."""
+    if value is not None:
+        return max(int(value), 1)
+    env = _env_int("TPUPROF_SERVE_MAX_CONNECTIONS")
+    return max(env, 1) if env is not None else 512
+
+
+def resolve_serve_conn_timeout(value: Optional[float] = None) -> float:
+    """Per-connection idle deadline (``serve_conn_timeout_s``): a
+    connection that neither completes a request nor accepts response
+    bytes for this many seconds is dropped — the slow-loris defense
+    (one drip-feeding client must never park edge state forever).
+    Explicit config value, else ``TPUPROF_SERVE_CONN_TIMEOUT_S``, else
+    30 seconds."""
+    if value is not None:
+        v = float(value)
+        return v if v > 0 else 30.0
+    env = _env_float("TPUPROF_SERVE_CONN_TIMEOUT_S")
+    return env if env and env > 0 else 30.0
+
+
+def resolve_serve_max_header_bytes(value: Optional[int] = None) -> int:
+    """Request head cap (``serve_max_header_bytes``): bytes of
+    request-line + headers the edge buffers before dropping the
+    connection as a flood.  Explicit config value, else
+    ``TPUPROF_SERVE_MAX_HEADER_BYTES``, else 64 KiB."""
+    if value is not None:
+        return max(int(value), 1024)
+    env = _env_int("TPUPROF_SERVE_MAX_HEADER_BYTES")
+    return max(env, 1024) if env is not None else 64 << 10
+
+
+def resolve_serve_max_body_bytes(value: Optional[int] = None) -> int:
+    """Request body cap (``serve_max_body_bytes``): a declared
+    Content-Length past this answers 400 without buffering the body.
+    Explicit config value, else ``TPUPROF_SERVE_MAX_BODY_BYTES``, else
+    1 MiB."""
+    if value is not None:
+        return max(int(value), 1024)
+    env = _env_int("TPUPROF_SERVE_MAX_BODY_BYTES")
+    return max(env, 1024) if env is not None else 1 << 20
+
+
 def resolve_watch_every(value: Optional[float] = None) -> float:
     """Continuous-drift watch cadence (``tpuprof watch --every``):
     seconds between re-profile cycles per watched source.  Explicit
@@ -875,6 +976,57 @@ class ProfilerConfig:
                                             # listed token).  None =
                                             # auto: TPUPROF_SERVE_AUTH_
                                             # FILE env, else open edge
+    serve_backlog: Optional[int] = None     # overload shed budget:
+                                            # queued-compute depth past
+                                            # which non-cacheable
+                                            # submits get 503 + jittered
+                                            # Retry-After while reads
+                                            # keep serving.  None =
+                                            # auto: TPUPROF_SERVE_
+                                            # BACKLOG env, else 0 =
+                                            # shedding off
+    serve_drain_timeout_s: Optional[float] = None  # graceful-drain
+                                            # bound after SIGTERM:
+                                            # finish in-flight jobs for
+                                            # at most this long, then
+                                            # release unstarted claims
+                                            # to the fleet and exit 0.
+                                            # None = auto: TPUPROF_
+                                            # SERVE_DRAIN_TIMEOUT_S
+                                            # env, else 30
+    breaker_threshold: Optional[int] = None  # warehouse-pushdown
+                                            # circuit breaker: open
+                                            # after this many
+                                            # consecutive failed reads
+                                            # per source.  None = auto:
+                                            # TPUPROF_BREAKER_THRESHOLD
+                                            # env, else 3
+    breaker_cooldown_s: Optional[float] = None  # open-breaker cooldown
+                                            # before ONE half-open
+                                            # probe.  None = auto:
+                                            # TPUPROF_BREAKER_
+                                            # COOLDOWN_S env, else 30
+    serve_max_connections: Optional[int] = None  # HTTP edge open-socket
+                                            # ceiling; accepts past it
+                                            # close immediately.  None
+                                            # = auto: TPUPROF_SERVE_
+                                            # MAX_CONNECTIONS env, else
+                                            # 512
+    serve_conn_timeout_s: Optional[float] = None  # per-connection idle
+                                            # deadline (slow-loris
+                                            # defense).  None = auto:
+                                            # TPUPROF_SERVE_CONN_
+                                            # TIMEOUT_S env, else 30
+    serve_max_header_bytes: Optional[int] = None  # request head cap
+                                            # before the conn drops as
+                                            # a flood.  None = auto:
+                                            # TPUPROF_SERVE_MAX_HEADER_
+                                            # BYTES env, else 64 KiB
+    serve_max_body_bytes: Optional[int] = None  # request body cap (a
+                                            # larger Content-Length is
+                                            # a 400).  None = auto:
+                                            # TPUPROF_SERVE_MAX_BODY_
+                                            # BYTES env, else 1 MiB
     job_timeout_s: Optional[float] = None   # serve per-job watchdog
                                             # (ROBUSTNESS.md rung 6): a
                                             # job running past this
@@ -1197,6 +1349,35 @@ class ProfilerConfig:
             raise ValueError(
                 "serve_http_port must be in [0, 65535] (0 = ephemeral; "
                 "or None = no HTTP edge)")
+        if self.serve_backlog is not None and self.serve_backlog < 0:
+            raise ValueError(
+                "serve_backlog must be >= 0 (0 = shedding off; or None)")
+        if self.serve_drain_timeout_s is not None \
+                and self.serve_drain_timeout_s < 0:
+            raise ValueError(
+                "serve_drain_timeout_s must be >= 0 (or None)")
+        if self.breaker_threshold is not None \
+                and self.breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1 (or None)")
+        if self.breaker_cooldown_s is not None \
+                and self.breaker_cooldown_s < 0:
+            raise ValueError("breaker_cooldown_s must be >= 0 (or None)")
+        if self.serve_max_connections is not None \
+                and self.serve_max_connections < 1:
+            raise ValueError(
+                "serve_max_connections must be >= 1 (or None)")
+        if self.serve_conn_timeout_s is not None \
+                and self.serve_conn_timeout_s <= 0:
+            raise ValueError(
+                "serve_conn_timeout_s must be > 0 (or None)")
+        if self.serve_max_header_bytes is not None \
+                and self.serve_max_header_bytes < 1024:
+            raise ValueError(
+                "serve_max_header_bytes must be >= 1024 (or None)")
+        if self.serve_max_body_bytes is not None \
+                and self.serve_max_body_bytes < 1024:
+            raise ValueError(
+                "serve_max_body_bytes must be >= 1024 (or None)")
         if self.read_cache is not None \
                 and self.read_cache not in READ_CACHE_MODES:
             raise ValueError(

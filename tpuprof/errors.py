@@ -85,6 +85,17 @@ The AOT executable cache (runtime/aot.py — ROADMAP 3(d)) adds one:
   reaches a CLI; when it does (direct store surgery), it shares
   CorruptArtifactError's exit code 6.
 
+The overload layer (serve/scheduler.py + serve/http.py — ISSUE 19)
+adds one:
+
+* ``DeadlineExceededError`` (TimeoutError) — a serve job's client-set
+  deadline (``X-Tpuprof-Deadline-Ms`` / ``--deadline-ms``) expired
+  before the job started running.  The scheduler never starts an
+  already-dead job: the mesh time would be wasted on an answer nobody
+  is waiting for.  Distinct from ``WatchdogTimeout`` ("the work ran
+  too long") — this is "the work never ran because the caller stopped
+  caring"; the CLI maps it to exit code 11.
+
 The edge read tier (serve/cache.py ResultCache — ISSUE 16) adds one:
 
 * ``CorruptReadCacheError`` (CorruptArtifactError) — a read-cache
@@ -234,21 +245,40 @@ class WatchdogTimeout(TimeoutError):
         self.heartbeat = heartbeat
 
 
+class DeadlineExceededError(TimeoutError):
+    """A serve job's client-propagated deadline expired before the job
+    started running (serve/scheduler.py — ISSUE 19).  The scheduler
+    refuses to start an already-dead job; carries how late the job was
+    when it reached the front of the queue so operators can size
+    ``serve_backlog``/workers.  The CLI maps it to exit code 11."""
+
+    def __init__(self, job_id: str, late_by_s: float):
+        super().__init__(
+            f"deadline exceeded: job {job_id!r} reached the front of "
+            f"the queue {late_by_s:.3f}s past its client deadline — "
+            "not started")
+        self.job_id = job_id
+        self.late_by_s = late_by_s
+
+
 # the typed taxonomy the CLI (and the crash flight recorder's
 # postmortem dumps — obs/blackbox.py) treats as "expected failure
 # shapes": one-line message + distinct exit code, no traceback
 TYPED_ERRORS = (InputError, CorruptCheckpointError, CorruptArtifactError,
                 CorruptManifestError, PoisonBatchError, WatchdogTimeout,
                 HostDeathError, ServeUnavailableError, LintFindingsError,
-                WarehouseUnavailableError)
+                WarehouseUnavailableError, DeadlineExceededError)
 
 _EXIT_CODES = (
     # order matters: InputError, CorruptCheckpointError,
     # CorruptArtifactError and CorruptManifestError are all ValueErrors
-    # — the most specific classes must match first
+    # — the most specific classes must match first (likewise
+    # DeadlineExceededError and WatchdogTimeout are both TimeoutErrors,
+    # but siblings — neither shadows the other)
     (CorruptCheckpointError, 3),
     (CorruptArtifactError, 6),
     (CorruptManifestError, 7),
+    (DeadlineExceededError, 11),
     (WatchdogTimeout, 4),
     (PoisonBatchError, 5),
     (HostDeathError, 8),

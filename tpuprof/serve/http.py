@@ -18,7 +18,11 @@ Routes::
                                  quota/depth rejection -> 429 with the
                                  scheduler's reject reason; malformed
                                  body -> 400 (never a daemon crash);
-                                 draining daemon -> 503
+                                 draining daemon -> 503; backlog shed
+                                 (ISSUE 19) -> 503 with a jittered
+                                 Retry-After derived from the observed
+                                 drain rate — read-tier hits keep
+                                 serving while compute degrades
     POST /v1/query               {source, cols, stats} -> the values
                                  doc, answered from the cheapest tier
                                  that is still CORRECT: the edge
@@ -99,6 +103,7 @@ from tpuprof.obs import events as _obs_events
 from tpuprof.obs import metrics as _obs_metrics
 from tpuprof.serve.server import (JOB_SCHEMA, RESULT_SCHEMA, ServeDaemon,
                                   poll_intervals, read_result)
+from tpuprof.testing import faults as _faults
 
 _REQUESTS = _obs_metrics.counter(
     "tpuprof_http_requests_total",
@@ -170,18 +175,29 @@ HTTP_WORKERS = 8                    # bounded handler pool: concurrency
 class _Conn:
     """One client connection's loop-owned state."""
     __slots__ = ("sock", "rbuf", "wbuf", "busy", "close_after",
-                 "dropped", "events")
+                 "dropped", "events", "deadline", "pending_job")
 
     def __init__(self, sock):
         self.sock = sock
         self.rbuf = b""             # bytes read, not yet parsed
         self.wbuf = b""             # response bytes not yet written
-        self.busy = False           # a request is in flight (reads
-                                    # paused — backpressure, and no
-                                    # pipelining ambiguity)
+        self.busy = False           # a request is in flight (no
+                                    # pipelining ambiguity: dispatch
+                                    # waits for the answer)
         self.close_after = False    # close once wbuf drains
         self.dropped = False
         self.events = 0             # current selector interest mask
+        self.deadline = None        # monotonic cutoff for the CURRENT
+                                    # I/O obligation (finish sending a
+                                    # request / drain a response); a
+                                    # trickling client cannot extend it
+                                    # — the slow-loris defense (ISSUE
+                                    # 19).  None while a handler runs:
+                                    # job time is the watchdog's beat.
+        self.pending_job = None     # job id this connection is owed an
+                                    # answer for — a disconnect before
+                                    # the answer cancels it if still
+                                    # unclaimed (ISSUE 19)
 
 
 class _SelectorHttpServer:
@@ -203,7 +219,25 @@ class _SelectorHttpServer:
     per HTTP/1.1 semantics, partial writes finished under
     ``EVENT_WRITE``."""
 
-    def __init__(self, address, workers: int = HTTP_WORKERS):
+    def __init__(self, address, workers: int = HTTP_WORKERS,
+                 max_connections: Optional[int] = None,
+                 conn_timeout_s: Optional[float] = None,
+                 max_header_bytes: Optional[int] = None,
+                 max_body_bytes: Optional[int] = None):
+        from tpuprof.config import (resolve_serve_conn_timeout,
+                                    resolve_serve_max_body_bytes,
+                                    resolve_serve_max_connections,
+                                    resolve_serve_max_header_bytes)
+        # per-connection abuse caps (ISSUE 19): an open socket is a
+        # bounded liability — a ceiling on how many, a deadline on each
+        # I/O obligation, and byte caps on what one request may send
+        self.max_connections = resolve_serve_max_connections(
+            max_connections)
+        self.conn_timeout_s = resolve_serve_conn_timeout(conn_timeout_s)
+        self.max_header_bytes = resolve_serve_max_header_bytes(
+            max_header_bytes)
+        self.max_body_bytes = resolve_serve_max_body_bytes(
+            max_body_bytes)
         self.edge = None            # set by HttpEdge after construction
         self._listen = socket.create_server(address, backlog=128)
         self._listen.setblocking(False)
@@ -227,6 +261,8 @@ class _SelectorHttpServer:
         self._conns: set = set()
         self._stop = threading.Event()
         self._stopped = threading.Event()
+        self._accepting = True          # loop-thread view
+        self._stop_accept = threading.Event()   # cross-thread request
 
     # -- loop --------------------------------------------------------------
 
@@ -249,7 +285,10 @@ class _SelectorHttpServer:
                         if mask & selectors.EVENT_READ \
                                 and not conn.dropped:
                             self._readable(conn)
+                if self._stop_accept.is_set() and self._accepting:
+                    self._pause_listener()
                 self._drain_completed()
+                self._sweep_deadlines()
         finally:
             self._stopped.set()
 
@@ -257,6 +296,37 @@ class _SelectorHttpServer:
         self._stop.set()
         self._wake()
         self._stopped.wait(timeout=10)
+
+    def stop_accepting(self) -> None:
+        """Graceful-drain step 1 (ISSUE 19): close the listening socket
+        (the port frees immediately for a replacement daemon) while
+        every established connection keeps its reads, its in-flight
+        handlers, and its pending writes.  Thread-safe; the loop thread
+        does the actual unregister on its next tick."""
+        self._stop_accept.set()
+        self._wake()
+
+    def _pause_listener(self) -> None:
+        self._accepting = False
+        try:
+            self._sel.unregister(self._listen)
+        except (KeyError, OSError):
+            pass
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+    def _sweep_deadlines(self) -> None:
+        """Reap connections past their I/O deadline — the slow-loris
+        defense: a client trickling header bytes (or never draining its
+        response) holds a socket for at most ``conn_timeout_s``, because
+        progress does NOT extend the deadline; only completing the
+        obligation clears it."""
+        now = time.monotonic()
+        for conn in [c for c in self._conns
+                     if c.deadline is not None and now > c.deadline]:
+            self._drop(conn)
 
     def server_close(self) -> None:
         for sock in (self._listen, self._wake_r, self._wake_w):
@@ -285,13 +355,35 @@ class _SelectorHttpServer:
     # -- socket events (loop thread only) ----------------------------------
 
     def _accept(self) -> None:
-        while True:
+        while self._accepting:
             try:
+                # chaos seam (ISSUE 19): an injected accept failure
+                # (EMFILE under fd pressure) must skip THIS round and
+                # leave the listener registered — the loop survives
+                _faults.hit("http_accept")
                 sock, _addr = self._listen.accept()
             except (BlockingIOError, OSError):
                 return
+            except Exception:       # noqa: BLE001 — injected fault
+                return
             sock.setblocking(False)
+            if len(self._conns) >= self.max_connections:
+                # connection ceiling: the newcomer gets a terse 503 and
+                # the door — accepting unboundedly would turn every fd
+                # the OS grants into loop state an attacker sized
+                try:
+                    sock.send(b"HTTP/1.1 503 Service Unavailable\r\n"
+                              b"Connection: close\r\n"
+                              b"Content-Length: 0\r\n\r\n")
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
             conn = _Conn(sock)
+            conn.deadline = time.monotonic() + self.conn_timeout_s
             self._conns.add(conn)
             self._interest(conn, selectors.EVENT_READ)
 
@@ -313,6 +405,16 @@ class _SelectorHttpServer:
         if conn.dropped:
             return
         conn.dropped = True
+        if conn.pending_job is not None:
+            # the client this answer was for is gone: cancel the job if
+            # no worker claimed it yet (claimed jobs finish and publish
+            # to the result cache — coalescing followers still win)
+            jid, conn.pending_job = conn.pending_job, None
+            if self.edge is not None:
+                try:
+                    self.edge.client_gone(jid)
+                except Exception:   # noqa: BLE001 — dropping must not
+                    pass            # take the loop down
         if conn.events:
             try:
                 self._sel.unregister(conn.sock)
@@ -334,9 +436,13 @@ class _SelectorHttpServer:
             self._drop(conn)
             return
         if not data:
-            self._drop(conn)        # peer closed
-            return
+            self._drop(conn)        # peer closed (a busy connection's
+            return                  # drop cancels its pending job)
         conn.rbuf += data
+        if conn.busy and len(conn.rbuf) > \
+                self.max_header_bytes + self.max_body_bytes:
+            self._drop(conn)        # flooding while an answer is owed
+            return
         self._maybe_dispatch(conn)
 
     def _maybe_dispatch(self, conn: _Conn) -> None:
@@ -346,7 +452,7 @@ class _SelectorHttpServer:
             return
         head_end = conn.rbuf.find(b"\r\n\r\n")
         if head_end < 0:
-            if len(conn.rbuf) > MAX_HEADER_BYTES:
+            if len(conn.rbuf) > self.max_header_bytes:
                 self._drop(conn)    # header flood, no valid request
             return
         head_lines = conn.rbuf[:head_end].split(b"\r\n")
@@ -363,7 +469,7 @@ class _SelectorHttpServer:
         except ValueError:
             length = -1
         body: Optional[bytes] = None
-        if 0 <= length <= MAX_BODY_BYTES:
+        if 0 <= length <= self.max_body_bytes:
             total = head_end + 4 + length
             if len(conn.rbuf) < total:
                 return              # body still arriving
@@ -382,7 +488,13 @@ class _SelectorHttpServer:
             if (headers.get("Connection") or "").lower() == "close":
                 conn.close_after = True
         conn.busy = True
-        self._interest(conn, 0)     # pause reads while answering
+        conn.deadline = None        # handler time is the job
+                                    # watchdog's business, not the
+                                    # transport's
+        # reads stay on while answering: a peer that disconnects
+        # mid-handling is noticed by the empty recv (and its pending
+        # job cancelled) instead of discovered at write time; dispatch
+        # of buffered pipelined bytes still waits on `busy`
         self._pool.submit(self._handle, conn, method, path, body,
                           headers)
 
@@ -390,6 +502,14 @@ class _SelectorHttpServer:
         if conn.dropped:
             return
         if conn.wbuf:
+            try:
+                # chaos seam (ISSUE 19): a connection reset mid-
+                # response — the client sees a torn answer, the loop
+                # drops the socket and keeps serving everyone else
+                _faults.hit("http_write")
+            except Exception:       # noqa: BLE001 — injected fault
+                self._drop(conn)
+                return
             try:
                 sent = conn.sock.send(conn.wbuf)
                 conn.wbuf = conn.wbuf[sent:]
@@ -406,6 +526,8 @@ class _SelectorHttpServer:
         if conn.close_after:
             self._drop(conn)
             return
+        # response fully delivered: the idle keep-alive clock starts
+        conn.deadline = time.monotonic() + self.conn_timeout_s
         self._interest(conn, selectors.EVENT_READ)
         if conn.rbuf:
             # the client already sent its next keep-alive request
@@ -419,8 +541,14 @@ class _SelectorHttpServer:
                 conn, payload = self._completed.popleft()
             if conn.dropped:
                 continue
+            conn.pending_job = None     # the answer is on its way out:
+                                        # the id is (being) delivered,
+                                        # the job is the client's now
             conn.wbuf += payload
             conn.busy = False
+            # the write obligation gets its own deadline: a client that
+            # never drains its answer is a held fd, not a served one
+            conn.deadline = time.monotonic() + self.conn_timeout_s
             self._flush(conn)
 
     # -- request handling (worker pool) ------------------------------------
@@ -430,7 +558,8 @@ class _SelectorHttpServer:
         t0 = time.perf_counter()
         extra: Optional[Dict[str, str]] = None
         try:
-            res = self.edge.handle(method, path, body, headers)
+            res = self.edge.handle(method, path, body, headers,
+                                   conn=conn)
             code, rbody, route = res[0], res[1], res[2]
             if len(res) > 3:
                 extra = res[3]
@@ -480,11 +609,30 @@ class HttpEdge:
 
     def __init__(self, daemon: ServeDaemon, port: int = 0,
                  host: str = "127.0.0.1",
-                 auth_file: Optional[str] = None):
+                 auth_file: Optional[str] = None,
+                 max_connections: Optional[int] = None,
+                 conn_timeout_s: Optional[float] = None,
+                 max_header_bytes: Optional[int] = None,
+                 max_body_bytes: Optional[int] = None,
+                 breaker=None):
         self.daemon = daemon
         self.tokens = load_auth_file(auth_file) if auth_file else None
-        self.httpd = _SelectorHttpServer((host, int(port)))
+        self.httpd = _SelectorHttpServer(
+            (host, int(port)),
+            max_connections=max_connections,
+            conn_timeout_s=conn_timeout_s,
+            max_header_bytes=max_header_bytes,
+            max_body_bytes=max_body_bytes)
         self.httpd.edge = self
+        # warehouse-pushdown circuit breaker (ISSUE 19): the daemon's
+        # if it built one, else the process-wide default — a rotting
+        # source's corrupt-walk tax is paid once, not per query
+        if breaker is None:
+            breaker = getattr(daemon, "breaker", None)
+        if breaker is None:
+            from tpuprof.serve.breaker import default_breaker
+            breaker = default_breaker()
+        self.breaker = breaker
         self.host = host
         self.port = int(self.httpd.server_address[1])
         self._thread: Optional[threading.Thread] = None
@@ -510,6 +658,25 @@ class HttpEdge:
         _fleet.atomic_write(self._advert, (self.url + "\n").encode())
         return self
 
+    def stop_accepting(self) -> None:
+        """Graceful-drain step 1 (ISSUE 19): pull the spool advert (no
+        new discovery) and close the listening socket, while every
+        established connection keeps draining — in-flight answers are
+        delivered, not torn."""
+        if self._advert:
+            try:
+                os.unlink(self._advert)
+            except OSError:
+                pass
+            self._advert = None
+        self.httpd.stop_accepting()
+
+    def client_gone(self, job_id: str) -> None:
+        """The connection owed this job's answer dropped: cancel the
+        job if no worker claimed it yet (the scheduler refuses once it
+        is running, terminal, or carrying coalesced followers)."""
+        self.daemon.scheduler.cancel(job_id)
+
     def close(self) -> None:
         if self._advert:
             try:
@@ -524,13 +691,15 @@ class HttpEdge:
     # -- routing -----------------------------------------------------------
 
     def handle(self, method: str, path: str, body: Optional[bytes],
-               headers) -> Tuple:
+               headers, conn=None) -> Tuple:
         """(status, body, route-pattern[, extra-headers]) for one
         request.  ``body`` as bytes passes through verbatim (the
         /metrics exposition, pre-serialized conditional answers);
         anything else is JSON-encoded by the transport.  The optional
         fourth element is a header dict (ETag, provenance, an
-        overriding Content-Type)."""
+        overriding Content-Type).  ``conn`` is the transport's
+        connection record when there is one — the disconnect-
+        cancellation hook (ISSUE 19) rides it."""
         path, _, query = path.partition("?")
         if method == "GET" and path == "/metrics":
             return (200,
@@ -555,9 +724,9 @@ class HttpEdge:
                 return (401, {"error": "missing or unknown bearer "
                                        "token"}, "auth")
         if method == "POST" and path == "/v1/jobs":
-            return self._post_job(body, tenant)
+            return self._post_job(body, tenant, headers, conn)
         if method == "POST" and path == "/v1/query":
-            return self._post_query(body, tenant, headers)
+            return self._post_query(body, tenant, headers, conn)
         if method == "GET":
             m = re.match(r"^/v1/jobs/([^/]+)$", path)
             if m:
@@ -619,10 +788,24 @@ class HttpEdge:
             # ledger (computed vs coalesced) next to warming state
             body["computed"] = sched._computed
             body["coalesced"] = sched._coalesced
+            # overload ledger (ISSUE 19): submitted = terminal counts +
+            # live jobs — the reconciliation the shed bench asserts
+            # (nothing lost, nothing double-computed)
+            body["requests"] = sched._submitted
+            body["counts"] = dict(sched._counts)
+            body["shed"] = sched._shed
+            body["deadline_expired"] = sched._deadline_expired
+            body["cancelled"] = sched._cancelled
+            body["released"] = sched._released
+        body["serve_backlog"] = sched.serve_backlog
         body["queued"] = len(sched._queue)
+        body["connections"] = len(self.httpd._conns)
+        body["breaker"] = self.breaker.snapshot() \
+            if self.breaker is not None else None
         rc = getattr(sched, "read_cache", None)
         body["read_cache"] = rc.stats() if rc is not None else None
-        if daemon.stop_event.is_set():
+        body["draining"] = daemon.stop_event.is_set()
+        if body["draining"]:
             body["status"] = "draining"
             return 503, body, route
         if prewarm is not None and not prewarm["done"]:
@@ -632,13 +815,15 @@ class HttpEdge:
         return 200, body, route
 
     def _post_job(self, body: Optional[bytes],
-                  auth_tenant: Optional[str]) -> Tuple[int, Any, str]:
+                  auth_tenant: Optional[str], headers=None,
+                  conn=None) -> Tuple[int, Any, str]:
         route = "/v1/jobs"
         # a corrupt request body is the CLIENT's failure: 400 with the
         # parse error, never a daemon crash, never a spooled job
         if body is None:
             return (400, {"error": "missing or oversized request body "
-                                   f"(cap {MAX_BODY_BYTES} bytes)"},
+                                   f"(cap {self.httpd.max_body_bytes} "
+                                   "bytes)"},
                     route)
         try:
             req = json.loads(body)
@@ -666,25 +851,58 @@ class HttpEdge:
         # naming someone else's tenant is billing fraud, not a knob
         tenant = auth_tenant if auth_tenant is not None \
             else (req.get("tenant") or "default")
+        # client deadline (ISSUE 19): the header is a RELATIVE budget
+        # ("answer within N ms of receipt"); the body field is the
+        # absolute wire form (deadline_unix_ms) a spool forwarder
+        # carries.  The header wins — it is what THIS client asked.
+        deadline_unix = None
+        hdr = headers.get("X-Tpuprof-Deadline-Ms") if headers else None
+        if hdr is not None:
+            try:
+                ms = int(hdr)
+                if ms < 1:
+                    raise ValueError
+            except (TypeError, ValueError):
+                return (400, {"error": "X-Tpuprof-Deadline-Ms must be "
+                                       "a positive integer millisecond "
+                                       f"budget, got {hdr!r}"}, route)
+            deadline_unix = time.time() + ms / 1000.0
+        elif req.get("deadline_unix_ms") is not None:
+            try:
+                deadline_unix = int(req["deadline_unix_ms"]) / 1000.0
+            except (TypeError, ValueError):
+                return (400, {"error": "'deadline_unix_ms' must be an "
+                                       "integer epoch-millisecond "
+                                       "deadline"}, route)
         job = self.daemon.submit_local(
             source, output=req.get("output"), tenant=tenant,
             stats_json=req.get("stats_json"),
-            artifact=req.get("artifact"), config_kwargs=config)
+            artifact=req.get("artifact"), config_kwargs=config,
+            deadline_unix=deadline_unix)
         if job.state == "rejected":
             # the scheduler's admission hook decides the status class:
             # resource pressure (full queue / tenant over quota) is
             # 429 retry-later WITH the scheduler's reject reason; a
-            # draining daemon is 503; a bad config is the request's
-            # own fault (400)
+            # draining daemon is 503; a backlog shed (ISSUE 19) is 503
+            # WITH a jittered Retry-After sized to the observed drain
+            # rate; a bad config is the request's own fault (400)
+            wire = dict(job.to_wire())
+            wire["schema"] = RESULT_SCHEMA
+            if job.reject_kind == "BacklogFull":
+                retry = self.daemon.scheduler.retry_after_s()
+                return (503, wire, route,
+                        {"Retry-After": f"{retry:g}"})
             if job.reject_kind in ("QueueFull", "TenantQuotaExceeded"):
                 code = 429
             elif job.reject_kind == "QueueClosed":
                 code = 503
             else:
                 code = 400
-            wire = dict(job.to_wire())
-            wire["schema"] = RESULT_SCHEMA
             return code, wire, route
+        if conn is not None:
+            # owe this connection the 202: a disconnect before it is
+            # written cancels the job if still unclaimed
+            conn.pending_job = job.id
         return (202, {"schema": JOB_SCHEMA, "id": job.id,
                       "tenant": job.tenant, "status": job.state},
                 route)
@@ -798,7 +1016,8 @@ class HttpEdge:
     # -- query pushdown (ISSUE 16 (c)) -------------------------------------
 
     def _post_query(self, body: Optional[bytes],
-                    auth_tenant: Optional[str], headers) -> Tuple:
+                    auth_tenant: Optional[str], headers,
+                    conn=None) -> Tuple:
         """``POST /v1/query {source, cols, stats}``: answer column
         statistics from the CHEAPEST tier that is still correct —
 
@@ -819,7 +1038,8 @@ class HttpEdge:
         t0 = time.perf_counter()
         if body is None:
             return (400, {"error": "missing or oversized request body "
-                                   f"(cap {MAX_BODY_BYTES} bytes)"},
+                                   f"(cap {self.httpd.max_body_bytes} "
+                                   "bytes)"},
                     route)
         try:
             req = json.loads(body)
@@ -866,16 +1086,38 @@ class HttpEdge:
                     source, cols, stats, t0)
 
         # warehouse tier: the newest readable generation, column-pruned
+        # — gated by the per-source circuit breaker (ISSUE 19): a
+        # source whose generations keep reading corrupt pays the
+        # corrupt-walk disk tax ONCE per cooldown, not per query
         from tpuprof.errors import WarehouseUnavailableError
         from tpuprof.warehouse import store as _store
         from tpuprof.warehouse.history import query_columns
         dirpath = _store.source_dir(
             os.path.join(self.daemon.spool, "warehouse"), source)
+        breaker = self.breaker
+        breaker_open = breaker is not None \
+            and not breaker.allow(source)
         gen_doc = None
-        try:
-            gen_doc = query_columns(dirpath, cols, stats)
-        except WarehouseUnavailableError:
-            gen_doc = None          # no pyarrow here: compute answers
+        corrupt_reads: list = []
+        if not breaker_open:
+            try:
+                gen_doc = query_columns(
+                    dirpath, cols, stats,
+                    on_corrupt=(
+                        lambda path, exc:
+                        (corrupt_reads.append(path),
+                         breaker.record_failure(source))
+                        if breaker is not None
+                        else corrupt_reads.append(path)))
+            except WarehouseUnavailableError:
+                gen_doc = None      # no pyarrow here: compute answers
+                                    # (environment, not rot — the
+                                    # breaker does not count it)
+            if breaker is not None and gen_doc is not None \
+                    and not corrupt_reads:
+                # a clean walk (no corrupt skips) is the probe/success
+                # signal that closes a half-open breaker
+                breaker.record_success(source)
         fresh = False
         if gen_doc is not None and not gen_doc["missing"]:
             created = gen_doc.get("created_unix")
@@ -907,20 +1149,32 @@ class HttpEdge:
                                job_id=jid, stats_json=tmp_stats,
                                config_kwargs=kwargs))
         if job.state == "rejected":
+            wire = dict(job.to_wire())
+            wire["schema"] = RESULT_SCHEMA
+            if job.reject_kind == "BacklogFull":
+                retry = sched.retry_after_s()
+                return (503, wire, route,
+                        {"Retry-After": f"{retry:g}"})
             if job.reject_kind in ("QueueFull", "TenantQuotaExceeded"):
                 code = 429
             elif job.reject_kind == "QueueClosed":
                 code = 503
             else:
                 code = 400
-            wire = dict(job.to_wire())
-            wire["schema"] = RESULT_SCHEMA
             return code, wire, route
+        if conn is not None:
+            # this handler blocks on the answer: a client that
+            # disconnects mid-wait cancels the job if no worker
+            # claimed it yet (ISSUE 19)
+            conn.pending_job = job.id
         try:
             sched.wait(job, timeout=3600)
         except TimeoutError:
             return (504, {"error": f"query profile {job.id} still "
                                    f"{job.state} after 3600s"}, route)
+        finally:
+            if conn is not None:
+                conn.pending_job = None
         if job.state != "done":
             code = 400 if job.exit_code == 2 else 500
             return (code, {"error": job.error,
@@ -943,7 +1197,11 @@ class HttpEdge:
             var = variables.get(col) or {}
             columns[col] = {s: var.get(s) for s in stats}
         doc = {"schema": QUERY_SCHEMA, "source": source,
-               "provenance": "computed", "generation": None,
+               # "breaker_open": computed BECAUSE the warehouse is
+               # tripped for this source — operators see the detour
+               "provenance": ("breaker_open" if breaker_open
+                              else "computed"),
+               "generation": None,
                "rows": job.result.get("rows"), "columns": columns}
         return self._query_answer(doc, key, rc, route, headers,
                                   cols, stats, t0)
@@ -986,7 +1244,9 @@ class HttpEdge:
 def _request(url: str, method: str = "GET",
              payload: Optional[Dict[str, Any]] = None,
              token: Optional[str] = None,
-             timeout: float = 30.0) -> Tuple[int, Dict[str, Any]]:
+             timeout: float = 30.0,
+             extra_headers: Optional[Dict[str, str]] = None
+             ) -> Tuple[int, Dict[str, Any]]:
     """One HTTP exchange -> (status, decoded JSON body).  An HTTP
     error status is a NORMAL return (the daemon answered); only
     failing to reach the daemon at all raises, and it raises the typed
@@ -1000,6 +1260,7 @@ def _request(url: str, method: str = "GET",
         headers["Content-Type"] = "application/json"
     if token:
         headers["Authorization"] = f"Bearer {token}"
+    headers.update(extra_headers or {})
     req = urllib.request.Request(url, data=data, headers=headers,
                                  method=method)
     try:
@@ -1029,11 +1290,16 @@ def submit_job(base_url: str, source: str, output: Optional[str] = None,
                artifact: Optional[str] = None,
                config_kwargs: Optional[Dict[str, Any]] = None,
                token: Optional[str] = None,
-               timeout: float = 30.0) -> Tuple[int, Dict[str, Any]]:
+               timeout: float = 30.0,
+               deadline_ms: Optional[int] = None
+               ) -> Tuple[int, Dict[str, Any]]:
     """POST one job to an HTTP edge.  Paths resolve to absolute
     client-side, exactly like the spool transport's ``write_job`` —
     the daemon's cwd is not the client's (the edge and its clients
-    share storage the way spool clients do)."""
+    share storage the way spool clients do).  ``deadline_ms`` rides
+    the ``X-Tpuprof-Deadline-Ms`` header (ISSUE 19): a relative
+    answer-within budget the daemon enforces — a job still queued past
+    it is never started and fails typed (exit code 11)."""
     payload: Dict[str, Any] = {
         "schema": JOB_SCHEMA,
         "source": os.path.abspath(source),
@@ -1044,8 +1310,11 @@ def submit_job(base_url: str, source: str, output: Optional[str] = None,
     }
     if tenant is not None:
         payload["tenant"] = str(tenant)
+    extra = {"X-Tpuprof-Deadline-Ms": str(int(deadline_ms))} \
+        if deadline_ms is not None else None
     return _request(base_url.rstrip("/") + "/v1/jobs", method="POST",
-                    payload=payload, token=token, timeout=timeout)
+                    payload=payload, token=token, timeout=timeout,
+                    extra_headers=extra)
 
 
 def wait_result_http(base_url: str, job_id: str,

@@ -33,7 +33,10 @@ REJECTED = "rejected"
 TERMINAL = (DONE, FAILED, REJECTED)
 
 _TRANSITIONS = {
-    QUEUED: {RUNNING, REJECTED},
+    # QUEUED -> FAILED is the never-started terminal: a client deadline
+    # that expired in the queue, or a cancellation before any worker
+    # claimed the job (ISSUE 19) — the work must not reach the mesh
+    QUEUED: {RUNNING, REJECTED, FAILED},
     RUNNING: {DONE, FAILED},
     DONE: set(),
     FAILED: set(),
@@ -56,7 +59,8 @@ class Job:
                  tenant: str = "default", job_id: Optional[str] = None,
                  stats_json: Optional[str] = None,
                  artifact: Optional[str] = None,
-                 config_kwargs: Optional[Dict[str, Any]] = None):
+                 config_kwargs: Optional[Dict[str, Any]] = None,
+                 deadline_unix: Optional[float] = None):
         self.id = job_id or new_job_id()
         self.source = source
         self.output = output
@@ -64,6 +68,14 @@ class Job:
         self.stats_json = stats_json
         self.artifact = artifact
         self.config_kwargs = dict(config_kwargs or {})
+        self.deadline_unix = (float(deadline_unix)
+                              if deadline_unix is not None else None)
+                                                # client deadline, epoch
+                                                # seconds: expired jobs
+                                                # are never started
+        self.cancelled = False                  # client gone before the
+                                                # answer; honored only
+                                                # while still QUEUED
         self.state = QUEUED
         self.error: Optional[str] = None
         self.exit_code: Optional[int] = None
@@ -137,6 +149,10 @@ class Job:
             out["exit_code"] = self.exit_code
         if self.reject_kind is not None:
             out["reject_kind"] = self.reject_kind
+        if self.deadline_unix is not None:
+            out["deadline_unix_ms"] = int(self.deadline_unix * 1000)
+        if self.cancelled:
+            out["cancelled"] = True
         if self.cache_hit is not None:
             out["cache_hit"] = self.cache_hit
         if self.coalesced_with is not None:
@@ -210,6 +226,23 @@ class JobQueue:
             else:
                 self._tenant_live[job.tenant] = live - 1
 
+    def drain(self, keep=None) -> List[Job]:
+        """Graceful-drain helper (ISSUE 19): pop and return every job
+        still waiting in the queue — jobs ``keep(job)`` selects stay
+        queued (a closing fleet daemon keeps follower-laden jobs, whose
+        local waiters still need the answer HERE)."""
+        with self._lock:
+            kept: "collections.deque[Job]" = collections.deque()
+            out: List[Job] = []
+            while self._queue:
+                job = self._queue.popleft()
+                if keep is not None and keep(job):
+                    kept.append(job)
+                else:
+                    out.append(job)
+            self._queue.extend(kept)
+        return out
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -232,6 +265,14 @@ class JobQueue:
 
 class QueueFull(RuntimeError):
     """Admission rejected: the bounded queue is at depth."""
+
+
+class BacklogFull(RuntimeError):
+    """Admission shed: the queued-compute depth crossed the
+    ``serve_backlog`` budget (ISSUE 19).  Softer than :class:`QueueFull`
+    — the queue still has room, the daemon is deliberately degrading to
+    "reads only"; the HTTP edge answers 503 with a jittered
+    ``Retry-After`` derived from the observed drain rate."""
 
 
 class TenantQuotaExceeded(RuntimeError):

@@ -31,8 +31,9 @@ from tpuprof.obs import events as _obs_events
 from tpuprof.obs import metrics as _obs_metrics
 from tpuprof.serve import cache as _cache
 from tpuprof.serve.jobs import (DONE, FAILED, QUEUED, REJECTED, RUNNING,
-                                TERMINAL, Job, JobQueue, QueueClosed,
-                                QueueFull, TenantQuotaExceeded, percentile)
+                                TERMINAL, BacklogFull, Job, JobQueue,
+                                QueueClosed, QueueFull,
+                                TenantQuotaExceeded, percentile)
 
 _REQUESTS = _obs_metrics.counter(
     "tpuprof_serve_requests_total",
@@ -50,6 +51,16 @@ _COALESCED = _obs_metrics.counter(
     "tpuprof_coalesced_jobs_total",
     "submits that collapsed onto an in-flight same-key job (read tier "
     "— exactly-once compute, N fanned-out results)")
+_SHED = _obs_metrics.counter(
+    "tpuprof_requests_shed_total",
+    "non-cacheable submits shed at admission because the queued-compute "
+    "depth crossed serve_backlog (HTTP 503 + jittered Retry-After) — "
+    "overload degrading to reads-only, by design")
+_DEADLINE_EXPIRED = _obs_metrics.counter(
+    "tpuprof_deadline_expired_total",
+    "queued jobs whose client deadline (X-Tpuprof-Deadline-Ms / "
+    "--deadline-ms) expired before a worker reached them — never "
+    "started, failed with DeadlineExceededError (exit 11)")
 
 
 class ProfileScheduler:
@@ -64,16 +75,21 @@ class ProfileScheduler:
                  read_cache: Optional[str] = None,
                  read_cache_entries: Optional[int] = None,
                  read_cache_bytes: Optional[int] = None,
+                 serve_backlog: Optional[int] = None,
                  devices: Optional[Sequence] = None):
         from tpuprof.config import (resolve_aot_cache_dir,
                                     resolve_job_timeout,
                                     resolve_read_cache,
                                     resolve_read_cache_bytes,
                                     resolve_read_cache_entries,
+                                    resolve_serve_backlog,
                                     resolve_serve_queue_depth,
                                     resolve_serve_tenant_quota,
                                     resolve_serve_workers)
         self.workers = resolve_serve_workers(workers)
+        # overload shed budget (ISSUE 19): 0 = off — only the hard
+        # queue-depth 429 bound applies, the historical behavior
+        self.serve_backlog = resolve_serve_backlog(serve_backlog)
         # the read tier (ISSUE 16) is OPT-IN at this layer: a scheduler
         # that was not handed a read_cache mode keeps the historical
         # every-submit-computes behavior (the property every pre-16
@@ -105,6 +121,10 @@ class ProfileScheduler:
                                             # the one computing primary
         self._computed = 0          # jobs that actually ran the mesh
         self._coalesced = 0         # submits that rode another's compute
+        self._shed = 0              # submits shed past serve_backlog
+        self._deadline_expired = 0  # queued jobs dead before a worker
+        self._cancelled = 0         # client-disconnect cancellations
+        self._released = 0          # drain handoffs to fleet peers
         self._counts = {DONE: 0, FAILED: 0, REJECTED: 0}
         self._latencies: "collections.deque[float]" = \
             collections.deque(maxlen=4096)   # done jobs only (SLO view)
@@ -154,13 +174,30 @@ class ProfileScheduler:
                         return self._attach_locked(primary, key, job)
                     self._by_key[key] = job
                     job._key = key
+            # overload shed (ISSUE 19): past the backlog budget a
+            # non-cacheable submit is refused BEFORE the queue — the
+            # cache-hit and coalescing returns above never reach here,
+            # so the read tier keeps answering while compute degrades
+            if self.serve_backlog \
+                    and len(self._queue) >= self.serve_backlog:
+                raise BacklogFull(
+                    f"serve backlog budget exhausted "
+                    f"({len(self._queue)} queued >= serve_backlog="
+                    f"{self.serve_backlog}) — compute admission is "
+                    "shedding while reads keep serving; retry after "
+                    "the drain")
             self._queue.admit(job)
         except (QueueFull, TenantQuotaExceeded, QueueClosed,
-                ValueError, TypeError) as exc:
+                BacklogFull, ValueError, TypeError) as exc:
             # the admission hook the HTTP edge (serve/http.py) maps to
             # status codes: quota/depth rejections are 429 (retry
-            # later), a closing queue is 503, everything else is the
-            # request's own fault (400)
+            # later), a closing queue is 503, a shed is 503 WITH a
+            # Retry-After, everything else is the request's own fault
+            # (400)
+            if isinstance(exc, BacklogFull):
+                with self._lock:
+                    self._shed += 1
+                _SHED.inc()
             job.reject_kind = type(exc).__name__
             job.to(REJECTED, error=str(exc))
             with self._lock:
@@ -316,7 +353,31 @@ class ProfileScheduler:
             self._run_job(job)
 
     def _run_job(self, job: Job) -> None:
-        from tpuprof.errors import TYPED_ERRORS, exit_code
+        import time as _time
+
+        from tpuprof.errors import (TYPED_ERRORS, DeadlineExceededError,
+                                    exit_code)
+        # never start a dead job (ISSUE 19): a cancelled submit (client
+        # gone, nobody coalesced onto it) or an expired client deadline
+        # terminates here, before any mesh time is spent.  A job with
+        # followers runs regardless — someone still wants the answer.
+        with self._lock:
+            has_followers = bool(job._followers)
+        if job.cancelled and not has_followers:
+            self._terminate_unstarted(
+                job, "cancelled: client disconnected before the answer",
+                1)
+            return
+        if job.deadline_unix is not None and not has_followers:
+            late = _time.time() - job.deadline_unix
+            if late > 0:
+                exc = DeadlineExceededError(job.id, late)
+                with self._lock:
+                    self._deadline_expired += 1
+                _DEADLINE_EXPIRED.inc()
+                self._terminate_unstarted(
+                    job, f"{type(exc).__name__}: {exc}", exit_code(exc))
+                return
         config = job._config
         with self._lock:
             self._computed += 1     # actual mesh runs — the read
@@ -395,6 +456,75 @@ class ProfileScheduler:
             self._record_terminal(job)
             self._fan_out(job)
 
+    def _terminate_unstarted(self, job: Job, error: str,
+                             code: int) -> None:
+        """Terminal bookkeeping for a QUEUED job that must not run
+        (expired deadline, cancellation): the queued->failed edge, the
+        tenant slot released, the coalescing key freed, followers (if
+        any raced in) fanned the failure."""
+        job.to(FAILED, error=error, exit_code=code)
+        self._queue.release(job)
+        with self._done_cond:
+            self._counts[FAILED] += 1
+            if job._key is not None \
+                    and self._by_key.get(job._key) is job:
+                del self._by_key[job._key]
+            self._done_cond.notify_all()
+        self._record_terminal(job)
+        self._fan_out(job)
+
+    def cancel(self, job_id: str) -> bool:
+        """Client-disconnect cancellation (ISSUE 19): mark a still-
+        QUEUED job so the worker skips it.  Returns False — and leaves
+        the job alone — once it is running/terminal or has coalesced
+        followers riding it (their answer still matters); a running
+        job finishes and publishes to the result cache either way."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None or job.state != QUEUED or job._followers:
+                return False
+            job.cancelled = True
+            self._cancelled += 1
+        return True
+
+    def release_queued(self, select=None) -> List[Job]:
+        """Graceful drain (ISSUE 19): pull still-QUEUED jobs back out
+        of the local queue so a closing fleet daemon's peers can steal
+        and answer them (the daemon unlinks the spool claims).  Only
+        jobs ``select(job)`` picks are released (the daemon passes its
+        spool-backed set — a /v1/query compute has no job file and no
+        peer, so it must drain HERE); jobs carrying coalesced
+        followers stay queued regardless — a local waiter still needs
+        their answer from THIS process.  Released jobs keep their
+        QUEUED state and get no terminal record here: their job files
+        remain in the spool, and the peer that wins the re-claim
+        writes the one result."""
+        released = self._queue.drain(
+            keep=lambda j: bool(j._followers)
+            or (select is not None and not select(j)))
+        with self._lock:
+            for job in released:
+                self._jobs.pop(job.id, None)
+                if job._key is not None \
+                        and self._by_key.get(job._key) is job:
+                    del self._by_key[job._key]
+            self._released += len(released)
+        for job in released:
+            self._queue.release(job)
+        return released
+
+    def retry_after_s(self) -> float:
+        """Shed-response backoff hint: queued depth over the observed
+        drain rate (workers x recent p50), jittered so a thousand shed
+        clients do not retry in lockstep (the poll_intervals idiom)."""
+        import random
+        with self._lock:
+            lat: List[float] = list(self._latencies)
+            depth = len(self._queue)
+        per_job = percentile(lat, 50) or 1.0
+        base = max(per_job * max(depth, 1) / max(self.workers, 1), 0.5)
+        return round(min(base, 300.0) * random.uniform(0.75, 1.25), 2)
+
     def _probe_cache(self, job: Job, config) -> Optional[bool]:
         """True when the job's (config, shape) key already holds a
         cached runner — i.e. this job pays no compile.  Shape discovery
@@ -469,6 +599,11 @@ class ProfileScheduler:
                 "workers": self.workers,
                 "computed": self._computed,
                 "coalesced": self._coalesced,
+                "shed": self._shed,
+                "serve_backlog": self.serve_backlog,
+                "deadline_expired": self._deadline_expired,
+                "cancelled": self._cancelled,
+                "released": self._released,
             }
         out["p50_s"] = round(percentile(lat, 50), 4)
         out["p99_s"] = round(percentile(lat, 99), 4)

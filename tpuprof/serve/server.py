@@ -65,6 +65,11 @@ _CLAIMED = _obs_metrics.gauge(
 _STOLEN = _obs_metrics.counter(
     "tpuprof_serve_jobs_stolen_total",
     "spool jobs taken over from dead fleet daemons, by daemon id")
+_DRAIN_SECONDS = _obs_metrics.histogram(
+    "tpuprof_serve_drain_seconds",
+    "graceful-drain duration (stop signal -> daemon closed): in-flight "
+    "jobs finished, unstarted claims released to fleet peers, results "
+    "flushed (ISSUE 19)")
 
 
 def poll_intervals(initial: float = 0.1, cap: float = 2.0,
@@ -112,10 +117,15 @@ def write_job(spool: str, source: str, output: Optional[str] = None,
               stats_json: Optional[str] = None,
               artifact: Optional[str] = None,
               config_kwargs: Optional[Dict[str, Any]] = None,
-              job_id: Optional[str] = None) -> str:
+              job_id: Optional[str] = None,
+              deadline_unix_ms: Optional[int] = None) -> str:
     """Drop one request into the spool; returns the job id.  Paths in
     the request are resolved to absolute here — the daemon's cwd is not
-    the client's."""
+    the client's.  ``deadline_unix_ms`` (absolute epoch milliseconds —
+    relative budgets resolve client-side, where "now" is the submit
+    instant) rides the wire so whichever daemon ingests the job —
+    including a fleet peer that steals it — enforces the same cutoff
+    (ISSUE 19)."""
     from tpuprof.serve.jobs import new_job_id
     dirs = _spool_dirs(spool)
     jid = job_id or new_job_id()
@@ -127,6 +137,8 @@ def write_job(spool: str, source: str, output: Optional[str] = None,
         "artifact": os.path.abspath(artifact) if artifact else None,
         "config": dict(config_kwargs or {}),
     }
+    if deadline_unix_ms is not None:
+        payload["deadline_unix_ms"] = int(deadline_unix_ms)
     _atomic_write_json(dirs, os.path.join(dirs["jobs"], f"{jid}.json"),
                        payload)
     return jid
@@ -217,6 +229,7 @@ class ServeDaemon:
                  claim_jobs: bool = False,
                  daemon_id: Optional[str] = None,
                  liveness_timeout_s: Optional[float] = None,
+                 drain_timeout_s: Optional[float] = None,
                  aot_cache_dir: Optional[str] = None,
                  aot_cache: Optional[str] = None,
                  aot_prewarm: Optional[int] = None,
@@ -224,6 +237,12 @@ class ServeDaemon:
         self.spool = spool
         self.dirs = _spool_dirs(spool)
         self.poll_interval = max(float(poll_interval), 0.01)
+        # graceful-drain budget (ISSUE 19): how long close() lets
+        # in-flight jobs finish before giving up the wait — the
+        # SIGTERM-to-exit bound `tpuprof serve` promises its operator
+        from tpuprof.config import resolve_serve_drain_timeout
+        self.drain_timeout_s = resolve_serve_drain_timeout(
+            drain_timeout_s)
         # AOT executable cache (runtime/aot.py, ISSUE 15): the daemon's
         # restart-to-warm store.  The CLI defaults it to SPOOL/aot;
         # library embeddings opt in by passing a dir (or the env twin).
@@ -447,12 +466,16 @@ class ServeDaemon:
                 raise ValueError(
                     f"job schema {req.get('schema')!r} is not "
                     f"{JOB_SCHEMA}")
+            deadline_ms = req.get("deadline_unix_ms")
             job = Job(source=req["source"], output=req.get("output"),
                       tenant=req.get("tenant") or "default",
                       job_id=req.get("id") or name[: -len(".json")],
                       stats_json=req.get("stats_json"),
                       artifact=req.get("artifact"),
-                      config_kwargs=req.get("config") or {})
+                      config_kwargs=req.get("config") or {},
+                      deadline_unix=(int(deadline_ms) / 1000.0
+                                     if deadline_ms is not None
+                                     else None))
         except (OSError, ValueError, KeyError, TypeError) as exc:
             # a torn/garbage request file must answer, not rot silently
             # in the spool: synthesize a rejected result under the
@@ -498,7 +521,8 @@ class ServeDaemon:
                      tenant: str = "default",
                      stats_json: Optional[str] = None,
                      artifact: Optional[str] = None,
-                     config_kwargs: Optional[Dict[str, Any]] = None
+                     config_kwargs: Optional[Dict[str, Any]] = None,
+                     deadline_unix: Optional[float] = None
                      ) -> Job:
         """Admit one job through THIS daemon's scheduler, durably.
 
@@ -522,7 +546,10 @@ class ServeDaemon:
                 self.daemon_id)
         write_job(self.spool, source, output=output, tenant=tenant,
                   stats_json=stats_json, artifact=artifact,
-                  config_kwargs=config_kwargs, job_id=jid)
+                  config_kwargs=config_kwargs, job_id=jid,
+                  deadline_unix_ms=(int(deadline_unix * 1000)
+                                    if deadline_unix is not None
+                                    else None))
         self._seen.add(f"{jid}.json")   # the poll loop must not re-ingest
         job = Job(source=os.path.abspath(source),
                   output=os.path.abspath(output) if output else None,
@@ -531,7 +558,8 @@ class ServeDaemon:
                   if stats_json else None,
                   artifact=os.path.abspath(artifact)
                   if artifact else None,
-                  config_kwargs=dict(config_kwargs or {}))
+                  config_kwargs=dict(config_kwargs or {}),
+                  deadline_unix=deadline_unix)
         job = self.scheduler.submit(job)
         if job.state in TERMINAL:       # rejected at admission
             self._write_result(job)
@@ -551,9 +579,33 @@ class ServeDaemon:
                 return
             self.stop_event.wait(self.poll_interval)
 
-    def close(self, timeout: Optional[float] = 30.0) -> None:
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Graceful drain (ISSUE 19): finish what is RUNNING, hand back
+        what is not.  Queued jobs this daemon claimed but never started
+        are released — pulled from the local queue, their spool claims
+        unlinked — so fleet peers steal and answer them immediately
+        (the job files stay; no result is written here, so the peer's
+        is the one result).  In-flight jobs get up to the drain budget
+        to finish and flush; then the heartbeat departs.  ``timeout``
+        overrides the daemon's ``drain_timeout_s`` when given."""
+        t0 = time.monotonic()
+        drain_budget = self.drain_timeout_s if timeout is None \
+            else float(timeout)
         self.stop_event.set()
-        self.scheduler.shutdown(wait=True, timeout=timeout)
+        released = []
+        if self.claim_jobs:
+            # release BEFORE the queue closes: peers must win these,
+            # not this daemon's exiting workers.  Only spool-backed
+            # jobs qualify — an HTTP /v1/query compute has no job file
+            # for a peer to steal and a local handler blocked on it,
+            # so it must drain here instead.
+            released = self.scheduler.release_queued(
+                select=lambda j: j.id in self._pending)
+            for job in released:
+                self._pending.pop(job.id, None)
+                self._seen.discard(f"{job.id}.json")
+                self._cleanup_claims(job.id)
+        self.scheduler.shutdown(wait=True, timeout=drain_budget)
         # flush results of anything that finished during shutdown
         for jid, job in list(self._pending.items()):
             if job.state in TERMINAL:
@@ -572,4 +624,11 @@ class ServeDaemon:
                 self._hb_thread.join(timeout=5)
             _obs_events.emit("serve_fleet_depart",
                              daemon=self.daemon_id,
+                             unanswered=len(self._pending))
+        seconds = time.monotonic() - t0
+        _DRAIN_SECONDS.observe(seconds)
+        if _obs_metrics.enabled():
+            _obs_events.emit("serve_drain", daemon=self.daemon_id,
+                             seconds=round(seconds, 4),
+                             released=len(released),
                              unanswered=len(self._pending))

@@ -36,6 +36,13 @@ Sites wired today (grep ``faults.hit`` / ``faults.mangle``):
                           (runtime/aot.py — a raising load demotes
                           loudly to a fresh compile, never fails the
                           profile)
+``http_accept``           the HTTP edge's accept() (serve/http.py —
+                          an injected raise simulates EMFILE; the
+                          selector loop skips the round and survives)
+``http_write``            the HTTP edge's response write (serve/
+                          http.py — an injected raise resets the
+                          connection mid-response; that socket drops,
+                          the loop keeps serving)
 ========================  ==================================================
 
 Spec grammar (config/env-driven; ``TPUPROF_FAULTS`` +
@@ -112,6 +119,11 @@ SITES = frozenset({
     # AOT executable cache (runtime/aot.py): entry load on a
     # runner-cache miss — raises demote to a fresh compile
     "aot_load",
+    # HTTP edge transport (serve/http.py, ISSUE 19): accept-time
+    # failure (EMFILE under fd pressure — the loop skips the round and
+    # keeps serving) and mid-response write failure (connection reset
+    # — the socket drops, everyone else keeps their answers)
+    "http_accept", "http_write",
 })
 
 

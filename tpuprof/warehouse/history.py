@@ -145,7 +145,8 @@ def query_trend(dirpath: str, col: Optional[str] = None
 
 
 def query_columns(dirpath: str, cols: List[str],
-                  stats: List[str]) -> Optional[Dict[str, Any]]:
+                  stats: List[str],
+                  on_corrupt=None) -> Optional[Dict[str, Any]]:
     """The NEWEST readable generation's values for a column/stat subset
     — the warehouse leg of ``POST /v1/query`` pushdown (ISSUE 16 (c)).
 
@@ -161,7 +162,12 @@ def query_columns(dirpath: str, cols: List[str],
     ``generation``/``created_unix``/``rows``/``columns``/``missing``,
     where ``missing`` lists requested columns this generation never
     profiled — a non-empty list also sends the caller to the computed
-    tier, since the warehouse cannot answer the whole question."""
+    tier, since the warehouse cannot answer the whole question.
+
+    ``on_corrupt(path, exc)`` is invoked for every corrupt/unreadable
+    generation the walk skips — the HTTP edge's circuit breaker
+    (ISSUE 19) counts these to decide when this source's warehouse
+    reads stop being worth the disk tax."""
     t0 = time.perf_counter()
     for gen, path in reversed(store.chain(dirpath)):
         try:
@@ -172,6 +178,8 @@ def query_columns(dirpath: str, cols: List[str],
             _FALLBACKS.inc()
             blackbox.record("warehouse_fallback", path=path,
                             error=f"{type(exc).__name__}: {exc}")
+            if on_corrupt is not None:
+                on_corrupt(path, exc)
             continue
         columns: Dict[str, Any] = {}
         missing: List[str] = []
